@@ -1,0 +1,11 @@
+"""avenir_tpu — TPU-native LLM training framework.
+
+The JAX/XLA/Pallas backend of this repo (SURVEY.md §2b). The compute path is
+jax + flax.nnx + pallas; parallelism is data layout: a `jax.sharding.Mesh`
+with axes ('data', 'fsdp', 'tensor') (plus 'expert' for MoE and 'context'
+for ring attention), NamedSharding partition rules, and XLA SPMD collectives
+over ICI/DCN. Import is torch-free: a TPU pod never needs torch
+(BASELINE.json:5).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from avenir_tpu.utils.corpus import synthetic_corpus, write_char_dataset

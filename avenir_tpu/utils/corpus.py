@@ -1,0 +1,75 @@
+"""Deterministic synthetic corpus for tests and offline data prep.
+
+The sandbox has zero network egress, so `data/*/prepare.py` cannot download
+tinyshakespeare. This module generates a deterministic pseudo-English corpus
+with enough statistical structure (Zipf word distribution, stable bigram
+statistics, line structure) that a small LM's loss drops fast — good enough
+to anchor golden-loss tests (SURVEY.md §4) and smoke training runs. A real
+`input.txt` dropped next to a prepare.py always takes precedence.
+
+Torch-free (importable on a TPU pod)."""
+
+import os
+
+import numpy as np
+
+_WORDS = (
+    "the and to of a in that is was he for it with as his on be at by i "
+    "this had not are but from or have an they which one you were her all "
+    "she there would their we him been has when who will more no if out so "
+    "said what up its about into than them can only other new some could "
+    "time these two may then do first any my now such like our over man me "
+    "even most made after also did many before must through back years where "
+    "much your way well down should because each just those people mr how "
+    "too little state good very make world still own see men work long get "
+    "here between both life being under never day same another know while "
+    "last might us great old year off come since against go came right used "
+    "take three"
+).split()
+
+
+def synthetic_corpus(n_chars: int = 500_000, seed: int = 1337) -> str:
+    """Deterministic pseudo-text: Zipf-distributed words, ~12 words/line."""
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, len(_WORDS) + 1, dtype=np.float64)
+    probs = 1.0 / ranks
+    probs /= probs.sum()
+    parts = []
+    total = 0
+    line_len = 0
+    # draw in chunks for speed
+    while total < n_chars:
+        idxs = rng.choice(len(_WORDS), size=4096, p=probs)
+        for i in idxs:
+            w = _WORDS[i]
+            parts.append(w)
+            total += len(w) + 1
+            line_len += 1
+            if line_len >= 12:
+                parts.append("\n")
+                line_len = 0
+            else:
+                parts.append(" ")
+            if total >= n_chars:
+                break
+    return "".join(parts)
+
+
+def write_char_dataset(out_dir: str, text: str, train_frac: float = 0.9):
+    """Char-level tokenize `text` into train.bin/val.bin uint16 memmaps plus
+    a meta.pkl with the stoi/itos tables (nanoGPT-lineage on-disk layout, so
+    both backends' get_batch can memmap it — SURVEY.md §2a R4)."""
+    import pickle
+
+    chars = sorted(set(text))
+    stoi = {ch: i for i, ch in enumerate(chars)}
+    itos = {i: ch for i, ch in enumerate(chars)}
+    data = np.array([stoi[c] for c in text], dtype=np.uint16)
+    n = int(train_frac * len(data))
+    os.makedirs(out_dir, exist_ok=True)
+    data[:n].tofile(os.path.join(out_dir, "train.bin"))
+    data[n:].tofile(os.path.join(out_dir, "val.bin"))
+    meta = {"vocab_size": len(chars), "stoi": stoi, "itos": itos}
+    with open(os.path.join(out_dir, "meta.pkl"), "wb") as f:
+        pickle.dump(meta, f)
+    return meta

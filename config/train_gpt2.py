@@ -1,0 +1,23 @@
+# ladder config 2 (BASELINE.json:8): GPT-2 124M on OpenWebText,
+# data-parallel. cuda: torchrun --nproc_per_node=8; tpu: --backend=tpu on a
+# v4-8 ('data' mesh over all chips).
+wandb_log = False
+wandb_project = "owt"
+wandb_run_name = "gpt2-124M"
+
+dataset = "openwebtext"
+# ~0.5M tokens per iteration = 12 micro-batch * 1024 block * 40 accum
+batch_size = 12
+block_size = 1024
+gradient_accumulation_steps = 5 * 8
+
+n_layer = 12
+n_head = 12
+n_embd = 768
+
+max_iters = 600000
+lr_decay_iters = 600000
+eval_interval = 1000
+eval_iters = 200
+log_interval = 10
+weight_decay = 1e-1

@@ -1,0 +1,22 @@
+# ladder config 3 (BASELINE.json:9): GPT-2 1.5B (gpt2-xl shape) under FSDP —
+# params + optimizer state sharded on the 'fsdp' mesh axis; XLA SPMD emits
+# all-gather at use and reduce-scatter of grads over ICI. tpu backend only.
+backend = "tpu"
+mesh_shape = "data:1,fsdp:-1"  # -1 → all remaining devices
+
+dataset = "openwebtext"
+batch_size = 8
+block_size = 1024
+gradient_accumulation_steps = 8
+
+n_layer = 48
+n_head = 25
+n_embd = 1600
+
+learning_rate = 2e-4
+min_lr = 2e-5
+max_iters = 300000
+lr_decay_iters = 300000
+weight_decay = 1e-1
+remat = True
+scan_layers = True

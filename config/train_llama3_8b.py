@@ -1,0 +1,25 @@
+# ladder config 4 (BASELINE.json:10): Llama-3 8B — RoPE + SwiGLU + RMSNorm
+# (Pallas kernels) + GQA, FSDP over ICI. tpu backend only.
+backend = "tpu"
+model_type = "llama"
+mesh_shape = "data:1,fsdp:-1"
+
+dataset = "openwebtext"
+batch_size = 4
+block_size = 8192
+gradient_accumulation_steps = 16
+
+n_layer = 32
+n_head = 32
+n_kv_head = 8
+n_embd = 4096
+ffn_hidden = 14336
+rope_theta = 500000.0
+
+learning_rate = 3e-4
+min_lr = 3e-5
+max_iters = 500000
+lr_decay_iters = 500000
+weight_decay = 1e-1
+remat = True
+scan_layers = True

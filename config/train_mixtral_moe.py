@@ -1,0 +1,28 @@
+# ladder config 5 (BASELINE.json:11): Mixtral-8x7B-style MoE — top-2 router,
+# expert-parallel all-to-all over ICI ('expert' mesh axis). tpu backend only.
+backend = "tpu"
+model_type = "mixtral"
+mesh_shape = "data:1,expert:-1"
+
+dataset = "openwebtext"
+batch_size = 4
+block_size = 4096
+gradient_accumulation_steps = 16
+
+n_layer = 32
+n_head = 32
+n_kv_head = 8
+n_embd = 4096
+ffn_hidden = 14336
+rope_theta = 1000000.0
+n_experts = 8
+n_experts_per_tok = 2
+capacity_factor = 1.25
+
+learning_rate = 3e-4
+min_lr = 3e-5
+max_iters = 500000
+lr_decay_iters = 500000
+weight_decay = 1e-1
+remat = True
+scan_layers = True

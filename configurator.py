@@ -1,0 +1,63 @@
+"""Globals-override configurator (SURVEY.md §2a R3).
+
+The nanoGPT-lineage config pattern: a script declares its defaults as module
+globals, then calls `configure(globals())`, which
+
+  1. if the first positional CLI arg is a path, `exec`s that config file
+     into the globals (so config files are plain Python assigning the same
+     names), and
+  2. applies `--key=value` CLI overrides, literal-eval'ing values so
+     `--lr=3e-4` stays a float and `--compile=False` a bool.
+
+Overriding a key that has no default is an error (fail loud, like the
+partition-rule miss policy in SURVEY.md §4). Shared by both backends so the
+same argv drives CUDA and TPU runs (BASELINE.json:5).
+"""
+
+import sys
+from ast import literal_eval
+
+
+def configure(g, argv=None, allow_new_keys=False):
+    """Apply config-file + --key=value overrides to the dict `g` (usually the
+    caller's globals()). Returns the list of (key, value) overrides applied."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    applied = []
+    for arg in argv:
+        if "=" not in arg:
+            # assume it's a config file path
+            assert not arg.startswith("--"), f"flag {arg!r} must look like --key=value"
+            config_file = arg
+            print(f"[configurator] overriding config with {config_file}:")
+            with open(config_file) as f:
+                code = f.read()
+            print(code)
+            known = set(g)
+            exec(code, g)
+            new_keys = [
+                k for k in set(g) - known
+                if not k.startswith("_") and isinstance(g[k], (int, float, bool, str))
+            ]
+            if new_keys and not allow_new_keys:
+                raise ValueError(
+                    f"config file {config_file} sets unknown key(s): {sorted(new_keys)}"
+                )
+            applied.append(("__config_file__", config_file))
+        else:
+            assert arg.startswith("--"), f"override {arg!r} must look like --key=value"
+            key, val = arg[2:].split("=", 1)
+            if key not in g and not allow_new_keys:
+                raise ValueError(f"unknown config key: {key}")
+            try:
+                attempt = literal_eval(val)
+            except (SyntaxError, ValueError):
+                attempt = val  # it's a bare string
+            default = g.get(key)
+            if default is not None and attempt is not None:
+                assert isinstance(attempt, type(default)) or (
+                    isinstance(attempt, (int, float)) and isinstance(default, (int, float))
+                ), f"--{key}: {type(attempt).__name__} does not match default {type(default).__name__}"
+            print(f"[configurator] overriding: {key} = {attempt}")
+            g[key] = attempt
+            applied.append((key, attempt))
+    return applied

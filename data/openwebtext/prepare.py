@@ -1,0 +1,77 @@
+"""Prepare OpenWebText with the GPT-2 BPE tokenizer (SURVEY.md §2a R4;
+ladder config 2, BASELINE.json:8).
+
+Streams the HF `openwebtext` dataset through tiktoken's GPT-2 BPE into
+train.bin / val.bin uint16 memmaps. Needs network + disk; in the zero-egress
+sandbox use --synthetic to produce a small GPT-2-BPE-compatible stand-in
+(ids < 50257) so the training path is exercisable end to end.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+NUM_PROC = 8
+
+
+def prepare_synthetic(here: str, n_tokens: int = 2_000_000, seed: int = 1337):
+    from avenir_tpu.utils.corpus import synthetic_corpus
+
+    try:
+        import tiktoken
+
+        enc = tiktoken.get_encoding("gpt2")
+        text = synthetic_corpus(n_chars=n_tokens * 4, seed=seed)
+        ids = np.array(enc.encode_ordinary(text), dtype=np.uint16)
+    except Exception:
+        # no tiktoken cache offline: Zipf-distributed ids stand in for BPE
+        rng = np.random.default_rng(seed)
+        ranks = np.arange(1, 50258, dtype=np.float64)
+        probs = (1.0 / ranks) / (1.0 / ranks).sum()
+        ids = rng.choice(50257, size=n_tokens, p=probs).astype(np.uint16)
+    # keep val comfortably larger than any block_size (the full prep uses
+    # 0.0005, but on a small synthetic corpus that is < 1024 tokens and
+    # get_batch('val') would underflow)
+    n = int(0.95 * len(ids))
+    ids[:n].tofile(os.path.join(here, "train.bin"))
+    ids[n:].tofile(os.path.join(here, "val.bin"))
+    print(f"train tokens={n:,}, val tokens={len(ids) - n:,}")
+
+
+def prepare_full(here: str):
+    import tiktoken
+    from datasets import load_dataset  # pip: datasets (not in sandbox image)
+
+    enc = tiktoken.get_encoding("gpt2")
+    dataset = load_dataset("openwebtext", num_proc=NUM_PROC)
+    split = dataset["train"].train_test_split(test_size=0.0005, seed=2357, shuffle=True)
+    split["val"] = split.pop("test")
+
+    def process(example):
+        ids = enc.encode_ordinary(example["text"])
+        ids.append(enc.eot_token)
+        return {"ids": ids, "len": len(ids)}
+
+    tokenized = split.map(process, remove_columns=["text"], num_proc=NUM_PROC)
+    for name, dset in tokenized.items():
+        arr_len = int(np.sum(dset["len"], dtype=np.uint64))
+        arr = np.memmap(
+            os.path.join(here, f"{name}.bin"), dtype=np.uint16, mode="w+", shape=(arr_len,)
+        )
+        idx = 0
+        for batch in dset.iter(batch_size=1024):
+            for ids in batch["ids"]:
+                arr[idx : idx + len(ids)] = ids
+                idx += len(ids)
+        arr.flush()
+
+
+if __name__ == "__main__":
+    here = os.path.dirname(os.path.abspath(__file__))
+    if "--synthetic" in sys.argv:
+        prepare_synthetic(here)
+    else:
+        prepare_full(here)

@@ -1,0 +1,43 @@
+"""Prepare the char-level shakespeare dataset (SURVEY.md §2a R4).
+
+Writes train.bin / val.bin (uint16 char ids) + meta.pkl into this directory.
+Source text, in order of preference:
+  1. ./input.txt if present (drop the real tinyshakespeare here),
+  2. download from the public URL (fails in this zero-egress sandbox),
+  3. deterministic synthetic corpus (avenir_tpu.utils.corpus) as fallback.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from avenir_tpu.utils.corpus import synthetic_corpus, write_char_dataset
+
+DATA_URL = "https://raw.githubusercontent.com/karpathy/char-rnn/master/data/tinyshakespeare/input.txt"
+
+
+def load_text() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    input_path = os.path.join(here, "input.txt")
+    if os.path.exists(input_path):
+        with open(input_path, encoding="utf-8") as f:
+            return f.read()
+    try:
+        import urllib.request
+
+        with urllib.request.urlopen(DATA_URL, timeout=10) as r:
+            text = r.read().decode("utf-8")
+        with open(input_path, "w", encoding="utf-8") as f:
+            f.write(text)
+        return text
+    except Exception as e:  # no network in sandbox
+        print(f"[prepare] download failed ({e}); using synthetic corpus")
+        return synthetic_corpus(n_chars=1_000_000, seed=1337)
+
+
+if __name__ == "__main__":
+    here = os.path.dirname(os.path.abspath(__file__))
+    text = load_text()
+    meta = write_char_dataset(here, text)
+    print(f"vocab_size={meta['vocab_size']}, chars={len(text):,}")

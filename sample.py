@@ -1,0 +1,96 @@
+"""Sample from a trained checkpoint, dual backend (SURVEY.md §2a R5, §3.5).
+
+    python sample.py --out_dir=out-shakespeare-char
+    python sample.py --out_dir=out-shakespeare-char --backend=tpu
+"""
+
+import os
+import pickle
+
+# ----------------------------------------------------------------------------
+init_from = "resume"  # 'resume' (from out_dir) or 'gpt2*' (HF weights)
+out_dir = "out"
+start = "\n"  # prompt; "FILE:path" reads the prompt from a file
+num_samples = 3
+max_new_tokens = 500
+temperature = 0.8
+top_k = 200
+seed = 1337
+backend = "cuda"
+device = "cpu"
+# ----------------------------------------------------------------------------
+from configurator import configure
+
+configure(globals())
+
+if start.startswith("FILE:"):
+    with open(start[5:], encoding="utf-8") as f:
+        start = f.read()
+
+
+def load_codec():
+    """Char-level codec from the dataset meta.pkl when available, else GPT-2 BPE."""
+    meta_path = None
+    ckpt_config = globals().get("_ckpt_config")
+    if ckpt_config and "dataset" in ckpt_config:
+        cand = os.path.join("data", ckpt_config["dataset"], "meta.pkl")
+        if os.path.exists(cand):
+            meta_path = cand
+    if meta_path:
+        with open(meta_path, "rb") as f:
+            meta = pickle.load(f)
+        stoi, itos = meta["stoi"], meta["itos"]
+        return (lambda s: [stoi[c] for c in s]), (lambda t: "".join(itos[i] for i in t))
+    import tiktoken
+
+    enc = tiktoken.get_encoding("gpt2")
+    return (
+        lambda s: enc.encode(s, allowed_special={"<|endoftext|>"}),
+        lambda t: enc.decode(t),
+    )
+
+
+def sample_cuda():
+    import torch
+
+    from model import GPT, GPTConfig, strip_compile_prefix
+
+    torch.manual_seed(seed)
+    if init_from == "resume":
+        ckpt = torch.load(
+            os.path.join(out_dir, "ckpt.pt"), map_location=device, weights_only=False
+        )
+        globals()["_ckpt_config"] = ckpt.get("config", {})
+        model = GPT(GPTConfig(**{
+            k: ckpt["model_args"][k]
+            for k in ("n_layer", "n_head", "n_embd", "block_size", "bias", "vocab_size")
+        }))
+        model.load_state_dict(strip_compile_prefix(ckpt["model"]))
+    else:
+        model = GPT.from_pretrained(init_from, dict(dropout=0.0))
+    model.eval().to(device)
+    encode, decode = load_codec()
+    x = torch.tensor(encode(start), dtype=torch.long, device=device)[None, ...]
+    with torch.no_grad():
+        for _ in range(num_samples):
+            y = model.generate(x, max_new_tokens, temperature=temperature, top_k=top_k)
+            print(decode(y[0].tolist()))
+            print("---------------")
+
+
+def sample_tpu():
+    from avenir_tpu.sampling import run_sampling
+
+    run_sampling(
+        out_dir=out_dir, init_from=init_from, start=start, num_samples=num_samples,
+        max_new_tokens=max_new_tokens, temperature=temperature, top_k=top_k,
+        seed=seed, set_ckpt_config=lambda c: globals().__setitem__("_ckpt_config", c),
+        load_codec=load_codec,
+    )
+
+
+if __name__ == "__main__":
+    if backend == "tpu":
+        sample_tpu()
+    else:
+        sample_cuda()

@@ -1,0 +1,33 @@
+"""Test harness config (SURVEY.md §4): force jax onto CPU with 8 virtual
+devices so Mesh/SPMD/collective tests run without TPU hardware. Must happen
+before anything imports jax."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# repo root on sys.path so `import model`, `import train` etc. work from tests/
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def char_dataset(tmp_path_factory):
+    """Tiny deterministic char-level dataset in the nanoGPT on-disk layout."""
+    from avenir_tpu.utils.corpus import synthetic_corpus, write_char_dataset
+
+    root = tmp_path_factory.mktemp("data") / "shakespeare_char"
+    text = synthetic_corpus(n_chars=60_000, seed=7)
+    meta = write_char_dataset(str(root), text)
+    return {"dir": str(root), "meta": meta}
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

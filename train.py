@@ -1,0 +1,337 @@
+"""Single-file trainer, dual backend (SURVEY.md §2a R2 + §2b T11).
+
+One CLI entrypoint serves both stacks (BASELINE.json:5):
+
+    # CUDA/CPU reference (PyTorch, DDP via torchrun):
+    python train.py config/train_shakespeare_char.py
+    torchrun --nproc_per_node=8 train.py config/train_gpt2.py
+
+    # TPU-native backend (jax/XLA/Pallas) — same argv + one flag:
+    python train.py config/train_shakespeare_char.py --backend=tpu
+
+Import discipline: torch is imported only on the cuda path and jax only on
+the tpu path, so a TPU pod with no GPU (and no torch install) runs end to
+end (BASELINE.json:5). Config is the globals-override pattern shared by both
+backends (configurator.py).
+"""
+
+import math
+import os
+import pickle
+import time
+from contextlib import nullcontext
+
+import numpy as np
+
+# -----------------------------------------------------------------------------
+# defaults — every key here is overridable via config file or --key=value
+# I/O
+out_dir = "out"
+eval_interval = 2000
+log_interval = 1
+eval_iters = 200
+eval_only = False
+always_save_checkpoint = True
+init_from = "scratch"  # 'scratch' | 'resume' | 'gpt2*'
+# wandb logging
+wandb_log = False
+wandb_project = "avenir"
+wandb_run_name = "run"
+# data
+dataset = "openwebtext"
+gradient_accumulation_steps = 5 * 8
+batch_size = 12  # micro-batch size per device
+block_size = 1024
+# model
+model_type = "gpt"  # 'gpt' | 'llama' | 'mixtral' (llama/mixtral are tpu-only)
+n_layer = 12
+n_head = 12
+n_embd = 768
+dropout = 0.0
+bias = False
+# llama/mixtral extras (ignored by gpt)
+n_kv_head = 0  # 0 → = n_head (MHA); <n_head → GQA
+ffn_hidden = 0  # 0 → derived (8/3 * n_embd rounded)
+rope_theta = 10000.0
+n_experts = 8
+n_experts_per_tok = 2
+capacity_factor = 1.25
+# adamw
+learning_rate = 6e-4
+max_iters = 600000
+weight_decay = 1e-1
+beta1 = 0.9
+beta2 = 0.95
+grad_clip = 1.0
+# lr schedule
+decay_lr = True
+warmup_iters = 2000
+lr_decay_iters = 600000
+min_lr = 6e-5
+# system
+backend = "cuda"  # 'cuda' (torch ref incl. CPU) | 'tpu' (jax)
+device = "cuda"  # torch device string for the cuda backend; 'cpu' works
+dtype = "bfloat16"  # 'float32' | 'bfloat16' | 'float16'
+compile = True  # torch.compile / (tpu path is always jit-compiled)
+seed = 1337
+# tpu-backend parallelism (ignored by cuda backend)
+mesh_shape = ""  # e.g. "data:4,fsdp:2"; "" → all devices on 'data'
+remat = False  # rematerialize blocks (activation checkpointing)
+scan_layers = False  # lax.scan over blocks (fast compiles for deep models)
+use_pallas = True  # pallas kernels on TPU hot path (auto-falls back off-TPU)
+profile = False  # capture a jax.profiler trace window
+# -----------------------------------------------------------------------------
+from configurator import configure
+
+config_keys = [
+    k for k, v in globals().items()
+    if not k.startswith("_") and isinstance(v, (int, float, bool, str))
+]
+configure(globals())
+config = {k: globals()[k] for k in config_keys}
+# -----------------------------------------------------------------------------
+
+
+def train_cuda():
+    """PyTorch reference trainer (R2): DDP/NCCL data parallelism, AMP,
+    grad accumulation, cosine LR, checkpoint save/resume."""
+    import torch
+    from torch.nn.parallel import DistributedDataParallel as DDP
+    from torch.distributed import destroy_process_group, init_process_group
+
+    from model import GPT, GPTConfig
+
+    assert model_type == "gpt", "cuda backend implements the GPT-2 reference only"
+
+    ddp = int(os.environ.get("RANK", -1)) != -1
+    if ddp:
+        init_process_group(backend="nccl" if device.startswith("cuda") else "gloo")
+        ddp_rank = int(os.environ["RANK"])
+        ddp_local_rank = int(os.environ["LOCAL_RANK"])
+        ddp_world_size = int(os.environ["WORLD_SIZE"])
+        dev = f"cuda:{ddp_local_rank}" if device.startswith("cuda") else device
+        if device.startswith("cuda"):
+            torch.cuda.set_device(dev)
+        master_process = ddp_rank == 0
+        seed_offset = ddp_rank
+        assert gradient_accumulation_steps % ddp_world_size == 0
+        grad_accum = gradient_accumulation_steps // ddp_world_size
+    else:
+        master_process = True
+        seed_offset = 0
+        ddp_world_size = 1
+        grad_accum = gradient_accumulation_steps
+        dev = device
+
+    tokens_per_iter = grad_accum * ddp_world_size * batch_size * block_size
+    if master_process:
+        print(f"tokens per iteration: {tokens_per_iter:,}")
+        os.makedirs(out_dir, exist_ok=True)
+    torch.manual_seed(seed + seed_offset)
+    torch.backends.cuda.matmul.allow_tf32 = True
+    torch.backends.cudnn.allow_tf32 = True
+    device_type = "cuda" if "cuda" in dev else "cpu"
+    ptdtype = {
+        "float32": torch.float32, "bfloat16": torch.bfloat16, "float16": torch.float16
+    }[dtype]
+    amp_ctx = (
+        nullcontext() if device_type == "cpu"
+        else torch.amp.autocast(device_type=device_type, dtype=ptdtype)
+    )
+
+    data_dir = os.path.join("data", dataset)
+
+    def get_batch(split):
+        # recreate np.memmap every call to avoid the memory-leak footgun
+        arr = np.memmap(
+            os.path.join(data_dir, f"{split}.bin"), dtype=np.uint16, mode="r"
+        )
+        ix = torch.randint(len(arr) - block_size, (batch_size,))
+        x = torch.stack(
+            [torch.from_numpy(arr[i : i + block_size].astype(np.int64)) for i in ix]
+        )
+        y = torch.stack(
+            [torch.from_numpy(arr[i + 1 : i + 1 + block_size].astype(np.int64)) for i in ix]
+        )
+        if device_type == "cuda":
+            x = x.pin_memory().to(dev, non_blocking=True)
+            y = y.pin_memory().to(dev, non_blocking=True)
+        else:
+            x, y = x.to(dev), y.to(dev)
+        return x, y
+
+    iter_num = 0
+    best_val_loss = 1e9
+
+    meta_path = os.path.join(data_dir, "meta.pkl")
+    meta_vocab_size = None
+    if os.path.exists(meta_path):
+        with open(meta_path, "rb") as f:
+            meta_vocab_size = pickle.load(f)["vocab_size"]
+        if master_process:
+            print(f"found vocab_size = {meta_vocab_size} (from {meta_path})")
+
+    model_args = dict(
+        n_layer=n_layer, n_head=n_head, n_embd=n_embd, block_size=block_size,
+        bias=bias, vocab_size=None, dropout=dropout,
+    )
+    if init_from == "scratch":
+        model_args["vocab_size"] = meta_vocab_size if meta_vocab_size else 50304
+        model = GPT(GPTConfig(**model_args))
+    elif init_from == "resume":
+        ckpt_path = os.path.join(out_dir, "ckpt.pt")
+        checkpoint = torch.load(ckpt_path, map_location=dev, weights_only=False)
+        for k in ("n_layer", "n_head", "n_embd", "block_size", "bias", "vocab_size"):
+            model_args[k] = checkpoint["model_args"][k]
+        model = GPT(GPTConfig(**model_args))
+        from model import strip_compile_prefix
+
+        model.load_state_dict(strip_compile_prefix(checkpoint["model"]))
+        iter_num = checkpoint["iter_num"]
+        best_val_loss = checkpoint["best_val_loss"]
+    elif init_from.startswith("gpt2"):
+        model = GPT.from_pretrained(init_from, dict(dropout=dropout))
+        for k in ("n_layer", "n_head", "n_embd", "block_size", "bias", "vocab_size"):
+            model_args[k] = getattr(model.config, k)
+    else:
+        raise ValueError(f"unknown init_from {init_from!r}")
+
+    if block_size < model.config.block_size:
+        model.crop_block_size(block_size)
+        model_args["block_size"] = block_size
+    model.to(dev)
+
+    scaler = torch.amp.GradScaler(device_type, enabled=(dtype == "float16"))
+    optimizer = model.configure_optimizers(
+        weight_decay, learning_rate, (beta1, beta2), device_type
+    )
+    if init_from == "resume":
+        optimizer.load_state_dict(checkpoint["optimizer"])
+    checkpoint = None
+
+    if compile and hasattr(torch, "compile") and device_type == "cuda":
+        model = torch.compile(model)
+    if ddp:
+        model = DDP(model, device_ids=[ddp_local_rank] if device_type == "cuda" else None)
+    raw_model = model.module if ddp else model
+
+    @torch.no_grad()
+    def estimate_loss():
+        out = {}
+        model.eval()
+        for split in ("train", "val"):
+            losses = torch.zeros(eval_iters)
+            for k in range(eval_iters):
+                X, Y = get_batch(split)
+                with amp_ctx:
+                    _, loss = model(X, Y)
+                losses[k] = loss.item()
+            out[split] = losses.mean()
+        model.train()
+        return out
+
+    def get_lr(it):
+        if it < warmup_iters:
+            return learning_rate * (it + 1) / (warmup_iters + 1)
+        if it > lr_decay_iters:
+            return min_lr
+        ratio = (it - warmup_iters) / (lr_decay_iters - warmup_iters)
+        coeff = 0.5 * (1.0 + math.cos(math.pi * ratio))
+        return min_lr + coeff * (learning_rate - min_lr)
+
+    if wandb_log and master_process:
+        import wandb
+
+        wandb.init(project=wandb_project, name=wandb_run_name, config=config)
+
+    X, Y = get_batch("train")
+    t0 = time.time()
+    local_iter_num = 0
+    running_mfu = -1.0
+    while True:
+        lr = get_lr(iter_num) if decay_lr else learning_rate
+        for pg in optimizer.param_groups:
+            pg["lr"] = lr
+
+        if iter_num % eval_interval == 0 and master_process:
+            losses = estimate_loss()
+            print(
+                f"step {iter_num}: train loss {losses['train']:.4f}, "
+                f"val loss {losses['val']:.4f}"
+            )
+            if wandb_log:
+                import wandb
+
+                wandb.log({
+                    "iter": iter_num, "train/loss": losses["train"],
+                    "val/loss": losses["val"], "lr": lr, "mfu": running_mfu * 100,
+                })
+            if losses["val"] < best_val_loss or always_save_checkpoint:
+                best_val_loss = min(best_val_loss, losses["val"])
+                if iter_num > 0:
+                    ckpt = {
+                        "model": raw_model.state_dict(),
+                        "optimizer": optimizer.state_dict(),
+                        "model_args": model_args,
+                        "iter_num": iter_num,
+                        "best_val_loss": best_val_loss,
+                        "config": config,
+                    }
+                    print(f"saving checkpoint to {out_dir}")
+                    torch.save(ckpt, os.path.join(out_dir, "ckpt.pt"))
+        if iter_num == 0 and eval_only:
+            break
+
+        for micro_step in range(grad_accum):
+            if ddp:
+                # only sync grads on the last micro step
+                model.require_backward_grad_sync = micro_step == grad_accum - 1
+            with amp_ctx:
+                _, loss = model(X, Y)
+                loss = loss / grad_accum
+            X, Y = get_batch("train")  # prefetch while device is busy
+            scaler.scale(loss).backward()
+        if grad_clip != 0.0:
+            scaler.unscale_(optimizer)
+            torch.nn.utils.clip_grad_norm_(model.parameters(), grad_clip)
+        scaler.step(optimizer)
+        scaler.update()
+        optimizer.zero_grad(set_to_none=True)
+
+        t1 = time.time()
+        dt = t1 - t0
+        t0 = t1
+        if iter_num % log_interval == 0 and master_process:
+            lossf = loss.item() * grad_accum
+            if local_iter_num >= 5:
+                mfu = raw_model.estimate_mfu(batch_size * grad_accum, dt)
+                running_mfu = mfu if running_mfu == -1.0 else 0.9 * running_mfu + 0.1 * mfu
+            print(
+                f"iter {iter_num}: loss {lossf:.4f}, time {dt * 1000:.2f}ms, "
+                f"mfu {running_mfu * 100:.2f}%"
+            )
+        iter_num += 1
+        local_iter_num += 1
+        if iter_num > max_iters:
+            break
+
+    if ddp:
+        destroy_process_group()
+
+
+def train_tpu():
+    """TPU-native trainer (T5 + friends): delegates to avenir_tpu with the
+    same config namespace. jax is imported lazily here so the cuda path never
+    needs it (and vice versa)."""
+    from avenir_tpu.train.loop import run_training
+
+    run_training(config)
+
+
+if __name__ == "__main__":
+    if backend == "tpu":
+        train_tpu()
+    elif backend == "cuda":
+        train_cuda()
+    else:
+        raise ValueError(f"unknown backend {backend!r}")
