@@ -1,0 +1,16 @@
+"""avenir_tpu.checkpoint — cross-backend checkpointing (SURVEY.md §2b T7).
+
+Two halves:
+  - bridge.py: key/layout mapping between torch state_dicts and nnx state
+    (Linear kernels transposed, LayerNorm weight→scale, tied lm_head).
+  - torch_pt.py: read/write the torch `.pt` zipfile container in pure
+    Python — no torch import — so a TPU pod can resume a CUDA checkpoint
+    and vice versa (BASELINE.json:5 "same ... checkpoint format").
+"""
+
+from avenir_tpu.checkpoint.bridge import (
+    export_torch_state_dict,
+    load_torch_state_dict,
+    nnx_path_to_torch_key,
+    torch_key_to_nnx_path,
+)

@@ -1,0 +1,147 @@
+"""torch state_dict ↔ nnx state key/layout mapping (SURVEY.md §3.4).
+
+The contract: a state_dict produced by model.py's `GPT.state_dict()` maps
+1:1 onto `avenir_tpu.models.gpt.GPT`'s param state, with
+
+  - `transformer.` prefix stripped,
+  - torch Linear `weight` (out, in) transposed to nnx `kernel` (in, out),
+  - torch LayerNorm/RMSNorm `weight` renamed to nnx `scale`,
+  - embeddings (`wte`, `wpe`, `embed_tokens`) mapped to `embedding`
+    untransposed,
+  - tied `lm_head.weight` dropped on load (the nnx model has no separate
+    lm_head param; model.py:149-151 ties it) and re-emitted on export.
+
+The same rules cover the Llama/Mixtral families (their torch-side names
+follow the HF convention); anything unrecognized raises — fail loud, per
+the partition-rule miss policy (SURVEY.md §4).
+"""
+
+import numpy as np
+from flax import nnx
+
+# module attribute names that are nnx.Linear (torch weight needs transpose)
+_LINEAR = {
+    "c_attn", "c_proj", "c_fc",                      # gpt
+    "q_proj", "k_proj", "v_proj", "o_proj",          # llama attention
+    "gate_proj", "up_proj", "down_proj",             # llama mlp
+    "gate",                                          # mixtral router
+    "w1", "w2", "w3",                                # mixtral experts
+}
+_NORM = {"ln_1", "ln_2", "ln_f", "input_layernorm", "post_attention_layernorm", "norm"}
+_EMBED = {"wte", "wpe", "embed_tokens"}
+_LINEAR_TOP = {"lm_head"}  # top-level Linear modules (untied output head)
+
+
+def torch_key_to_nnx_path(key, tied_lm_head=True):
+    """Map a torch state_dict key to (nnx path tuple, transpose flag).
+
+    `tied_lm_head=True` (GPT-2, model.py:149-151): `lm_head.weight` is an
+    alias of the embedding and has no nnx param → returns (None, False).
+    `tied_lm_head=False` (Llama-3/Mixtral): `lm_head.weight` maps to a real
+    nnx Linear kernel (transposed)."""
+    if key == "lm_head.weight":
+        if tied_lm_head:
+            return None, False
+        return ("lm_head", "kernel"), True
+    parts = key.split(".")
+    if parts[0] in ("transformer", "model"):
+        parts = parts[1:]
+    path = []
+    for p in parts[:-1]:
+        path.append(int(p) if p.isdigit() else p)
+    leaf = parts[-1]
+    owner = path[-1] if path else None
+    if owner in _EMBED:
+        assert leaf == "weight", key
+        path.append("embedding")
+        return tuple(path), False
+    if owner in _NORM:
+        assert leaf in ("weight", "bias"), key
+        path.append("scale" if leaf == "weight" else "bias")
+        return tuple(path), False
+    if owner in _LINEAR:
+        assert leaf in ("weight", "bias"), key
+        path.append("kernel" if leaf == "weight" else "bias")
+        return tuple(path), leaf == "weight"
+    raise KeyError(f"no bridge rule for torch key {key!r}")
+
+
+def nnx_path_to_torch_key(path, model_family="gpt"):
+    """Inverse of torch_key_to_nnx_path. Returns (torch key, transpose)."""
+    parts = list(path)
+    leaf = parts[-1]
+    owner = parts[-2] if len(parts) > 1 else None
+    if leaf == "embedding":
+        parts[-1] = "weight"
+        transpose = False
+    elif leaf == "scale":
+        parts[-1] = "weight"
+        transpose = False
+    elif leaf == "kernel":
+        parts[-1] = "weight"
+        transpose = True
+    elif leaf == "bias":
+        transpose = False
+    else:
+        raise KeyError(f"no bridge rule for nnx path {path!r}")
+    if parts[0] in _LINEAR_TOP:  # lm_head lives at the top level, unprefixed
+        return ".".join(str(p) for p in parts), transpose
+    prefix = "transformer" if model_family == "gpt" else "model"
+    return ".".join(str(p) for p in ([prefix] + parts)), transpose
+
+
+def load_torch_state_dict(model, sd, strict=True, tied_lm_head=True):
+    """Load a torch-layout state_dict (key → numpy array) into an nnx model
+    in place. `sd` values must be numpy/jax arrays (call .numpy() on torch
+    tensors first — this module never imports torch)."""
+    state = nnx.state(model, nnx.Param)
+    flat = {path: v for path, v in state.flat_state()}
+    seen = set()
+    for key, arr in sd.items():
+        path, transpose = torch_key_to_nnx_path(key, tied_lm_head=tied_lm_head)
+        if path is None:
+            continue  # tied weight
+        if path not in flat:
+            if strict:
+                raise KeyError(
+                    f"torch key {key!r} maps to nnx path {path!r} "
+                    f"which does not exist in the model"
+                )
+            continue
+        arr = np.asarray(arr)
+        if transpose:
+            arr = arr.T
+        var = flat[path]
+        expected = var.get_value().shape
+        assert arr.shape == tuple(expected), (
+            f"{key}: shape {arr.shape} != model {tuple(expected)}"
+        )
+        var.set_value(arr.astype(np.asarray(var.get_value()).dtype))
+        seen.add(path)
+    if strict:
+        missing = set(flat) - seen
+        if missing:
+            raise KeyError(f"state_dict missing params for nnx paths: {sorted(missing)}")
+    nnx.update(model, nnx.State.from_flat_path(flat))
+    return model
+
+
+def export_torch_state_dict(model, model_family="gpt", tied_lm_head=True):
+    """Export nnx params as a torch-layout state_dict (key → numpy array).
+    With `tied_lm_head` (GPT-2), re-emit the `lm_head.weight` alias the
+    torch model's state_dict contains; untied families (Llama-3) export
+    their real lm_head kernel through the normal path rules."""
+    state = nnx.state(model, nnx.Param)
+    sd = {}
+    for path, var in state.flat_state():
+        key, transpose = nnx_path_to_torch_key(path, model_family=model_family)
+        arr = np.asarray(var.get_value())
+        sd[key] = arr.T if transpose else arr
+    if tied_lm_head:
+        wte_key = (
+            "transformer.wte.weight" if model_family == "gpt"
+            else "model.embed_tokens.weight"
+        )
+        assert "lm_head.weight" not in sd, "model has an untied lm_head param"
+        sd["lm_head.weight"] = sd[wte_key]
+    return sd

@@ -1,0 +1,9 @@
+"""avenir_tpu.models — flax nnx model zoo (SURVEY.md §1 L3, §2b T1/T9/T10).
+
+Each model mirrors the reference semantics (model.py for GPT-2; public
+Llama-3 / Mixtral architecture for the others) but is written TPU-first:
+params born sharded via partition rules, attention through the ops layer's
+Pallas/XLA dispatch, fp32 master params with configurable compute dtype.
+"""
+
+from avenir_tpu.models.gpt import GPT, GPTConfig

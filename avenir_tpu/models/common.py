@@ -1,0 +1,51 @@
+"""Shared model utilities: loss, dtype resolution, MFU accounting."""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def resolve_dtype(name):
+    return {
+        "float32": jnp.float32,
+        "bfloat16": jnp.bfloat16,
+        "float16": jnp.float16,
+    }[name]
+
+
+def cross_entropy_loss(logits, targets, ignore_index=-1):
+    """Mean token cross-entropy in fp32, skipping `ignore_index` positions —
+    mirrors `F.cross_entropy(..., ignore_index=-1)` in model.py:190-192."""
+    logits = logits.astype(jnp.float32)
+    valid = targets != ignore_index
+    safe_targets = jnp.where(valid, targets, 0)
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, safe_targets)
+    losses = jnp.where(valid, losses, 0.0)
+    return losses.sum() / jnp.maximum(valid.sum(), 1).astype(jnp.float32)
+
+
+def transformer_flops_per_token(n_params, n_layer, n_head, head_dim, seq_len):
+    """6N + 12·L·H·Q·T — the PaLM-appendix accounting used by
+    model.py:273-280 (estimate_mfu), kept identical so MFU numbers from the
+    two backends are comparable."""
+    return 6 * n_params + 12 * n_layer * n_head * head_dim * seq_len
+
+
+def tpu_peak_flops(device=None):
+    """Per-chip bf16 peak FLOP/s for MFU denominators (SURVEY.md §5:
+    'MFU denominators: A100 312 vs TPU v4 275 TFLOP/s')."""
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    table = {
+        "tpu v6": 918e12,   # Trillium
+        "tpu v5p": 459e12,
+        "tpu v5": 197e12,   # v5e ("TPU v5 lite")
+        "tpu v4": 275e12,
+        "tpu v3": 123e12,
+        "tpu v2": 46e12,
+    }
+    for prefix, peak in table.items():
+        if kind.startswith(prefix):
+            return peak
+    return 312e12  # A100 bf16 — keeps CPU-dev MFU numbers comparable to the torch ref

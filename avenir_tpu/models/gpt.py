@@ -1,0 +1,253 @@
+"""GPT-2 in flax nnx — the TPU mirror of the torch reference (SURVEY.md §2b
+T1; BASELINE.json:5 "flax/nnx mirror").
+
+Semantics are pinned to model.py (the torch yardstick) so loss curves
+overlay — that IS the acceptance metric (BASELINE.json:2):
+  - learned positional embeddings added to token embeddings (model.py:181-183)
+  - pre-LayerNorm blocks, eps=1e-5, optional bias (model.py:50-59)
+  - exact (erf) GELU (model.py:116)
+  - weight tying: logits = x @ wte.T, no separate lm_head param
+    (model.py:149-151)
+  - init: normal(0, 0.02) everywhere, residual projections scaled to
+    0.02/sqrt(2·n_layer), zero biases (model.py:153-165)
+  - cross-entropy with ignore_index=-1 (model.py:190-192)
+
+TPU-first deltas (not in the torch file):
+  - master params fp32, compute dtype configurable (bf16 on TPU) — the jax
+    equivalent of autocast: matmuls in bf16, norms and loss in fp32
+  - attention through ops.causal_attention (Pallas flash kernel on TPU)
+  - optional per-block rematerialisation (activation checkpointing)
+
+Weight layout note for the checkpoint bridge (SURVEY.md §3.4): nnx Linear
+kernels are (in, out); torch Linear weights are (out, in) — transposed.
+"""
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from avenir_tpu.models.common import (
+    cross_entropy_loss,
+    resolve_dtype,
+    transformer_flops_per_token,
+)
+from avenir_tpu.ops import causal_attention
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    block_size: int = 1024
+    vocab_size: int = 50304  # GPT-2 50257 padded up to a multiple of 64
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    dropout: float = 0.0
+    bias: bool = True
+    # --- TPU-side knobs (no torch counterpart) ---
+    compute_dtype: str = "float32"  # 'bfloat16' on TPU; params stay fp32
+    attn_impl: str = "auto"  # 'auto' | 'pallas' | 'xla'
+    remat: bool = False  # rematerialize each block on the backward pass
+
+
+class CausalSelfAttention(nnx.Module):
+    def __init__(self, config: GPTConfig, *, rngs: nnx.Rngs):
+        assert config.n_embd % config.n_head == 0
+        cdtype = resolve_dtype(config.compute_dtype)
+        init = nnx.initializers.normal(stddev=0.02)
+        # GPT-2 scaled init on the residual projection (model.py:155-157)
+        proj_init = nnx.initializers.normal(
+            stddev=0.02 / math.sqrt(2 * config.n_layer)
+        )
+        zeros = nnx.initializers.zeros_init()
+        self.c_attn = nnx.Linear(
+            config.n_embd, 3 * config.n_embd, use_bias=config.bias,
+            kernel_init=init, bias_init=zeros,
+            dtype=cdtype, param_dtype=jnp.float32, rngs=rngs,
+        )
+        self.c_proj = nnx.Linear(
+            config.n_embd, config.n_embd, use_bias=config.bias,
+            kernel_init=proj_init, bias_init=zeros,
+            dtype=cdtype, param_dtype=jnp.float32, rngs=rngs,
+        )
+        self.resid_dropout = nnx.Dropout(config.dropout)
+        self.n_head = config.n_head
+        self.dropout = config.dropout
+        self.attn_impl = config.attn_impl
+
+    def __call__(self, x, *, deterministic=True, rngs=None):
+        B, T, C = x.shape
+        qkv = self.c_attn(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        hd = C // self.n_head
+        q = q.reshape(B, T, self.n_head, hd)
+        k = k.reshape(B, T, self.n_head, hd)
+        v = v.reshape(B, T, self.n_head, hd)
+        use_dropout = self.dropout > 0.0 and not deterministic
+        y = causal_attention(
+            q, k, v,
+            dropout_rate=self.dropout, deterministic=deterministic,
+            dropout_rng=rngs.dropout() if use_dropout else None,
+            impl=self.attn_impl,
+        )
+        y = y.reshape(B, T, C)
+        return self.resid_dropout(
+            self.c_proj(y), deterministic=deterministic, rngs=rngs
+        )
+
+
+class MLP(nnx.Module):
+    def __init__(self, config: GPTConfig, *, rngs: nnx.Rngs):
+        cdtype = resolve_dtype(config.compute_dtype)
+        init = nnx.initializers.normal(stddev=0.02)
+        proj_init = nnx.initializers.normal(
+            stddev=0.02 / math.sqrt(2 * config.n_layer)
+        )
+        zeros = nnx.initializers.zeros_init()
+        self.c_fc = nnx.Linear(
+            config.n_embd, 4 * config.n_embd, use_bias=config.bias,
+            kernel_init=init, bias_init=zeros,
+            dtype=cdtype, param_dtype=jnp.float32, rngs=rngs,
+        )
+        self.c_proj = nnx.Linear(
+            4 * config.n_embd, config.n_embd, use_bias=config.bias,
+            kernel_init=proj_init, bias_init=zeros,
+            dtype=cdtype, param_dtype=jnp.float32, rngs=rngs,
+        )
+        self.dropout = nnx.Dropout(config.dropout)
+
+    def __call__(self, x, *, deterministic=True, rngs=None):
+        # exact (erf) GELU, matching torch F.gelu default (model.py:116)
+        x = jax.nn.gelu(self.c_fc(x), approximate=False)
+        return self.dropout(
+            self.c_proj(x), deterministic=deterministic, rngs=rngs
+        )
+
+
+class Block(nnx.Module):
+    def __init__(self, config: GPTConfig, *, rngs: nnx.Rngs):
+        cdtype = resolve_dtype(config.compute_dtype)
+        # LayerNorm computes in fp32 (autocast keeps norms in fp32); output
+        # is cast back to the compute dtype by the next Linear.
+        self.ln_1 = nnx.LayerNorm(
+            config.n_embd, epsilon=1e-5, use_bias=config.bias,
+            dtype=jnp.float32, param_dtype=jnp.float32, rngs=rngs,
+        )
+        self.attn = CausalSelfAttention(config, rngs=rngs)
+        self.ln_2 = nnx.LayerNorm(
+            config.n_embd, epsilon=1e-5, use_bias=config.bias,
+            dtype=jnp.float32, param_dtype=jnp.float32, rngs=rngs,
+        )
+        self.mlp = MLP(config, rngs=rngs)
+        self._cdtype = cdtype
+
+    def __call__(self, x, *, deterministic=True, rngs=None):
+        x = x + self.attn(
+            self.ln_1(x).astype(self._cdtype),
+            deterministic=deterministic, rngs=rngs,
+        )
+        x = x + self.mlp(
+            self.ln_2(x).astype(self._cdtype),
+            deterministic=deterministic, rngs=rngs,
+        )
+        return x
+
+
+class GPT(nnx.Module):
+    def __init__(self, config: GPTConfig, *, rngs: nnx.Rngs):
+        assert config.vocab_size is not None and config.block_size is not None
+        self.config = config
+        init = nnx.initializers.normal(stddev=0.02)
+        cdtype = resolve_dtype(config.compute_dtype)
+        self.wte = nnx.Embed(
+            config.vocab_size, config.n_embd, embedding_init=init,
+            dtype=cdtype, param_dtype=jnp.float32, rngs=rngs,
+        )
+        self.wpe = nnx.Embed(
+            config.block_size, config.n_embd, embedding_init=init,
+            dtype=cdtype, param_dtype=jnp.float32, rngs=rngs,
+        )
+        self.drop = nnx.Dropout(config.dropout)
+        self.h = nnx.List(
+            [Block(config, rngs=rngs) for _ in range(config.n_layer)]
+        )
+        self.ln_f = nnx.LayerNorm(
+            config.n_embd, epsilon=1e-5, use_bias=config.bias,
+            dtype=jnp.float32, param_dtype=jnp.float32, rngs=rngs,
+        )
+        self._cdtype = cdtype
+
+    def __call__(self, idx, targets=None, *, deterministic=True, rngs=None):
+        B, T = idx.shape
+        assert T <= self.config.block_size, (
+            f"sequence length {T} > block_size {self.config.block_size}"
+        )
+        pos = jnp.arange(T, dtype=jnp.int32)
+        x = self.wte(idx) + self.wpe(pos)[None]
+        x = self.drop(x, deterministic=deterministic, rngs=rngs)
+
+        if self.config.remat:
+            assert self.config.dropout == 0.0 or deterministic, (
+                "remat + dropout rng threading not supported; train with dropout=0"
+            )
+            block_fn = nnx.remat(lambda blk, h: blk(h, deterministic=deterministic))
+        else:
+            block_fn = lambda blk, h: blk(
+                h, deterministic=deterministic, rngs=rngs
+            )
+        for block in self.h:
+            x = block_fn(block, x)
+        x = self.ln_f(x).astype(self._cdtype)
+
+        if targets is not None:
+            logits = self.wte.attend(x)  # tied weights (model.py:149-151)
+            loss = cross_entropy_loss(logits, targets, ignore_index=-1)
+        else:
+            logits = self.wte.attend(x[:, -1:, :])
+            loss = None
+        return logits, loss
+
+    # ----- parity utilities (mirror model.py) -----
+
+    def get_num_params(self, non_embedding=True):
+        """Param count. The torch side counts the tied wte/lm_head tensor
+        once (shared storage), so the totals match (model.py:167-171)."""
+        leaves = jax.tree.leaves(nnx.state(self, nnx.Param))
+        n = sum(x.size for x in leaves)
+        if non_embedding:
+            n -= self.wpe.embedding.get_value().size
+        return n
+
+    def crop_block_size(self, block_size):
+        import dataclasses
+
+        assert block_size <= self.config.block_size
+        self.wpe.embedding.set_value(self.wpe.embedding.get_value()[:block_size])
+        self.wpe.num_embeddings = block_size
+        self.config = dataclasses.replace(self.config, block_size=block_size)
+
+    def estimate_mfu(self, fwdbwd_per_iter, dt, peak_flops=312e12):
+        cfg = self.config
+        fpt = transformer_flops_per_token(
+            self.get_num_params(), cfg.n_layer, cfg.n_head,
+            cfg.n_embd // cfg.n_head, cfg.block_size,
+        )
+        return (fpt * cfg.block_size * fwdbwd_per_iter / dt) / peak_flops
+
+    def generate(self, rng, idx, max_new_tokens, temperature=1.0, top_k=None):
+        """Autoregressive sampling, recompute-full-prefix (parity with
+        model.py:282-297). For the jitted KV-cache decoder see
+        avenir_tpu/infer/decode.py."""
+        for _ in range(max_new_tokens):
+            idx_cond = idx[:, -self.config.block_size:]
+            logits, _ = self(idx_cond)
+            logits = logits[:, -1, :].astype(jnp.float32) / temperature
+            if top_k is not None:
+                kth = jnp.sort(logits, axis=-1)[:, -min(top_k, logits.shape[-1])]
+                logits = jnp.where(logits < kth[:, None], -jnp.inf, logits)
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(sub, logits, axis=-1)
+            idx = jnp.concatenate([idx, nxt[:, None]], axis=1)
+        return idx

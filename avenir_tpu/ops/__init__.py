@@ -1,0 +1,13 @@
+"""avenir_tpu.ops — the kernel layer (SURVEY.md §1 L1, §2b T6).
+
+Every op exposes a single public function that dispatches between a
+Pallas/Mosaic TPU kernel and a pure-jnp reference implementation. The jnp
+path is the semantic spec (used on CPU, in tests, and as the Pallas
+correctness oracle); the Pallas path is the TPU hot path mandated by
+BASELINE.json:5 ("fused attention + AdamW hot path as Pallas kernels").
+"""
+
+from avenir_tpu.ops.attention import causal_attention
+from avenir_tpu.ops.rmsnorm import rmsnorm
+from avenir_tpu.ops.rope import apply_rope, rope_frequencies
+from avenir_tpu.ops.swiglu import swiglu

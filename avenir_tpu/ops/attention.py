@@ -1,0 +1,99 @@
+"""Causal self-attention op with Pallas/XLA dispatch (SURVEY.md §2b T6).
+
+Public entry: `causal_attention(q, k, v, ...)` in (B, T, H, D) layout.
+
+Implementations:
+  - "xla": pure-jnp reference (fp32 softmax, fp32 matmul accumulation) —
+    the semantic spec, matching torch `F.scaled_dot_product_attention`
+    (model.py:91-97) at fp32. Runs anywhere; XLA fuses it decently.
+  - "pallas": blockwise online-softmax flash attention compiled by Mosaic
+    for TPU (avenir_tpu/ops/pallas/flash_attention.py).
+  - "auto": pallas on TPU when shapes allow, else xla.
+
+Dropout on attention probabilities is only supported on the xla path
+(flash kernels and prob-dropout don't mix; the reference trains with
+dropout=0.0 in every ladder config, BASELINE.json:7-11).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float("-inf")
+
+
+def _on_tpu() -> bool:
+    """True when jit traces will lower to TPU. Safe to call at trace time
+    (reads the default backend, not the current trace)."""
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+def causal_attention_reference(q, k, v, *, dropout_rate=0.0, deterministic=True,
+                               dropout_rng=None, segment_ids=None):
+    """Pure-jnp causal attention, (B, T, H, D) layout.
+
+    Softmax and score accumulation in fp32 regardless of input dtype
+    (bf16-safe); output cast back to q.dtype. `segment_ids` (B, T) optional:
+    positions may only attend within their own segment (packed sequences).
+    """
+    B, T, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+    if segment_ids is not None:
+        seg = segment_ids[:, :, None] == segment_ids[:, None, :]  # (B, T, T)
+        mask = mask[None, :, :] & seg
+        mask = mask[:, None, :, :]  # (B, 1, T, T)
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0 and not deterministic:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = probs * keep / (1.0 - dropout_rate)
+    probs = probs.astype(v.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def causal_attention(q, k, v, *, dropout_rate=0.0, deterministic=True,
+                     dropout_rng=None, impl="auto", segment_ids=None):
+    """Causal multi-head attention. q, k, v: (B, T, H, D).
+
+    K/V may have fewer heads than Q (GQA): H_kv must divide H; K/V heads are
+    repeated to match (the xla path repeats explicitly; the pallas kernel
+    indexes the shared head).
+    """
+    if q.shape[2] != k.shape[2]:
+        assert q.shape[2] % k.shape[2] == 0, (
+            f"GQA requires n_head % n_kv_head == 0, got {q.shape[2]} % {k.shape[2]}"
+        )
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    use_dropout = dropout_rate > 0.0 and not deterministic
+    if impl == "auto":
+        if _on_tpu() and not use_dropout and segment_ids is None:
+            try:  # fall back gracefully while/where the kernel is unavailable
+                from avenir_tpu.ops.pallas import flash_attention  # noqa: F401
+
+                impl = "pallas"
+            except ImportError:
+                impl = "xla"
+        else:
+            impl = "xla"
+    if impl == "pallas":
+        assert not use_dropout, "pallas flash attention does not support attn dropout"
+        from avenir_tpu.ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    assert impl == "xla", f"unknown attention impl {impl!r}"
+    return causal_attention_reference(
+        q, k, v, dropout_rate=dropout_rate, deterministic=deterministic,
+        dropout_rng=dropout_rng, segment_ids=segment_ids,
+    )

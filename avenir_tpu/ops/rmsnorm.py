@@ -1,0 +1,37 @@
+"""RMSNorm op (SURVEY.md §2b T6, for Llama-3 — BASELINE.json:10).
+
+Matches torch's `nn.RMSNorm` / Llama reference semantics: normalize in
+fp32, scale by a learned weight, cast back to input dtype.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def rmsnorm_reference(x, weight, eps=1e-5):
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rmsnorm(x, weight, eps=1e-5, impl="auto"):
+    """Root-mean-square layer norm over the last axis."""
+    if impl == "auto":
+        from avenir_tpu.ops.attention import _on_tpu
+
+        if _on_tpu():
+            try:
+                from avenir_tpu.ops.pallas import rmsnorm as _  # noqa: F401
+
+                impl = "pallas"
+            except ImportError:
+                impl = "xla"
+        else:
+            impl = "xla"
+    if impl == "pallas":
+        from avenir_tpu.ops.pallas.rmsnorm import rmsnorm_pallas
+
+        return rmsnorm_pallas(x, weight, eps=eps)
+    return rmsnorm_reference(x, weight, eps=eps)

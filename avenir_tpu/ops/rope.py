@@ -1,0 +1,41 @@
+"""Rotary position embeddings (SURVEY.md §2b T6, for Llama-3 —
+BASELINE.json:10).
+
+Llama-style "split halves" RoPE: the head dim is split into two halves that
+form the (real, imag) parts of complex rotation. This matches the HF/Llama
+reference convention (`rotate_half`), which the checkpoint bridge relies on.
+"""
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_t: int, theta: float = 10000.0,
+                     dtype=jnp.float32):
+    """Precompute (cos, sin) tables of shape (max_t, head_dim // 2)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_t, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # (max_t, head_dim/2)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope_reference(x, cos, sin, positions=None):
+    """x: (B, T, H, D); cos/sin: (max_t, D/2). Rotates in fp32."""
+    B, T, H, D = x.shape
+    if positions is None:
+        c = cos[:T][None, :, None, :]  # (1, T, 1, D/2)
+        s = sin[:T][None, :, None, :]
+    else:
+        c = cos[positions][:, :, None, :]  # positions: (B, T)
+        s = sin[positions][:, :, None, :]
+    orig = x.dtype
+    x = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(orig)
+
+
+def apply_rope(x, cos, sin, positions=None, impl="xla"):
+    """Apply rotary embeddings. The op is elementwise and XLA fuses it into
+    the surrounding matmuls, so the pallas variant only pays off inside the
+    fused attention kernel; standalone use takes the xla path."""
+    return apply_rope_reference(x, cos, sin, positions=positions)

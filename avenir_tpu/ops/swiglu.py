@@ -1,0 +1,17 @@
+"""SwiGLU activation (SURVEY.md §2b T6, for Llama-3 — BASELINE.json:10).
+
+swiglu(gate, up) = silu(gate) * up. Elementwise — XLA fuses it into the
+adjacent matmuls on its own; the explicit op exists so the model code names
+the semantic and the pallas fused-MLP variant can slot in behind it.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def swiglu_reference(gate, up):
+    return jax.nn.silu(gate.astype(jnp.float32)).astype(gate.dtype) * up
+
+
+def swiglu(gate, up, impl="xla"):
+    return swiglu_reference(gate, up)

@@ -10,6 +10,13 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The axon sandbox sets JAX_PLATFORMS=axon and imports jax from a
+# sitecustomize before this conftest runs, so the env var alone is not
+# enough — pin the platform through the live config too.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 # repo root on sys.path so `import model`, `import train` etc. work from tests/
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

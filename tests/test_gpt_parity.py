@@ -1,0 +1,117 @@
+"""Backend equivalence tests (SURVEY.md §4 "Unit: model parity"): torch GPT
+(model.py) vs nnx GPT (avenir_tpu/models/gpt.py) must produce identical
+logits/loss on identical weights — the loss curve IS the acceptance metric
+(BASELINE.json:2)."""
+
+import numpy as np
+import pytest
+import torch
+
+from model import GPT as TorchGPT, GPTConfig as TorchGPTConfig
+
+from flax import nnx
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.checkpoint.bridge import (
+    export_torch_state_dict,
+    load_torch_state_dict,
+)
+from avenir_tpu.models.gpt import GPT, GPTConfig
+
+TINY = dict(block_size=16, vocab_size=65, n_layer=2, n_head=2, n_embd=32,
+            dropout=0.0)
+
+
+def _torch_model(bias):
+    torch.manual_seed(0)
+    m = TorchGPT(TorchGPTConfig(bias=bias, **TINY))
+    m.eval()
+    return m
+
+
+def _nnx_model(bias):
+    return GPT(GPTConfig(bias=bias, **TINY), rngs=nnx.Rngs(0))
+
+
+def _numpy_sd(torch_model):
+    return {k: v.detach().numpy() for k, v in torch_model.state_dict().items()}
+
+
+@pytest.mark.parametrize("bias", [True, False])
+def test_logits_and_loss_parity(bias):
+    tm = _torch_model(bias)
+    jm = _nnx_model(bias)
+    load_torch_state_dict(jm, _numpy_sd(tm))
+
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, TINY["vocab_size"], (3, TINY["block_size"]))
+    tgt = rng.integers(0, TINY["vocab_size"], (3, TINY["block_size"]))
+    tgt[0, :4] = -1  # exercise ignore_index
+
+    with torch.no_grad():
+        t_logits, t_loss = tm(torch.from_numpy(idx), torch.from_numpy(tgt))
+    j_logits, j_loss = jm(jnp.asarray(idx), jnp.asarray(tgt))
+
+    np.testing.assert_allclose(
+        np.asarray(j_logits), t_logits.numpy(), atol=2e-5, rtol=2e-5
+    )
+    np.testing.assert_allclose(float(j_loss), float(t_loss), atol=1e-5, rtol=1e-5)
+
+
+def test_inference_path_last_position_only():
+    tm = _torch_model(True)
+    jm = _nnx_model(True)
+    load_torch_state_dict(jm, _numpy_sd(tm))
+    idx = np.arange(8, dtype=np.int64)[None, :] % TINY["vocab_size"]
+    with torch.no_grad():
+        t_logits, _ = tm(torch.from_numpy(idx))
+    j_logits, j_loss = jm(jnp.asarray(idx))
+    assert j_loss is None
+    assert j_logits.shape == (1, 1, TINY["vocab_size"])
+    np.testing.assert_allclose(
+        np.asarray(j_logits), t_logits.numpy(), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_export_round_trip():
+    """nnx → torch state_dict → fresh torch model gives identical logits."""
+    jm = _nnx_model(True)
+    sd = export_torch_state_dict(jm)
+    tm = _torch_model(True)
+    tm.load_state_dict({k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()})
+    tm.eval()
+    idx = np.arange(12, dtype=np.int64)[None, :] % TINY["vocab_size"]
+    tgt = np.roll(idx, -1, axis=1)
+    with torch.no_grad():
+        t_logits, t_loss = tm(torch.from_numpy(idx), torch.from_numpy(tgt))
+    j_logits, j_loss = jm(jnp.asarray(idx), jnp.asarray(tgt))
+    np.testing.assert_allclose(
+        np.asarray(j_logits), t_logits.numpy(), atol=2e-5, rtol=2e-5
+    )
+    np.testing.assert_allclose(float(j_loss), float(t_loss), atol=1e-5, rtol=1e-5)
+
+
+def test_param_count_matches_torch():
+    tm = _torch_model(True)
+    jm = _nnx_model(True)
+    assert jm.get_num_params() == tm.get_num_params()
+    assert jm.get_num_params(False) == tm.get_num_params(False)
+
+
+def test_grad_flow_through_tied_embedding():
+    """Weight tying must route lm_head grads into wte, like torch."""
+    jm = _nnx_model(True)
+    graphdef, params = nnx.split(jm, nnx.Param)
+
+    idx = jnp.zeros((2, 8), dtype=jnp.int32)
+    tgt = jnp.ones((2, 8), dtype=jnp.int32)
+
+    def loss_fn(p):
+        m = nnx.merge(graphdef, p)
+        _, loss = m(idx, tgt)
+        return loss
+
+    grads = jax.grad(loss_fn)(params)
+    g_wte = grads["wte"]["embedding"].get_value()
+    assert np.abs(np.asarray(g_wte)).sum() > 0
