@@ -70,7 +70,6 @@ def nnx_path_to_torch_key(path, model_family="gpt"):
     """Inverse of torch_key_to_nnx_path. Returns (torch key, transpose)."""
     parts = list(path)
     leaf = parts[-1]
-    owner = parts[-2] if len(parts) > 1 else None
     if leaf == "embedding":
         parts[-1] = "weight"
         transpose = False
@@ -88,6 +87,12 @@ def nnx_path_to_torch_key(path, model_family="gpt"):
         return ".".join(str(p) for p in parts), transpose
     prefix = "transformer" if model_family == "gpt" else "model"
     return ".".join(str(p) for p in ([prefix] + parts)), transpose
+
+
+def _as_state(model_or_state):
+    if isinstance(model_or_state, nnx.Module):
+        return nnx.state(model_or_state, nnx.Param)
+    return model_or_state
 
 
 def load_torch_state_dict(model, sd, strict=True, tied_lm_head=True):
@@ -130,8 +135,11 @@ def export_torch_state_dict(model, model_family="gpt", tied_lm_head=True):
     """Export nnx params as a torch-layout state_dict (key → numpy array).
     With `tied_lm_head` (GPT-2), re-emit the `lm_head.weight` alias the
     torch model's state_dict contains; untied families (Llama-3) export
-    their real lm_head kernel through the normal path rules."""
-    state = nnx.state(model, nnx.Param)
+    their real lm_head kernel through the normal path rules.
+
+    `model` may be an nnx Module or a Param State (e.g. gathered host
+    params, or an optimizer-moment tree with the same structure)."""
+    state = _as_state(model)
     sd = {}
     for path, var in state.flat_state():
         key, transpose = nnx_path_to_torch_key(path, model_family=model_family)

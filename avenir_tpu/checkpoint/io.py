@@ -1,0 +1,216 @@
+"""Full training-state checkpointing in the torch ckpt.pt schema
+(SURVEY.md §3.4): {model, optimizer, model_args, iter_num, best_val_loss,
+config}. A ckpt.pt written here resumes under the torch trainer and vice
+versa — including optimizer moments, so resume is bit-honest, not just
+weights (train.py:272-281 defines the schema; model.py:255-271 defines the
+torch AdamW param grouping we must reproduce).
+"""
+
+import collections
+import os
+
+import jax
+import numpy as np
+from flax import nnx
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from avenir_tpu.checkpoint.bridge import (
+    export_torch_state_dict,
+    torch_key_to_nnx_path,
+)
+from avenir_tpu.checkpoint.torch_pt import load_pt, save_pt
+
+
+def torch_param_order(sd, model_family="gpt"):
+    """Reproduce torch `named_parameters()` order (module insertion order,
+    tied lm_head deduplicated) for the reference GPT (model.py:133-151).
+    Needed because torch optimizer state is keyed by param *index*."""
+    assert model_family == "gpt", "optimizer bridge currently covers gpt"
+    keys = ["transformer.wte.weight", "transformer.wpe.weight"]
+    i = 0
+    while f"transformer.h.{i}.ln_1.weight" in sd:
+        b = f"transformer.h.{i}."
+        keys += [
+            b + "ln_1.weight", b + "ln_1.bias",
+            b + "attn.c_attn.weight", b + "attn.c_attn.bias",
+            b + "attn.c_proj.weight", b + "attn.c_proj.bias",
+            b + "ln_2.weight", b + "ln_2.bias",
+            b + "mlp.c_fc.weight", b + "mlp.c_fc.bias",
+            b + "mlp.c_proj.weight", b + "mlp.c_proj.bias",
+        ]
+        i += 1
+    keys += ["transformer.ln_f.weight", "transformer.ln_f.bias"]
+    return [k for k in keys if k in sd]
+
+
+def _adam_groups(order, sd):
+    """torch configure_optimizers grouping: decay = ndim>=2 first, then
+    nodecay; param indices are global across groups (model.py:258-264)."""
+    decay = [k for k in order if sd[k].ndim >= 2]
+    nodecay = [k for k in order if sd[k].ndim < 2]
+    return decay, nodecay
+
+
+def _find_adam_state(opt_state):
+    """Locate the ScaleByAdamState node inside an optax chain state."""
+    found = []
+
+    def walk(node):
+        if hasattr(node, "mu") and hasattr(node, "nu") and hasattr(node, "count"):
+            found.append(node)
+            return
+        if isinstance(node, tuple):
+            for c in node:
+                walk(c)
+
+    walk(opt_state)
+    assert len(found) == 1, f"expected exactly one adam state, found {len(found)}"
+    return found[0]
+
+
+def _replace_adam_state(opt_state, new_adam):
+    def walk(node):
+        if hasattr(node, "mu") and hasattr(node, "nu") and hasattr(node, "count"):
+            return new_adam
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            return type(node)(*(walk(c) for c in node))
+        if isinstance(node, tuple):
+            return tuple(walk(c) for c in node)
+        return node
+
+    return walk(opt_state)
+
+
+def gather_to_host(tree):
+    """Pull (possibly sharded) jax arrays to replicated host numpy. On a
+    multi-host mesh every process participates in the all-gather; the
+    coordinator alone writes the file (SURVEY.md §3.4 ⟨proc⟩ note)."""
+    def gather(x):
+        if isinstance(x, jax.Array) and hasattr(x, "sharding") and not x.is_fully_addressable:
+            mesh = x.sharding.mesh
+            x = jax.jit(lambda a: a, out_shardings=NamedSharding(mesh, P()))(x)
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree.map(gather, tree)
+
+
+def save_checkpoint(out_dir, *, params, opt_state, hyper, model_args,
+                    iter_num, best_val_loss, config, model_family="gpt"):
+    """Write out_dir/ckpt.pt in the torch schema. `params` is the nnx Param
+    State; `opt_state` the optax state; `hyper` carries the torch
+    param_group hyperparams (lr, betas, eps, weight_decay)."""
+    params_host = gather_to_host(params)
+    sd = export_torch_state_dict(params_host, model_family=model_family)
+    order = torch_param_order(sd, model_family)
+    decay, nodecay = _adam_groups(order, sd)
+
+    adam = _find_adam_state(gather_to_host(opt_state))
+    mu_sd = export_torch_state_dict(adam.mu, model_family=model_family,
+                                    tied_lm_head=False)
+    nu_sd = export_torch_state_dict(adam.nu, model_family=model_family,
+                                    tied_lm_head=False)
+    step = float(np.asarray(adam.count))
+    opt_sd = {
+        "state": {
+            i: {
+                "step": np.asarray(step, np.float32),
+                "exp_avg": mu_sd[k],
+                "exp_avg_sq": nu_sd[k],
+            }
+            for i, k in enumerate(decay + nodecay)
+        },
+        "param_groups": [
+            {
+                "lr": hyper["lr"], "betas": tuple(hyper["betas"]),
+                "eps": hyper["eps"], "weight_decay": wd,
+                "amsgrad": False, "maximize": False, "foreach": None,
+                "capturable": False, "differentiable": False, "fused": None,
+                "decoupled_weight_decay": True,
+                "params": list(range(start, start + len(group))),
+            }
+            for group, wd, start in (
+                (decay, hyper["weight_decay"], 0),
+                (nodecay, 0.0, len(decay)),
+            )
+        ],
+    }
+    ckpt = {
+        "model": collections.OrderedDict((k, sd[k]) for k in list(order) + ["lm_head.weight"]),
+        "optimizer": opt_sd,
+        "model_args": dict(model_args),
+        "iter_num": int(iter_num),
+        "best_val_loss": float(best_val_loss),
+        "config": dict(config),
+    }
+    if jax.process_index() == 0:
+        os.makedirs(out_dir, exist_ok=True)
+        save_pt(ckpt, os.path.join(out_dir, "ckpt.pt"))
+
+
+def load_checkpoint(out_dir):
+    """Read out_dir/ckpt.pt (either backend's) into host numpy. Returns the
+    raw dict; use restore_params/restore_opt_state to place on device."""
+    return load_pt(os.path.join(out_dir, "ckpt.pt"))
+
+
+def _strip_compile_prefix(sd):
+    pre = "_orig_mod."
+    return {k[len(pre):] if k.startswith(pre) else k: v for k, v in sd.items()}
+
+
+def restore_params(ckpt, abs_state, shardings):
+    """Map ckpt['model'] (torch layout) onto the param State, placing each
+    leaf with its NamedSharding (sharded host→device transfer)."""
+    sd = _strip_compile_prefix(dict(ckpt["model"]))
+    flat = {p: v for p, v in abs_state.flat_state()}
+    out = {}
+    for key, arr in sd.items():
+        path, transpose = torch_key_to_nnx_path(key)
+        if path is None:
+            continue
+        assert path in flat, f"checkpoint key {key} → {path} not in model"
+        a = np.asarray(arr)
+        if transpose:
+            a = np.ascontiguousarray(a.T)
+        var = flat[path]
+        a = a.astype(var.get_value().dtype)
+        out[path] = var.replace(jax.device_put(a, shardings[path]))
+    missing = set(flat) - set(out)
+    assert not missing, f"checkpoint missing params: {sorted(missing)}"
+    return nnx.State.from_flat_path(out)
+
+
+def restore_opt_state(ckpt, opt_state, params, param_shardings):
+    """Rebuild the optax adam moments from torch optimizer state (indexed
+    by param position) and splice them into a freshly init'd opt_state."""
+    sd = _strip_compile_prefix(dict(ckpt["model"]))
+    order = torch_param_order(sd)
+    decay, nodecay = _adam_groups(order, sd)
+    indexed = decay + nodecay
+    tstate = ckpt["optimizer"]["state"]
+
+    flat_shard = {p: s for p, s in param_shardings.items()}
+    mu_flat, nu_flat = {}, {}
+    step = 0.0
+    for i, key in enumerate(indexed):
+        ent = tstate[i]
+        path, transpose = torch_key_to_nnx_path(key)
+        step = float(np.asarray(ent["step"]))
+        for src, dst in (("exp_avg", mu_flat), ("exp_avg_sq", nu_flat)):
+            a = np.asarray(ent[src], dtype=np.float32)
+            if transpose:
+                a = np.ascontiguousarray(a.T)
+            dst[path] = jax.device_put(a, flat_shard[path])
+
+    pflat = {p: v for p, v in params.flat_state()}
+    mu = nnx.State.from_flat_path(
+        {p: pflat[p].replace(mu_flat[p]) for p in pflat}
+    )
+    nu = nnx.State.from_flat_path(
+        {p: pflat[p].replace(nu_flat[p]) for p in pflat}
+    )
+    adam = _find_adam_state(opt_state)
+    new_adam = adam._replace(
+        count=np.asarray(int(step), np.int32), mu=mu, nu=nu
+    )
+    return _replace_adam_state(opt_state, new_adam)

@@ -1,0 +1,300 @@
+"""Read/write torch's `.pt` zipfile container in pure Python — no torch
+import (SURVEY.md §2b T7, call stack §3.4; BASELINE.json:5 "same ...
+checkpoint format").
+
+Format (torch's _use_new_zipfile_serialization, torch >= 1.6):
+  <stem>/data.pkl   pickle of the object; tensors appear as
+                    REDUCE(torch._utils._rebuild_tensor_v2,
+                           (BINPERSID(('storage', <StorageClass>, key,
+                                       location, numel)),
+                            offset, size, stride, requires_grad, hooks))
+  <stem>/data/<key> raw little-endian storage bytes
+  <stem>/version    serialization format version ("3")
+  <stem>/byteorder  "little" (torch >= 2.1)
+
+Reading uses the stdlib Unpickler with `find_class`/`persistent_load`
+overridden, so arbitrary torch internals never execute — unknown globals
+fail loud. Writing uses a hand-rolled protocol-2 pickler: emitting GLOBAL
+opcodes by hand is what lets us reference `torch.FloatStorage` etc. without
+torch being importable (stdlib pickle verifies globals against live
+modules; we must not fake a `torch` module in sys.modules on a pod where
+real code may probe for torch).
+
+Tensors materialize as numpy arrays (bfloat16 via ml_dtypes). Shared
+storages (tied weights) round-trip: arrays that share a base get one
+storage entry on write, and views of one storage share memory on read
+until copied.
+"""
+
+import collections
+import io
+import pickle
+import struct
+import zipfile
+
+import ml_dtypes
+import numpy as np
+
+BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+
+# torch legacy storage class name ↔ numpy dtype
+_STORAGE_TO_DTYPE = {
+    "DoubleStorage": np.dtype("<f8"),
+    "FloatStorage": np.dtype("<f4"),
+    "HalfStorage": np.dtype("<f2"),
+    "BFloat16Storage": BFLOAT16,
+    "LongStorage": np.dtype("<i8"),
+    "IntStorage": np.dtype("<i4"),
+    "ShortStorage": np.dtype("<i2"),
+    "CharStorage": np.dtype("i1"),
+    "ByteStorage": np.dtype("u1"),
+    "BoolStorage": np.dtype("?"),
+}
+_DTYPE_TO_STORAGE = {v: k for k, v in _STORAGE_TO_DTYPE.items()}
+
+
+# ---------------------------------------------------------------------------
+# reading
+# ---------------------------------------------------------------------------
+
+class _StorageType:
+    def __init__(self, name):
+        self.name = name
+
+
+def _rebuild_tensor_v2(storage, offset, size, stride, requires_grad,
+                       backward_hooks, metadata=None):
+    """Reconstruct a tensor as a numpy array from a flat storage array."""
+    itemsize = storage.dtype.itemsize
+    byte_strides = tuple(s * itemsize for s in stride)
+    return np.lib.stride_tricks.as_strided(
+        storage[offset:], shape=tuple(size), strides=byte_strides, writeable=False
+    )
+
+
+class _Unpickler(pickle.Unpickler):
+    def __init__(self, file, storage_loader):
+        super().__init__(file, encoding="utf-8")
+        self._load_storage = storage_loader
+
+    def find_class(self, module, name):
+        if module == "torch._utils" and name in (
+            "_rebuild_tensor_v2", "_rebuild_tensor"
+        ):
+            return _rebuild_tensor_v2
+        if module == "torch" and name in _STORAGE_TO_DTYPE:
+            return _StorageType(name)
+        if module == "torch" and name == "Size":
+            return tuple
+        if module == "collections" and name == "OrderedDict":
+            return collections.OrderedDict
+        if module == "builtins":
+            import builtins
+
+            return getattr(builtins, name)
+        raise pickle.UnpicklingError(
+            f"torch_pt reader does not allow global {module}.{name} — "
+            "extend the allowlist if this checkpoint is trusted"
+        )
+
+    def persistent_load(self, pid):
+        assert isinstance(pid, tuple) and pid[0] == "storage", pid
+        _, storage_type, key, _location, _numel = pid
+        dtype = _STORAGE_TO_DTYPE[storage_type.name]
+        return self._load_storage(str(key), dtype)
+
+
+def load_pt(path_or_file):
+    """Load a torch-format .pt file. Returns the object with every tensor
+    as a numpy array (copies — safe after the zip closes)."""
+    with zipfile.ZipFile(path_or_file, "r") as zf:
+        names = zf.namelist()
+        pkl_name = next(n for n in names if n.endswith("/data.pkl"))
+        prefix = pkl_name[: -len("data.pkl")]
+        cache = {}
+
+        def load_storage(key, dtype):
+            if key not in cache:
+                raw = zf.read(f"{prefix}data/{key}")
+                cache[key] = np.frombuffer(raw, dtype=dtype)
+            return cache[key]
+
+        with zf.open(pkl_name) as f:
+            obj = _Unpickler(io.BytesIO(f.read()), load_storage).load()
+    # as_strided views alias the storage buffers; copy to own the memory
+    return _copy_arrays(obj)
+
+
+def _copy_arrays(obj):
+    if isinstance(obj, np.ndarray):
+        return np.ascontiguousarray(obj)
+    if isinstance(obj, collections.OrderedDict):
+        return collections.OrderedDict(
+            (k, _copy_arrays(v)) for k, v in obj.items()
+        )
+    if isinstance(obj, dict):
+        return {k: _copy_arrays(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_copy_arrays(v) for v in obj)
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# writing — minimal protocol-2 pickler
+# ---------------------------------------------------------------------------
+
+class _MiniPickler:
+    """Hand-rolled pickler for the checkpoint object tree: dict/OrderedDict,
+    list, tuple, str, bool, int, float, None, and numpy arrays (emitted as
+    torch tensors). Nothing else — fail loud on surprises."""
+
+    def __init__(self, out, storages):
+        self.out = out
+        self.storages = storages  # id(base_array) -> (key, base_array)
+
+    def w(self, b):
+        self.out.write(b)
+
+    def global_(self, module, name):
+        self.w(b"c" + module.encode() + b"\n" + name.encode() + b"\n")
+
+    def save(self, obj):
+        if obj is None:
+            self.w(b"N")
+        elif obj is True:
+            self.w(b"\x88")
+        elif obj is False:
+            self.w(b"\x89")
+        elif isinstance(obj, (int, np.integer)):
+            self.save_int(int(obj))
+        elif isinstance(obj, (float, np.floating)):
+            self.w(b"G" + struct.pack(">d", float(obj)))
+        elif isinstance(obj, str):
+            raw = obj.encode("utf-8")
+            self.w(b"X" + struct.pack("<I", len(raw)) + raw)
+        elif isinstance(obj, np.ndarray):
+            self.save_tensor(obj)
+        elif isinstance(obj, (dict, collections.OrderedDict)):
+            self.save_dict(obj)
+        elif isinstance(obj, list):
+            self.w(b"]")
+            if obj:
+                self.w(b"(")
+                for v in obj:
+                    self.save(v)
+                self.w(b"e")
+        elif isinstance(obj, tuple):
+            self.save_tuple(obj)
+        else:
+            raise TypeError(
+                f"torch_pt writer cannot serialize {type(obj).__name__!r}"
+            )
+
+    def save_int(self, v):
+        if 0 <= v < 256:
+            self.w(b"K" + bytes([v]))
+        elif 0 <= v < 65536:
+            self.w(b"M" + struct.pack("<H", v))
+        elif -(2 ** 31) <= v < 2 ** 31:
+            self.w(b"J" + struct.pack("<i", v))
+        else:
+            enc = v.to_bytes((v.bit_length() + 8) // 8, "little", signed=True)
+            self.w(b"\x8a" + bytes([len(enc)]) + enc)
+
+    def save_tuple(self, obj):
+        if len(obj) == 0:
+            self.w(b")")
+            return
+        if len(obj) <= 3:
+            for v in obj:
+                self.save(v)
+            self.w({1: b"\x85", 2: b"\x86", 3: b"\x87"}[len(obj)])
+            return
+        self.w(b"(")
+        for v in obj:
+            self.save(v)
+        self.w(b"t")
+
+    def save_dict(self, obj):
+        if isinstance(obj, collections.OrderedDict):
+            # torch state_dicts are OrderedDicts; keep the type faithful
+            self.global_("collections", "OrderedDict")
+            self.w(b")")  # empty args tuple
+            self.w(b"R")
+        else:
+            self.w(b"}")
+        if obj:
+            self.w(b"(")
+            for k, v in obj.items():
+                self.save(k)
+                self.save(v)
+            self.w(b"u")
+
+    def save_tensor(self, arr):
+        """Emit REDUCE(torch._utils._rebuild_tensor_v2, (storage, offset,
+        size, stride, requires_grad, hooks)) with the storage referenced by
+        persistent id. Storage dedup is by array identity, so tied weights
+        (the bridge exports the SAME numpy object under both keys) share one
+        storage entry exactly like torch's shared tensors."""
+        lookup = BFLOAT16 if arr.dtype == BFLOAT16 else np.dtype(arr.dtype.str.replace(">", "<"))
+        if lookup not in _DTYPE_TO_STORAGE:
+            raise TypeError(f"no torch storage type for numpy dtype {arr.dtype}")
+        sid = id(arr)
+        if sid not in self.storages:
+            self.storages[sid] = (str(len(self.storages)), arr)
+        key, _ = self.storages[sid]
+
+        self.global_("torch._utils", "_rebuild_tensor_v2")
+        self.w(b"(")  # MARK for the args tuple
+        # arg 1: the storage, via persistent id
+        self.w(b"(")
+        self.save("storage")
+        self.global_("torch", _DTYPE_TO_STORAGE[lookup])
+        self.save(key)
+        self.save("cpu")
+        self.save_int(int(arr.size))
+        self.w(b"t")
+        self.w(b"Q")
+        # args 2..6
+        self.save_int(0)
+        self.save_tuple(tuple(int(s) for s in arr.shape))
+        contiguous_stride = []
+        acc = 1
+        for dim in reversed(arr.shape):
+            contiguous_stride.append(acc)
+            acc *= dim
+        self.save_tuple(tuple(reversed(contiguous_stride)))
+        self.w(b"\x89")  # requires_grad=False
+        self.global_("collections", "OrderedDict")
+        self.w(b")")
+        self.w(b"R")
+        self.w(b"t")  # close args tuple
+        self.w(b"R")  # REDUCE -> tensor
+
+
+def _pickle_checkpoint(obj, storages):
+    out = io.BytesIO()
+    p = _MiniPickler(out, storages)
+    out.write(b"\x80\x02")
+    p.save(obj)
+    out.write(b".")
+    return out.getvalue()
+
+
+def save_pt(obj, path, stem="archive"):
+    """Write `obj` (dicts/lists/scalars/str/numpy arrays) as a torch-format
+    .pt that real `torch.load` accepts. Arrays become CPU tensors."""
+    storages = {}
+    pkl = _pickle_checkpoint(obj, storages)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as zf:
+        zf.writestr(f"{stem}/data.pkl", pkl)
+        zf.writestr(f"{stem}/byteorder", "little")
+        for key, arr in storages.values():
+            data = np.ascontiguousarray(arr)
+            if data.dtype == BFLOAT16:
+                raw = data.tobytes()
+            else:
+                raw = data.astype(data.dtype.newbyteorder("<"), copy=False).tobytes()
+            zf.writestr(f"{stem}/data/{key}", raw)
+        zf.writestr(f"{stem}/version", "3\n")

@@ -1,0 +1,1 @@
+from avenir_tpu.data.loader import DataLoader

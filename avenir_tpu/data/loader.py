@@ -1,0 +1,67 @@
+"""Multi-host memmap data loader (SURVEY.md §2b T8).
+
+Same on-disk contract as the torch trainer's get_batch (train.py:144-161):
+uint16 token memmaps, random crops of block_size+1. Made multi-host aware
+the jax way: every process samples its OWN disjoint stream of crops from
+the full local file (the corpus is replicated on each host's disk), and
+`jax.make_array_from_process_local_data` assembles the per-process shards
+into one global jax.Array laid out by the batch sharding — no host ever
+materializes the global batch.
+
+The memmap is re-opened per batch, matching the reference's defense against
+the np.memmap leak (train.py:145-147).
+"""
+
+import os
+
+import jax
+import numpy as np
+
+
+class DataLoader:
+    def __init__(self, data_dir, block_size, batch_size, *, sharding=None,
+                 grad_accum=1, seed=0, flat=False):
+        """`batch_size` is the GLOBAL batch size in sequences per micro-step;
+        each call to get_batch returns (grad_accum, B, T) stacked micro
+        batches as a sharded global array (leading accum dim unsharded).
+        `flat=True` (eval): grad_accum must be 1 and batches are (B, T)."""
+        self.data_dir = data_dir
+        self.block_size = block_size
+        self.batch_size = batch_size
+        self.grad_accum = grad_accum
+        self.sharding = sharding
+        self.flat = flat
+        assert not (flat and grad_accum != 1)
+        n_proc = jax.process_count()
+        assert batch_size % n_proc == 0, (
+            f"global batch {batch_size} must divide over {n_proc} processes"
+        )
+        self.local_batch = batch_size // n_proc
+        # disjoint per-process stream
+        self.rng = np.random.default_rng(seed + 1000 * jax.process_index())
+
+    def _sample_local(self, split):
+        arr = np.memmap(
+            os.path.join(self.data_dir, f"{split}.bin"), dtype=np.uint16, mode="r"
+        )
+        n = self.grad_accum * self.local_batch
+        ix = self.rng.integers(0, len(arr) - self.block_size, size=n)
+        x = np.stack([arr[i : i + self.block_size] for i in ix]).astype(np.int32)
+        y = np.stack([arr[i + 1 : i + 1 + self.block_size] for i in ix]).astype(np.int32)
+        if self.flat:
+            shape = (self.local_batch, self.block_size)
+        else:
+            shape = (self.grad_accum, self.local_batch, self.block_size)
+        return x.reshape(shape), y.reshape(shape)
+
+    def get_batch(self, split):
+        x, y = self._sample_local(split)
+        if self.sharding is None:
+            return jax.numpy.asarray(x), jax.numpy.asarray(y)
+        if self.flat:
+            global_shape = (self.batch_size, self.block_size)
+        else:
+            global_shape = (self.grad_accum, self.batch_size, self.block_size)
+        gx = jax.make_array_from_process_local_data(self.sharding, x, global_shape)
+        gy = jax.make_array_from_process_local_data(self.sharding, y, global_shape)
+        return gx, gy

@@ -89,6 +89,7 @@ def causal_attention(q, k, v, *, dropout_rate=0.0, deterministic=True,
             impl = "xla"
     if impl == "pallas":
         assert not use_dropout, "pallas flash attention does not support attn dropout"
+        assert segment_ids is None, "pallas flash attention does not take segment_ids"
         from avenir_tpu.ops.pallas.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=True)

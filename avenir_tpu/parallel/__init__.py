@@ -1,0 +1,23 @@
+"""avenir_tpu.parallel — mesh, sharding rules, and explicit collectives
+(SURVEY.md §1 L2/L0, §2b T3/T4, §2c).
+
+Parallelism here is data layout, not module wrappers: a single
+`jax.sharding.Mesh` with canonical axes, regex partition rules mapping
+param paths to PartitionSpecs, and XLA SPMD inserting the collectives
+(psum for DP, all-gather/reduce-scatter for FSDP, all-to-all for EP).
+Explicit collectives appear only inside shard_map regions (MoE dispatch,
+ring attention).
+"""
+
+from avenir_tpu.parallel.mesh import (
+    AXES,
+    initialize_distributed,
+    make_mesh,
+    parse_mesh_shape,
+)
+from avenir_tpu.parallel.partition import (
+    batch_pspec,
+    match_partition_rules,
+    named_shardings,
+    rules_for_model,
+)

@@ -1,0 +1,101 @@
+"""Device mesh & multi-process bring-up (SURVEY.md §2b T3, §5 "Distributed
+communication backend").
+
+The TPU-native answer to torchrun+NCCL (train.py:106-118): multi-host
+rendezvous via `jax.distributed.initialize`, then ONE global mesh whose
+axis order follows the physical ICI topology (`mesh_utils.create_device_mesh`)
+so the heavy collectives (FSDP gathers, MoE all-to-all, TP reductions) ride
+the fastest links.
+
+Canonical axes, outermost→innermost:
+    data    — pure data parallelism (gradient psum); put DCN here multi-slice
+    fsdp    — data parallelism with params/opt-state sharded (ZeRO-3)
+    expert  — MoE expert parallelism (all-to-all dispatch/combine)
+    context — sequence/context parallelism (ring attention ppermute)
+    tensor  — megatron-style tensor parallelism (innermost: most traffic)
+
+Every mesh carries all five axes (unused ones have size 1) so partition
+rules can always name any axis.
+"""
+
+import os
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXES = ("data", "fsdp", "expert", "context", "tensor")
+
+
+def initialize_distributed():
+    """Multi-host rendezvous (the NCCL-init equivalent). No-op unless the
+    launcher provided coordinator env vars or we're on multi-host TPU."""
+    if jax.process_count() > 1:
+        return  # already initialized
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    )
+    if coord:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+            process_id=int(os.environ["JAX_PROCESS_ID"]),
+        )
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0
+
+
+def parse_mesh_shape(spec: str, n_devices: int) -> dict:
+    """Parse "data:4,fsdp:2" → {'data': 4, 'fsdp': 2, ...rest 1}. One axis
+    may be -1 (inferred). Empty spec → all devices on 'data'."""
+    sizes = {a: 1 for a in AXES}
+    if not spec:
+        sizes["data"] = n_devices
+        return sizes
+    wildcard = None
+    for part in spec.split(","):
+        name, _, val = part.strip().partition(":")
+        if name not in AXES:
+            raise ValueError(f"unknown mesh axis {name!r}; valid: {AXES}")
+        v = int(val)
+        if v == -1:
+            assert wildcard is None, "only one mesh axis may be -1"
+            wildcard = name
+        else:
+            assert v >= 1, f"axis {name} size must be >=1 or -1"
+            sizes[name] = v
+    known = int(np.prod([v for v in sizes.values()]))
+    if wildcard is not None:
+        assert n_devices % known == 0, (
+            f"device count {n_devices} not divisible by fixed axes product {known}"
+        )
+        sizes[wildcard] = n_devices // known
+        known = n_devices
+    if known > n_devices:
+        raise ValueError(
+            f"mesh {spec!r} needs {known} devices but only {n_devices} are present"
+        )
+    # known < n_devices is allowed: the mesh uses the first `known` devices
+    # (debug runs on a slice of the chip pool)
+    return sizes
+
+
+def make_mesh(spec: str = "", devices=None) -> Mesh:
+    """Build the global mesh. Axis order is AXES; the physical device
+    assignment is topology-aware on TPU (ICI-contiguous subcubes)."""
+    devices = jax.devices() if devices is None else devices
+    sizes = parse_mesh_shape(spec, len(devices))
+    shape = tuple(sizes[a] for a in AXES)
+    n_used = int(np.prod(shape))
+    devices = list(devices)[:n_used]
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=np.asarray(devices)
+        )
+    except (ValueError, AssertionError, NotImplementedError):
+        # non-TPU platforms / odd shapes: plain row-major assignment
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXES)

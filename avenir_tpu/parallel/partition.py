@@ -1,0 +1,127 @@
+"""Regex partition rules → PartitionSpec pytrees (SURVEY.md §2b T4).
+
+The pattern follows the public match_partition_rules idiom (SNIPPETS.md:19-32):
+param paths are '/'-joined strings, rules are (regex, PartitionSpec) pairs
+tried in order, and an unmatched param is a hard error — fail loud
+(SNIPPETS.md:31) so silent replication can't eat HBM.
+
+Sharding conventions (axes from mesh.AXES):
+  - Linear kernels alternate ('fsdp','tensor') / ('tensor','fsdp') —
+    column-parallel up-projections, row-parallel down-projections, so TP
+    needs one psum per block and FSDP shards every matmul weight.
+  - Embeddings shard vocab on 'tensor', features on 'fsdp'.
+  - Norm scales/biases are replicated (tiny).
+  - The batch shards on ('data','fsdp') combined: 'fsdp' is still data
+    parallelism (ZeRO), it just also shards the params — XLA SPMD emits
+    the all-gather-at-use / reduce-scatter-of-grads (BASELINE.json:9).
+"""
+
+import re
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+# ---- rule tables per model family ----
+
+GPT_RULES = (
+    (r"wte/embedding$", P("tensor", "fsdp")),
+    (r"wpe/embedding$", P(None, "fsdp")),
+    (r"attn/c_attn/kernel$", P("fsdp", "tensor")),
+    (r"attn/c_attn/bias$", P("tensor")),
+    (r"attn/c_proj/kernel$", P("tensor", "fsdp")),
+    (r"attn/c_proj/bias$", P()),
+    (r"mlp/c_fc/kernel$", P("fsdp", "tensor")),
+    (r"mlp/c_fc/bias$", P("tensor")),
+    (r"mlp/c_proj/kernel$", P("tensor", "fsdp")),
+    (r"mlp/c_proj/bias$", P()),
+    (r"(ln_1|ln_2|ln_f)/(scale|bias)$", P()),
+)
+
+LLAMA_RULES = (
+    (r"embed_tokens/embedding$", P("tensor", "fsdp")),
+    (r"(q_proj|k_proj|v_proj)/kernel$", P("fsdp", "tensor")),
+    (r"o_proj/kernel$", P("tensor", "fsdp")),
+    (r"(gate_proj|up_proj)/kernel$", P("fsdp", "tensor")),
+    (r"down_proj/kernel$", P("tensor", "fsdp")),
+    (r"lm_head/kernel$", P("fsdp", "tensor")),
+    (r"(input_layernorm|post_attention_layernorm|norm)/scale$", P()),
+)
+
+MIXTRAL_RULES = LLAMA_RULES + (
+    # experts are stacked on a leading 'expert' axis: (E, in, out)
+    (r"experts/(w1|w3)/kernel$", P("expert", "fsdp", "tensor")),
+    (r"experts/w2/kernel$", P("expert", "tensor", "fsdp")),
+    (r"gate/kernel$", P(None, None)),
+)
+
+
+def rules_for_model(model_type: str):
+    return {
+        "gpt": GPT_RULES,
+        "llama": LLAMA_RULES,
+        "mixtral": MIXTRAL_RULES,
+    }[model_type]
+
+
+def path_str(path) -> str:
+    return "/".join(str(p) for p in path)
+
+
+def match_partition_rules(rules, paths):
+    """Map each path (tuple or string) to its first matching PartitionSpec.
+    Raises ValueError listing every unmatched path."""
+    out = {}
+    misses = []
+    for path in paths:
+        s = path_str(path) if not isinstance(path, str) else path
+        for pattern, spec in rules:
+            if re.search(pattern, s):
+                out[path] = spec
+                break
+        else:
+            misses.append(s)
+    if misses:
+        raise ValueError(
+            f"no partition rule matched param path(s): {misses}. "
+            "Add a rule — silent replication is not allowed."
+        )
+    return out
+
+
+def sanitize_specs(spec_by_path, shapes, mesh):
+    """Drop mesh axes from any spec dimension they don't divide evenly
+    (e.g. an unpadded char-level vocab of 25 on tensor:2). GSPMD would
+    otherwise refuse the layout; replication of that one dim is the honest
+    fallback. Real configs avoid this by padding (vocab 50304)."""
+    import numpy as np
+
+    out = {}
+    for p, spec in spec_by_path.items():
+        dims = shapes[p]
+        entries = tuple(spec) + (None,) * (len(dims) - len(spec))
+        new = []
+        for d, ax in zip(dims, entries):
+            if ax is None:
+                new.append(None)
+                continue
+            axes = ax if isinstance(ax, tuple) else (ax,)
+            size = int(np.prod([mesh.shape[a] for a in axes]))
+            new.append(ax if d % size == 0 else None)
+        out[p] = P(*new)
+    return out
+
+
+def batch_pspec() -> P:
+    """Global batch layout: batch dim sharded over every data-parallel-like
+    axis (pure DP + ZeRO), sequence dim over 'context' (ring attention)."""
+    return P(("data", "fsdp"), "context")
+
+
+def activation_pspec() -> P:
+    """Between-block activation constraint (B, T, C)."""
+    return P(("data", "fsdp"), "context", None)
+
+
+def named_shardings(mesh, spec_by_path):
+    """{path: PartitionSpec} → {path: NamedSharding} on `mesh`."""
+    return {p: NamedSharding(mesh, s) for p, s in spec_by_path.items()}

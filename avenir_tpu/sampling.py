@@ -1,0 +1,43 @@
+"""Sampling entry for sample.py --backend=tpu (SURVEY.md §2a R5, §3.5).
+
+Loads a ckpt.pt (written by EITHER backend — the container is shared,
+§3.4) and generates with temperature + top-k, mirroring sample_cuda's
+behavior (sample.py:53-78)."""
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from avenir_tpu.checkpoint.bridge import load_torch_state_dict
+from avenir_tpu.checkpoint.io import _strip_compile_prefix, load_checkpoint
+from avenir_tpu.models.gpt import GPT, GPTConfig
+
+
+def run_sampling(*, out_dir, init_from, start, num_samples, max_new_tokens,
+                 temperature, top_k, seed, set_ckpt_config, load_codec):
+    if init_from == "resume":
+        ckpt = load_checkpoint(out_dir)
+        set_ckpt_config(ckpt.get("config", {}))
+        args = {
+            k: ckpt["model_args"][k]
+            for k in ("n_layer", "n_head", "n_embd", "block_size", "bias",
+                      "vocab_size")
+        }
+        model = GPT(GPTConfig(**args), rngs=nnx.Rngs(seed))
+        load_torch_state_dict(model, _strip_compile_prefix(dict(ckpt["model"])))
+    elif init_from.startswith("gpt2"):
+        from avenir_tpu.tools.hf_import import gpt2_from_hf
+
+        model = gpt2_from_hf(init_from)
+    else:
+        raise ValueError(f"init_from={init_from!r}")
+
+    encode, decode = load_codec()
+    x = jnp.asarray(encode(start), dtype=jnp.int32)[None, :]
+    rng = jax.random.key(seed)
+    for s in range(num_samples):
+        rng, sub = jax.random.split(rng)
+        y = model.generate(sub, x, max_new_tokens, temperature=temperature,
+                           top_k=top_k)
+        print(decode([int(t) for t in y[0]]))
+        print("---------------")

@@ -1,0 +1,3 @@
+"""avenir_tpu.train — jit'd training loop (SURVEY.md §1 L4, §2b T2/T5)."""
+
+from avenir_tpu.train.optimizer import make_lr_schedule, make_optimizer

@@ -1,0 +1,322 @@
+"""The TPU training loop (SURVEY.md §2b T5/T11, call stack §3.2).
+
+Driven by train.py --backend=tpu with the same config namespace as the
+torch path. The shape of the loop mirrors train.py:251-316 exactly (eval
+cadence, checkpoint policy, logging keys, MFU) so curves overlay; the body
+is one jit dispatch per optimizer step with donated state.
+
+tokens/iteration parity: the torch side divides gradient_accumulation_steps
+across DDP ranks of micro-batch `batch_size` (train.py:117-118,126). Here
+the batch-sharding axes ('data'×'fsdp'×'context'-free) play the rank role:
+global micro-batch = batch_size × n_dp, accum = grad_accum_steps / n_dp —
+same tokens/iter for the same config on any mesh.
+"""
+
+import os
+import pickle
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import nnx
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from avenir_tpu.checkpoint.io import (
+    load_checkpoint,
+    restore_opt_state,
+    restore_params,
+    save_checkpoint,
+)
+from avenir_tpu.data.loader import DataLoader
+from avenir_tpu.models.common import (
+    transformer_flops_per_token,
+    tpu_peak_flops,
+)
+from avenir_tpu.models.gpt import GPT, GPTConfig
+from avenir_tpu.parallel.mesh import initialize_distributed, is_coordinator, make_mesh
+from avenir_tpu.parallel.partition import (
+    match_partition_rules,
+    rules_for_model,
+    sanitize_specs,
+)
+from avenir_tpu.train.optimizer import make_optimizer
+from avenir_tpu.train.step import jit_train_step, make_step_fns
+
+
+def build_model_factory(cfg, model_args):
+    """Return (model_type, config_obj, ctor) for the configured family."""
+    mt = cfg["model_type"]
+    if mt == "gpt":
+        gcfg = GPTConfig(
+            block_size=model_args["block_size"],
+            vocab_size=model_args["vocab_size"],
+            n_layer=model_args["n_layer"], n_head=model_args["n_head"],
+            n_embd=model_args["n_embd"], dropout=model_args["dropout"],
+            bias=model_args["bias"],
+            compute_dtype=("float32" if cfg["dtype"] == "float16" else cfg["dtype"]),
+            attn_impl=("auto" if cfg["use_pallas"] else "xla"),
+            remat=cfg["remat"],
+        )
+        return mt, gcfg, (lambda seed: GPT(gcfg, rngs=nnx.Rngs(seed)))
+    if mt == "llama":
+        from avenir_tpu.models.llama import Llama, LlamaConfig
+
+        lcfg = LlamaConfig.from_train_config(cfg, model_args)
+        return mt, lcfg, (lambda seed: Llama(lcfg, rngs=nnx.Rngs(seed)))
+    if mt == "mixtral":
+        from avenir_tpu.models.mixtral import Mixtral, MixtralConfig
+
+        mcfg = MixtralConfig.from_train_config(cfg, model_args)
+        return mt, mcfg, (lambda seed: Mixtral(mcfg, rngs=nnx.Rngs(seed)))
+    raise ValueError(f"unknown model_type {mt!r}")
+
+
+def setup_state(cfg, mesh, model_args, *, verbose=True):
+    """Shared bring-up for training and sampling: sharded param init (or
+    abstract shapes only), partition specs, graphdef."""
+    mt, gcfg, ctor = build_model_factory(cfg, model_args)
+    model_abs = nnx.eval_shape(lambda: ctor(cfg["seed"]))
+    graphdef, abs_state = nnx.split(model_abs, nnx.Param)
+    paths = [p for p, _ in abs_state.flat_state()]
+    specs = match_partition_rules(rules_for_model(mt), paths)
+    shapes = {p: tuple(v.get_value().shape) for p, v in abs_state.flat_state()}
+    specs = sanitize_specs(specs, shapes, mesh)
+    shardings = {p: NamedSharding(mesh, specs[p]) for p in paths}
+    shard_tree = nnx.State.from_flat_path(
+        {p: v.replace(shardings[p]) for p, v in abs_state.flat_state()}
+    )
+    if verbose and is_coordinator():
+        n_params = sum(
+            int(np.prod(v.get_value().shape)) for _, v in abs_state.flat_state()
+        )
+        print(f"[tpu] model={mt} params={n_params / 1e6:.2f}M "
+              f"mesh={dict(mesh.shape)}")
+    return {
+        "model_type": mt, "model_config": gcfg, "ctor": ctor,
+        "graphdef": graphdef, "abs_state": abs_state,
+        "shardings": shardings, "shard_tree": shard_tree,
+    }
+
+
+def run_training(cfg):
+    initialize_distributed()
+    master = is_coordinator()
+    mesh = make_mesh(cfg["mesh_shape"])
+    n_dp = mesh.shape["data"] * mesh.shape["fsdp"]
+
+    grad_accum_total = cfg["gradient_accumulation_steps"]
+    assert grad_accum_total % n_dp == 0, (
+        f"gradient_accumulation_steps={grad_accum_total} must divide across "
+        f"{n_dp} data-parallel shards"
+    )
+    grad_accum = grad_accum_total // n_dp
+    global_micro_batch = cfg["batch_size"] * n_dp
+    block_size = cfg["block_size"]
+    tokens_per_iter = grad_accum * global_micro_batch * block_size
+    if master:
+        print(f"tokens per iteration: {tokens_per_iter:,}")
+        os.makedirs(cfg["out_dir"], exist_ok=True)
+
+    # dataset may be a name under data/ or an absolute path (tests, pods)
+    data_dir = (
+        cfg["dataset"] if os.path.isabs(cfg["dataset"])
+        else os.path.join("data", cfg["dataset"])
+    )
+    meta_path = os.path.join(data_dir, "meta.pkl")
+    meta_vocab_size = None
+    if os.path.exists(meta_path):
+        with open(meta_path, "rb") as f:
+            meta_vocab_size = pickle.load(f)["vocab_size"]
+        if master:
+            print(f"found vocab_size = {meta_vocab_size} (from {meta_path})")
+
+    model_args = dict(
+        n_layer=cfg["n_layer"], n_head=cfg["n_head"], n_embd=cfg["n_embd"],
+        block_size=block_size, bias=cfg["bias"], vocab_size=None,
+        dropout=cfg["dropout"],
+    )
+
+    iter_num = 0
+    best_val_loss = 1e9
+    ckpt = None
+    if cfg["init_from"] == "scratch":
+        model_args["vocab_size"] = meta_vocab_size if meta_vocab_size else 50304
+    elif cfg["init_from"] == "resume":
+        ckpt = load_checkpoint(cfg["out_dir"])
+        for k in ("n_layer", "n_head", "n_embd", "block_size", "bias", "vocab_size"):
+            model_args[k] = ckpt["model_args"][k]
+        iter_num = ckpt["iter_num"]
+        best_val_loss = ckpt["best_val_loss"]
+        if master:
+            print(f"resuming from {cfg['out_dir']} at iter {iter_num}")
+    else:
+        raise ValueError(
+            f"init_from={cfg['init_from']!r} not supported on the tpu "
+            "backend (gpt2* HF import: use sample.py / tools)"
+        )
+
+    st = setup_state(cfg, mesh, model_args)
+    graphdef, shardings = st["graphdef"], st["shardings"]
+
+    # ---- params: sharded init or checkpoint restore ----
+    if ckpt is None:
+        def init_fn():
+            m = st["ctor"](cfg["seed"])
+            return nnx.split(m, nnx.Param)[1]
+
+        params = jax.jit(init_fn, out_shardings=st["shard_tree"])()
+    else:
+        params = restore_params(ckpt, st["abs_state"], shardings)
+
+    # ---- optimizer ----
+    tx, lr_schedule = make_optimizer(
+        params,
+        learning_rate=cfg["learning_rate"], weight_decay=cfg["weight_decay"],
+        beta1=cfg["beta1"], beta2=cfg["beta2"], grad_clip=cfg["grad_clip"],
+        warmup_iters=cfg["warmup_iters"], lr_decay_iters=cfg["lr_decay_iters"],
+        min_lr=cfg["min_lr"], decay_lr=cfg["decay_lr"],
+        use_pallas=cfg["use_pallas"],
+    )
+
+    def init_opt(p):
+        state = tx.init(p)
+
+        def constrain(node):
+            if hasattr(node, "mu") and hasattr(node, "nu") and hasattr(node, "count"):
+                con = lambda a, path_shard: jax.lax.with_sharding_constraint(a, path_shard)
+                mu = jax.tree.map(con, node.mu, st["shard_tree"])
+                nu = jax.tree.map(con, node.nu, st["shard_tree"])
+                return node._replace(mu=mu, nu=nu)
+            if isinstance(node, tuple) and hasattr(node, "_fields"):
+                return type(node)(*(constrain(c) for c in node))
+            if isinstance(node, tuple):
+                return tuple(constrain(c) for c in node)
+            return node
+
+        return constrain(state)
+
+    opt_state = jax.jit(init_opt)(params)
+    if ckpt is not None:
+        opt_state = restore_opt_state(ckpt, opt_state, params, shardings)
+        ckpt = None  # free host copies
+
+    # ---- data ----
+    batch_sharding = NamedSharding(mesh, P(None, ("data", "fsdp"), "context"))
+    eval_sharding = NamedSharding(mesh, P(("data", "fsdp"), "context"))
+    train_loader = DataLoader(
+        data_dir, block_size, global_micro_batch,
+        sharding=batch_sharding, grad_accum=grad_accum, seed=cfg["seed"],
+    )
+    eval_loader = DataLoader(
+        data_dir, block_size, global_micro_batch,
+        sharding=eval_sharding, grad_accum=1, seed=cfg["seed"] + 1, flat=True,
+    )
+
+    # ---- step fns ----
+    train_step_fn, eval_step_fn = make_step_fns(
+        graphdef, dropout=model_args["dropout"]
+    )
+    train_step = jit_train_step(train_step_fn, tx)
+    eval_step = jax.jit(eval_step_fn)
+
+    def estimate_loss(params):
+        out = {}
+        for split in ("train", "val"):
+            losses = np.zeros(cfg["eval_iters"])
+            for k in range(cfg["eval_iters"]):
+                x, y = eval_loader.get_batch(split)
+                losses[k] = float(eval_step(params, x, y))
+            out[split] = losses.mean()
+        return out
+
+    if cfg["wandb_log"] and master:
+        import wandb
+
+        wandb.init(project=cfg["wandb_project"], name=cfg["wandb_run_name"],
+                   config=cfg)
+
+    base_rng = jax.random.key(cfg["seed"])
+    flat_abs = dict(st["abs_state"].flat_state())
+    n_params = sum(int(np.prod(v.get_value().shape)) for v in flat_abs.values())
+    if ("wpe", "embedding") in flat_abs:  # gpt: exclude pos-emb, model.py:167-171
+        n_params -= int(np.prod(flat_abs[("wpe", "embedding")].get_value().shape))
+    flops_per_token = transformer_flops_per_token(
+        n_params, model_args["n_layer"], model_args["n_head"],
+        model_args["n_embd"] // model_args["n_head"], block_size,
+    )
+    peak = tpu_peak_flops()
+
+    x, y = train_loader.get_batch("train")
+    t0 = time.time()
+    local_iter_num = 0
+    running_mfu = -1.0
+    metrics = {"loss": jnp.float32(0.0)}
+    profile_started = False
+    loss_history = []  # (iter, loss) at log cadence; returned for tests/tools
+
+    while True:
+        lr = float(lr_schedule(iter_num)) if cfg["decay_lr"] else cfg["learning_rate"]
+
+        if iter_num % cfg["eval_interval"] == 0 and master:
+            losses = estimate_loss(params)
+            print(f"step {iter_num}: train loss {losses['train']:.4f}, "
+                  f"val loss {losses['val']:.4f}")
+            if cfg["wandb_log"]:
+                import wandb
+
+                wandb.log({
+                    "iter": iter_num, "train/loss": losses["train"],
+                    "val/loss": losses["val"], "lr": lr,
+                    "mfu": running_mfu * 100,
+                })
+            if losses["val"] < best_val_loss or cfg["always_save_checkpoint"]:
+                best_val_loss = min(best_val_loss, losses["val"])
+                if iter_num > 0:
+                    print(f"saving checkpoint to {cfg['out_dir']}")
+                    save_checkpoint(
+                        cfg["out_dir"], params=params, opt_state=opt_state,
+                        hyper={"lr": lr, "betas": (cfg["beta1"], cfg["beta2"]),
+                               "eps": 1e-8, "weight_decay": cfg["weight_decay"]},
+                        model_args=model_args, iter_num=iter_num,
+                        best_val_loss=best_val_loss, config=cfg,
+                        model_family=st["model_type"],
+                    )
+        if iter_num == 0 and cfg["eval_only"]:
+            break
+
+        if cfg["profile"] and iter_num == 10 and master and not profile_started:
+            jax.profiler.start_trace(os.path.join(cfg["out_dir"], "profile"))
+            profile_started = True
+
+        step_rng = jax.random.fold_in(base_rng, iter_num)
+        params, opt_state, metrics = train_step(params, opt_state, step_rng, x, y)
+        x, y = train_loader.get_batch("train")  # overlap host sampling w/ device step
+
+        if cfg["profile"] and iter_num == 20 and profile_started:
+            jax.block_until_ready(metrics["loss"])
+            jax.profiler.stop_trace()
+            profile_started = False
+
+        t1 = time.time()
+        dt = t1 - t0
+        t0 = t1
+        if iter_num % cfg["log_interval"] == 0 and master:
+            lossf = float(metrics["loss"])  # sync point, log cadence only
+            loss_history.append((iter_num, lossf))
+            if local_iter_num >= 5:
+                seqs_per_iter = cfg["batch_size"] * grad_accum_total
+                flops_per_iter = flops_per_token * block_size * seqs_per_iter
+                mfu = (flops_per_iter / dt) / (peak * jax.device_count())
+                running_mfu = mfu if running_mfu == -1.0 else 0.9 * running_mfu + 0.1 * mfu
+            print(f"iter {iter_num}: loss {lossf:.4f}, time {dt * 1000:.2f}ms, "
+                  f"mfu {running_mfu * 100:.2f}%")
+        iter_num += 1
+        local_iter_num += 1
+        if iter_num > cfg["max_iters"]:
+            break
+
+    return {
+        "iter_num": iter_num, "best_val_loss": float(best_val_loss),
+        "loss_history": loss_history,
+    }

@@ -1,0 +1,78 @@
+"""AdamW via optax, matching the torch reference semantics (SURVEY.md §2b
+T2; BASELINE.json:5 "AdamW hot path as Pallas kernels / optax").
+
+Parity notes vs model.py:255-271 + train.py:233-240:
+  - decay mask: weight decay only on params with ndim >= 2 (matmul kernels
+    and embeddings) — same predicate as configure_optimizers
+  - decoupled weight decay, eps=1e-8 — optax.adamw matches torch.AdamW
+  - grad clip by global norm BEFORE the Adam update (train.py:294-296)
+  - schedule: linear warmup (it+1)/(warmup+1) → cosine to min_lr — exact
+    get_lr translation; optax's `count` is the completed-update count,
+    which equals the torch loop's iter_num at set-lr time
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def make_lr_schedule(learning_rate, warmup_iters, lr_decay_iters, min_lr,
+                     decay_lr=True):
+    def schedule(count):
+        count = jnp.asarray(count, jnp.float32)
+        if not decay_lr:
+            return jnp.full_like(count, learning_rate)
+        warm = learning_rate * (count + 1.0) / (warmup_iters + 1.0)
+        ratio = jnp.clip(
+            (count - warmup_iters) / jnp.maximum(lr_decay_iters - warmup_iters, 1),
+            0.0, 1.0,
+        )
+        coeff = 0.5 * (1.0 + jnp.cos(math.pi * ratio))
+        cos = min_lr + coeff * (learning_rate - min_lr)
+        return jnp.where(count < warmup_iters, warm, cos)
+
+    return schedule
+
+
+def decay_mask(params):
+    """True (decay) for >=2-D params — model.py:258-260's predicate."""
+    return jax.tree.map(lambda p: jnp.ndim(p) >= 2, params)
+
+
+def make_optimizer(params, *, learning_rate, weight_decay, beta1, beta2,
+                   grad_clip, warmup_iters, lr_decay_iters, min_lr,
+                   decay_lr=True, use_pallas=False):
+    """Build the optax chain. `params` is only used to shape the decay mask.
+
+    `use_pallas` swaps the adamw transform for the fused Pallas kernel
+    (avenir_tpu/ops/pallas/adamw.py) on TPU; the optax path is the
+    reference semantics either way."""
+    schedule = make_lr_schedule(
+        learning_rate, warmup_iters, lr_decay_iters, min_lr, decay_lr
+    )
+    mask = decay_mask(params)
+    if use_pallas:
+        try:
+            from avenir_tpu.ops.pallas.adamw import fused_adamw
+
+            adamw = fused_adamw(
+                learning_rate=schedule, b1=beta1, b2=beta2, eps=1e-8,
+                weight_decay=weight_decay, mask=mask,
+            )
+        except ImportError:
+            adamw = optax.adamw(
+                learning_rate=schedule, b1=beta1, b2=beta2, eps=1e-8,
+                weight_decay=weight_decay, mask=mask,
+            )
+    else:
+        adamw = optax.adamw(
+            learning_rate=schedule, b1=beta1, b2=beta2, eps=1e-8,
+            weight_decay=weight_decay, mask=mask,
+        )
+    chain = []
+    if grad_clip and grad_clip > 0.0:
+        chain.append(optax.clip_by_global_norm(grad_clip))
+    chain.append(adamw)
+    return optax.chain(*chain), schedule

@@ -1,0 +1,73 @@
+"""The jit'd train/eval step (SURVEY.md §2b T5, call stack §3.2).
+
+One XLA dispatch per optimizer step: grad accumulation runs as a
+`lax.scan` over the leading micro-batch axis INSIDE the jit, gradients
+live in fp32, params/opt-state are donated so the update is in-place in
+HBM. Parallelism never appears here — it is carried entirely by the
+shardings of the inputs (params pytree, batch) and XLA SPMD inserts the
+psum / reduce-scatter / all-gather the layout implies (SURVEY.md §1).
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import nnx
+
+
+def make_step_fns(graphdef, *, dropout: float):
+    """Build (train_step, eval_step) closures over the model graphdef.
+
+    train_step(params, opt_state, tx, rng, x, y) -> (params, opt_state, metrics)
+      x, y: (grad_accum, B, T) int32. `tx` is the optax transform (static).
+    """
+
+    def micro_loss(params, x, y, step_rng):
+        model = nnx.merge(graphdef, params)
+        rngs = nnx.Rngs(dropout=step_rng) if dropout > 0.0 else None
+        _, loss = model(x, y, deterministic=dropout == 0.0, rngs=rngs)
+        return loss
+
+    def train_step(params, opt_state, tx, rng, x, y):
+        grad_accum = x.shape[0]
+
+        def body(carry, micro):
+            g_acc, loss_acc = carry
+            xb, yb, r = micro
+            loss, g = jax.value_and_grad(micro_loss)(params, xb, yb, r)
+            g_acc = jax.tree.map(jnp.add, g_acc, g)
+            return (g_acc, loss_acc + loss), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params
+        )
+        rngs = jax.random.split(rng, grad_accum)
+        (g_sum, loss_sum), _ = jax.lax.scan(
+            body, (zeros, jnp.float32(0.0)), (x, y, rngs)
+        )
+        inv = 1.0 / grad_accum
+        grads = jax.tree.map(lambda g: g * inv, g_sum)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = {
+            "loss": loss_sum * inv,
+            "grad_norm": optax.global_norm(grads),
+        }
+        return params, opt_state, metrics
+
+    def eval_step(params, x, y):
+        model = nnx.merge(graphdef, params)
+        _, loss = model(x, y, deterministic=True)
+        return loss
+
+    return train_step, eval_step
+
+
+def jit_train_step(train_step, tx):
+    """jit the step with donation of params+opt_state so the update happens
+    in place in HBM (no transient second copy of the model). Output
+    shardings follow the (already sharded) inputs; SPMD does the rest."""
+
+    def wrapped(params, opt_state, rng, x, y):
+        return train_step(params, opt_state, tx, rng, x, y)
+
+    return jax.jit(wrapped, donate_argnums=(0, 1))

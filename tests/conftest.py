@@ -24,6 +24,10 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: multi-subprocess integration tests")
+
+
 @pytest.fixture(scope="session")
 def char_dataset(tmp_path_factory):
     """Tiny deterministic char-level dataset in the nanoGPT on-disk layout."""

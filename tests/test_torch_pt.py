@@ -1,0 +1,107 @@
+"""torch .pt container round-trip tests (SURVEY.md §4; §7 names
+"T7 torch-.pt-without-torch" the highest-risk item). Real torch is
+available in the sandbox, so both directions are tested against it."""
+
+import collections
+import os
+
+import ml_dtypes
+import numpy as np
+import pytest
+import torch
+
+from avenir_tpu.checkpoint.torch_pt import BFLOAT16, load_pt, save_pt
+
+
+@pytest.fixture()
+def ckpt_obj():
+    tied = np.random.randn(5, 3).astype(np.float32)
+    return {
+        "model": collections.OrderedDict([
+            ("w", np.random.randn(4, 4).astype(np.float32)),
+            ("b", np.arange(3, dtype=np.int64)),
+            ("bf", np.random.randn(2, 2).astype(ml_dtypes.bfloat16)),
+            ("tied_a", tied),
+            ("tied_b", tied),
+        ]),
+        "iter_num": 123,
+        "best_val_loss": 1.5,
+        "config": {"lr": 3e-4, "name": "x", "flag": True, "none": None,
+                   "lst": [1, 2.5, "s"], "tup": (1, 2, 3, 4),
+                   "big": 2 ** 40},
+    }
+
+
+def test_our_writer_torch_reader(tmp_path, ckpt_obj):
+    p = tmp_path / "ckpt.pt"
+    save_pt(ckpt_obj, p)
+    loaded = torch.load(p, map_location="cpu", weights_only=False)
+    assert loaded["iter_num"] == 123
+    assert loaded["best_val_loss"] == 1.5
+    assert loaded["config"]["lst"] == [1, 2.5, "s"]
+    assert loaded["config"]["big"] == 2 ** 40
+    assert tuple(loaded["config"]["tup"]) == (1, 2, 3, 4)
+    np.testing.assert_array_equal(
+        loaded["model"]["w"].numpy(), ckpt_obj["model"]["w"]
+    )
+    np.testing.assert_array_equal(
+        loaded["model"]["b"].numpy(), ckpt_obj["model"]["b"]
+    )
+    assert loaded["model"]["bf"].dtype == torch.bfloat16
+    # tied tensors share one storage, exactly like torch's own save
+    assert (loaded["model"]["tied_a"].data_ptr()
+            == loaded["model"]["tied_b"].data_ptr())
+
+
+def test_torch_writer_our_reader(tmp_path):
+    obj = {
+        "model": collections.OrderedDict([
+            ("w", torch.randn(4, 4)),
+            ("h", torch.randn(6).to(torch.bfloat16)),
+            ("i", torch.arange(5)),
+        ]),
+        "iter_num": 7,
+        "cfg": {"a": 1},
+    }
+    p = tmp_path / "t.pt"
+    torch.save(obj, p)
+    back = load_pt(p)
+    np.testing.assert_array_equal(back["model"]["w"], obj["model"]["w"].numpy())
+    assert back["model"]["h"].dtype == BFLOAT16
+    assert back["iter_num"] == 7
+    assert back["cfg"] == {"a": 1}
+
+
+def test_self_round_trip(tmp_path, ckpt_obj):
+    p = tmp_path / "ckpt.pt"
+    save_pt(ckpt_obj, p)
+    back = load_pt(p)
+    np.testing.assert_array_equal(back["model"]["w"], ckpt_obj["model"]["w"])
+    assert back["model"]["bf"].dtype == BFLOAT16
+    assert back["config"]["tup"] == (1, 2, 3, 4)
+
+
+def test_weights_only_load(tmp_path):
+    """torch.load(weights_only=True) — the hardened loader — must accept
+    a pure state_dict written by us."""
+    sd = collections.OrderedDict(
+        [("w", np.random.randn(3, 3).astype(np.float32))]
+    )
+    p = tmp_path / "sd.pt"
+    save_pt(sd, p)
+    loaded = torch.load(p, weights_only=True)
+    np.testing.assert_array_equal(loaded["w"].numpy(), sd["w"])
+
+
+def test_reader_rejects_unknown_globals(tmp_path):
+    """Fail-loud policy: arbitrary callables must not unpickle."""
+    import pickle as pkl
+    import zipfile
+
+    evil = b"\x80\x02cos\nsystem\nX\x04\x00\x00\x00echo\x85R."
+    p = tmp_path / "evil.pt"
+    with zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("archive/data.pkl", evil)
+        zf.writestr("archive/version", "3\n")
+    with pytest.raises(pkl.UnpicklingError):
+        load_pt(p)

@@ -139,7 +139,7 @@ def train_cuda():
         else torch.amp.autocast(device_type=device_type, dtype=ptdtype)
     )
 
-    data_dir = os.path.join("data", dataset)
+    data_dir = dataset if os.path.isabs(dataset) else os.path.join("data", dataset)
 
     def get_batch(split):
         # recreate np.memmap every call to avoid the memory-leak footgun
