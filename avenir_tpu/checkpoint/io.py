@@ -12,7 +12,6 @@ import os
 import jax
 import numpy as np
 from flax import nnx
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from avenir_tpu.checkpoint.bridge import (
     export_torch_state_dict,
@@ -86,61 +85,87 @@ def gather_to_host(tree):
     multi-host mesh every process participates in the all-gather; the
     coordinator alone writes the file (SURVEY.md §3.4 ⟨proc⟩ note)."""
     def gather(x):
-        if isinstance(x, jax.Array) and hasattr(x, "sharding") and not x.is_fully_addressable:
-            mesh = x.sharding.mesh
-            x = jax.jit(lambda a: a, out_shardings=NamedSharding(mesh, P()))(x)
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
         return np.asarray(jax.device_get(x))
 
     return jax.tree.map(gather, tree)
+
+
+def _tied(model_family):
+    return model_family == "gpt"  # llama/mixtral have a real lm_head param
 
 
 def save_checkpoint(out_dir, *, params, opt_state, hyper, model_args,
                     iter_num, best_val_loss, config, model_family="gpt"):
     """Write out_dir/ckpt.pt in the torch schema. `params` is the nnx Param
     State; `opt_state` the optax state; `hyper` carries the torch
-    param_group hyperparams (lr, betas, eps, weight_decay)."""
-    params_host = gather_to_host(params)
-    sd = export_torch_state_dict(params_host, model_family=model_family)
-    order = torch_param_order(sd, model_family)
-    decay, nodecay = _adam_groups(order, sd)
+    param_group hyperparams (lr, betas, eps, weight_decay).
 
+    gpt: the optimizer entry is a torch AdamW state_dict (param-index
+    keyed, model.py:255-271 grouping) so torch can resume it. llama/
+    mixtral have no torch counterpart in-repo; their moments are stored
+    under torch-style KEYS instead of indices ("format": "avenir_adamw"),
+    same container."""
+    params_host = gather_to_host(params)
+    tied = _tied(model_family)
+    sd = export_torch_state_dict(params_host, model_family=model_family,
+                                 tied_lm_head=tied)
     adam = _find_adam_state(gather_to_host(opt_state))
     mu_sd = export_torch_state_dict(adam.mu, model_family=model_family,
                                     tied_lm_head=False)
     nu_sd = export_torch_state_dict(adam.nu, model_family=model_family,
                                     tied_lm_head=False)
     step = float(np.asarray(adam.count))
-    opt_sd = {
-        "state": {
-            i: {
-                "step": np.asarray(step, np.float32),
-                "exp_avg": mu_sd[k],
-                "exp_avg_sq": nu_sd[k],
-            }
-            for i, k in enumerate(decay + nodecay)
-        },
-        "param_groups": [
-            {
-                "lr": hyper["lr"], "betas": tuple(hyper["betas"]),
-                "eps": hyper["eps"], "weight_decay": wd,
-                "amsgrad": False, "maximize": False, "foreach": None,
-                "capturable": False, "differentiable": False, "fused": None,
-                "decoupled_weight_decay": True,
-                "params": list(range(start, start + len(group))),
-            }
-            for group, wd, start in (
-                (decay, hyper["weight_decay"], 0),
-                (nodecay, 0.0, len(decay)),
-            )
-        ],
-    }
+
+    if model_family == "gpt":
+        order = torch_param_order(sd, model_family)
+        decay, nodecay = _adam_groups(order, sd)
+        opt_sd = {
+            "state": {
+                i: {
+                    "step": np.asarray(step, np.float32),
+                    "exp_avg": mu_sd[k],
+                    "exp_avg_sq": nu_sd[k],
+                }
+                for i, k in enumerate(decay + nodecay)
+            },
+            "param_groups": [
+                {
+                    "lr": hyper["lr"], "betas": tuple(hyper["betas"]),
+                    "eps": hyper["eps"], "weight_decay": wd,
+                    "amsgrad": False, "maximize": False, "foreach": None,
+                    "capturable": False, "differentiable": False,
+                    "fused": None, "decoupled_weight_decay": True,
+                    "params": list(range(start, start + len(group))),
+                }
+                for group, wd, start in (
+                    (decay, hyper["weight_decay"], 0),
+                    (nodecay, 0.0, len(decay)),
+                )
+            ],
+        }
+        model_sd = collections.OrderedDict(
+            (k, sd[k]) for k in list(order) + ["lm_head.weight"]
+        )
+    else:
+        opt_sd = {
+            "format": "avenir_adamw", "step": step,
+            "exp_avg": mu_sd, "exp_avg_sq": nu_sd,
+            "hyper": dict(hyper),
+        }
+        model_sd = collections.OrderedDict(sorted(sd.items()))
+
     ckpt = {
-        "model": collections.OrderedDict((k, sd[k]) for k in list(order) + ["lm_head.weight"]),
+        "model": model_sd,
         "optimizer": opt_sd,
         "model_args": dict(model_args),
         "iter_num": int(iter_num),
         "best_val_loss": float(best_val_loss),
         "config": dict(config),
+        "model_family": model_family,
     }
     if jax.process_index() == 0:
         os.makedirs(out_dir, exist_ok=True)
@@ -158,14 +183,14 @@ def _strip_compile_prefix(sd):
     return {k[len(pre):] if k.startswith(pre) else k: v for k, v in sd.items()}
 
 
-def restore_params(ckpt, abs_state, shardings):
+def restore_params(ckpt, abs_state, shardings, model_family="gpt"):
     """Map ckpt['model'] (torch layout) onto the param State, placing each
     leaf with its NamedSharding (sharded host→device transfer)."""
     sd = _strip_compile_prefix(dict(ckpt["model"]))
     flat = {p: v for p, v in abs_state.flat_state()}
     out = {}
     for key, arr in sd.items():
-        path, transpose = torch_key_to_nnx_path(key)
+        path, transpose = torch_key_to_nnx_path(key, tied_lm_head=_tied(model_family))
         if path is None:
             continue
         assert path in flat, f"checkpoint key {key} → {path} not in model"
@@ -180,27 +205,65 @@ def restore_params(ckpt, abs_state, shardings):
     return nnx.State.from_flat_path(out)
 
 
-def restore_opt_state(ckpt, opt_state, params, param_shardings):
-    """Rebuild the optax adam moments from torch optimizer state (indexed
-    by param position) and splice them into a freshly init'd opt_state."""
-    sd = _strip_compile_prefix(dict(ckpt["model"]))
-    order = torch_param_order(sd)
-    decay, nodecay = _adam_groups(order, sd)
-    indexed = decay + nodecay
-    tstate = ckpt["optimizer"]["state"]
+def _set_all_counts(opt_state, count):
+    """Set `count` on EVERY stateful node that carries one — ScaleByAdam
+    AND ScaleBySchedule: restoring only the adam count would silently
+    replay the LR schedule from 0 after resume."""
+    c = np.asarray(count, np.int32)
 
-    flat_shard = {p: s for p, s in param_shardings.items()}
+    def walk(node):
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            node = type(node)(*(walk(x) for x in node))
+            if "count" in node._fields:
+                node = node._replace(count=c)
+            return node
+        if isinstance(node, tuple):
+            return tuple(walk(x) for x in node)
+        return node
+
+    return walk(opt_state)
+
+
+def restore_opt_state(ckpt, opt_state, params, param_shardings,
+                      model_family="gpt"):
+    """Rebuild the optax adam moments from the checkpoint's optimizer entry
+    (torch param-index schema for gpt, key schema for other families) and
+    splice them into a freshly init'd opt_state."""
+    opt_entry = ckpt["optimizer"]
+    flat_shard = dict(param_shardings)
     mu_flat, nu_flat = {}, {}
-    step = 0.0
-    for i, key in enumerate(indexed):
-        ent = tstate[i]
-        path, transpose = torch_key_to_nnx_path(key)
-        step = float(np.asarray(ent["step"]))
-        for src, dst in (("exp_avg", mu_flat), ("exp_avg_sq", nu_flat)):
-            a = np.asarray(ent[src], dtype=np.float32)
-            if transpose:
-                a = np.ascontiguousarray(a.T)
-            dst[path] = jax.device_put(a, flat_shard[path])
+
+    if "param_groups" in opt_entry:  # torch AdamW schema
+        sd = _strip_compile_prefix(dict(ckpt["model"]))
+        order = torch_param_order(sd, model_family)
+        decay, nodecay = _adam_groups(order, sd)
+        indexed = decay + nodecay
+        tstate = opt_entry["state"]
+        step = 0.0
+        for i, key in enumerate(indexed):
+            ent = tstate[i]
+            path, transpose = torch_key_to_nnx_path(key)
+            step = float(np.asarray(ent["step"]))
+            for src, dst in (("exp_avg", mu_flat), ("exp_avg_sq", nu_flat)):
+                a = np.asarray(ent[src], dtype=np.float32)
+                if transpose:
+                    a = np.ascontiguousarray(a.T)
+                dst[path] = jax.device_put(a, flat_shard[path])
+    else:  # avenir_adamw schema (llama/mixtral)
+        assert opt_entry.get("format") == "avenir_adamw", opt_entry.keys()
+        step = float(opt_entry["step"])
+        for key, a in opt_entry["exp_avg"].items():
+            path, transpose = torch_key_to_nnx_path(key, tied_lm_head=False)
+            a = np.asarray(a, np.float32)
+            mu_flat[path] = jax.device_put(
+                np.ascontiguousarray(a.T) if transpose else a, flat_shard[path]
+            )
+        for key, a in opt_entry["exp_avg_sq"].items():
+            path, transpose = torch_key_to_nnx_path(key, tied_lm_head=False)
+            a = np.asarray(a, np.float32)
+            nu_flat[path] = jax.device_put(
+                np.ascontiguousarray(a.T) if transpose else a, flat_shard[path]
+            )
 
     pflat = {p: v for p, v in params.flat_state()}
     mu = nnx.State.from_flat_path(
@@ -210,7 +273,5 @@ def restore_opt_state(ckpt, opt_state, params, param_shardings):
         {p: pflat[p].replace(nu_flat[p]) for p in pflat}
     )
     adam = _find_adam_state(opt_state)
-    new_adam = adam._replace(
-        count=np.asarray(int(step), np.int32), mu=mu, nu=nu
-    )
-    return _replace_adam_state(opt_state, new_adam)
+    new_adam = adam._replace(mu=mu, nu=nu)
+    return _set_all_counts(_replace_adam_state(opt_state, new_adam), int(step))

@@ -28,11 +28,26 @@ from jax.sharding import Mesh
 AXES = ("data", "fsdp", "expert", "context", "tensor")
 
 
+def _already_initialized() -> bool:
+    # peek at the distributed client without touching the local backend
+    # (jax.process_count() would initialize it, after which
+    # jax.distributed.initialize refuses to run)
+    from jax._src import distributed as _dist
+
+    return getattr(_dist.global_state, "client", None) is not None
+
+
 def initialize_distributed():
-    """Multi-host rendezvous (the NCCL-init equivalent). No-op unless the
-    launcher provided coordinator env vars or we're on multi-host TPU."""
-    if jax.process_count() > 1:
-        return  # already initialized
+    """Multi-host rendezvous (the NCCL-init equivalent of
+    train.py:106-118's init_process_group). MUST run before any JAX
+    computation. Three cases:
+      - explicit env (JAX_COORDINATOR_ADDRESS/_NUM_PROCESSES/_PROCESS_ID,
+        the torchrun-style launcher contract) → explicit initialize
+      - multi-host TPU pod (worker hostnames advertised by the TPU
+        runtime) → argless initialize(), which auto-detects from metadata
+      - single host → no-op"""
+    if _already_initialized():
+        return
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
         "COORDINATOR_ADDRESS"
     )
@@ -42,6 +57,10 @@ def initialize_distributed():
             num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
             process_id=int(os.environ["JAX_PROCESS_ID"]),
         )
+        return
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hostnames.split(",") if h]) > 1:
+        jax.distributed.initialize()  # auto-detect via TPU metadata
 
 
 def is_coordinator() -> bool:

@@ -111,10 +111,13 @@ def sanitize_specs(spec_by_path, shapes, mesh):
     return out
 
 
-def batch_pspec() -> P:
+def batch_pspec(with_accum: bool = True) -> P:
     """Global batch layout: batch dim sharded over every data-parallel-like
-    axis (pure DP + ZeRO), sequence dim over 'context' (ring attention)."""
-    return P(("data", "fsdp"), "context")
+    axis (pure DP + ZeRO), sequence dim over 'context' (ring attention).
+    `with_accum`: leading unsharded grad-accumulation axis (train batches
+    are (accum, B, T); eval batches are (B, T))."""
+    per_batch = (("data", "fsdp"), "context")
+    return P(None, *per_batch) if with_accum else P(*per_batch)
 
 
 def activation_pspec() -> P:

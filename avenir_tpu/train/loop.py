@@ -36,6 +36,7 @@ from avenir_tpu.models.common import (
 from avenir_tpu.models.gpt import GPT, GPTConfig
 from avenir_tpu.parallel.mesh import initialize_distributed, is_coordinator, make_mesh
 from avenir_tpu.parallel.partition import (
+    batch_pspec,
     match_partition_rules,
     rules_for_model,
     sanitize_specs,
@@ -167,7 +168,8 @@ def run_training(cfg):
 
         params = jax.jit(init_fn, out_shardings=st["shard_tree"])()
     else:
-        params = restore_params(ckpt, st["abs_state"], shardings)
+        params = restore_params(ckpt, st["abs_state"], shardings,
+                                model_family=st["model_type"])
 
     # ---- optimizer ----
     tx, lr_schedule = make_optimizer(
@@ -198,12 +200,13 @@ def run_training(cfg):
 
     opt_state = jax.jit(init_opt)(params)
     if ckpt is not None:
-        opt_state = restore_opt_state(ckpt, opt_state, params, shardings)
+        opt_state = restore_opt_state(ckpt, opt_state, params, shardings,
+                                      model_family=st["model_type"])
         ckpt = None  # free host copies
 
     # ---- data ----
-    batch_sharding = NamedSharding(mesh, P(None, ("data", "fsdp"), "context"))
-    eval_sharding = NamedSharding(mesh, P(("data", "fsdp"), "context"))
+    batch_sharding = NamedSharding(mesh, batch_pspec())
+    eval_sharding = NamedSharding(mesh, batch_pspec(with_accum=False))
     train_loader = DataLoader(
         data_dir, block_size, global_micro_batch,
         sharding=batch_sharding, grad_accum=grad_accum, seed=cfg["seed"],
@@ -258,11 +261,17 @@ def run_training(cfg):
     while True:
         lr = float(lr_schedule(iter_num)) if cfg["decay_lr"] else cfg["learning_rate"]
 
-        if iter_num % cfg["eval_interval"] == 0 and master:
+        # eval + checkpointing run on EVERY process: the global-batch
+        # construction and the save-time gathers are SPMD collectives, so
+        # gating them on the coordinator would deadlock a pod. Only the
+        # printing/logging is coordinator-only. All processes compute the
+        # same losses (same global arrays), so the save decision agrees.
+        if iter_num % cfg["eval_interval"] == 0:
             losses = estimate_loss(params)
-            print(f"step {iter_num}: train loss {losses['train']:.4f}, "
-                  f"val loss {losses['val']:.4f}")
-            if cfg["wandb_log"]:
+            if master:
+                print(f"step {iter_num}: train loss {losses['train']:.4f}, "
+                      f"val loss {losses['val']:.4f}")
+            if cfg["wandb_log"] and master:
                 import wandb
 
                 wandb.log({
@@ -273,7 +282,8 @@ def run_training(cfg):
             if losses["val"] < best_val_loss or cfg["always_save_checkpoint"]:
                 best_val_loss = min(best_val_loss, losses["val"])
                 if iter_num > 0:
-                    print(f"saving checkpoint to {cfg['out_dir']}")
+                    if master:
+                        print(f"saving checkpoint to {cfg['out_dir']}")
                     save_checkpoint(
                         cfg["out_dir"], params=params, opt_state=opt_state,
                         hyper={"lr": lr, "betas": (cfg["beta1"], cfg["beta2"]),

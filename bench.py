@@ -1,0 +1,144 @@
+"""Throughput bench harness (SURVEY.md §2a R6 / §2b T12).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Measures the GPT-2-124M jit'd train step (forward+backward+AdamW, bf16
+compute, fp32 master params) on whatever accelerator jax sees, and reports
+tokens/sec/chip. `vs_baseline` is relative to the public nanoGPT A100
+number the north star targets (BASELINE.json:5 "≥1.0× A100
+tokens/sec/chip"): ~1.06M tokens/sec on 8×A100-40GB ≈ 132,500
+tokens/sec/GPU for the same model/optimizer in PyTorch.
+
+Usage: python bench.py [--steps=N] [--batch=N] [--block=N] [--no_pallas]
+(no pytest conftest here: this must see the REAL chip, not the 8-CPU
+test harness).
+"""
+
+import json
+import sys
+import time
+
+A100_BASELINE_TOKENS_PER_SEC_PER_CHIP = 132_500.0
+
+
+def main():
+    import jax
+    import numpy as np
+    from flax import nnx
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    args = {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
+            for a in sys.argv[1:]}
+    steps = int(args.get("steps", 10))
+    block = int(args.get("block", 1024))
+    use_pallas = "no_pallas" not in args
+    on_tpu = jax.default_backend() == "tpu"
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+    from avenir_tpu.models.common import tpu_peak_flops, transformer_flops_per_token
+    from avenir_tpu.parallel.mesh import make_mesh
+    from avenir_tpu.parallel.partition import (
+        match_partition_rules, rules_for_model, sanitize_specs,
+    )
+    from avenir_tpu.train.optimizer import make_optimizer
+    from avenir_tpu.train.step import jit_train_step, make_step_fns
+
+    if on_tpu:
+        batch_candidates = [int(args["batch"])] if "batch" in args else [16, 8, 4]
+    else:  # CPU smoke: tiny so the harness itself can be tested anywhere
+        batch_candidates = [int(args.get("batch", 2))]
+        block = min(block, 256)
+        steps = min(steps, 3)
+
+    cfg = GPTConfig(
+        block_size=block, vocab_size=50304, n_layer=12, n_head=12,
+        n_embd=768, dropout=0.0, bias=True,
+        compute_dtype="bfloat16" if on_tpu else "float32",
+        attn_impl="auto" if (use_pallas and on_tpu) else "xla",
+    )
+    mesh = make_mesh("")  # all chips on 'data'
+    n_chips = int(np.prod(list(mesh.shape.values())))
+
+    model_abs = nnx.eval_shape(lambda: GPT(cfg, rngs=nnx.Rngs(0)))
+    graphdef, abs_state = nnx.split(model_abs, nnx.Param)
+    paths = [p for p, _ in abs_state.flat_state()]
+    specs = match_partition_rules(rules_for_model("gpt"), paths)
+    shapes = {p: tuple(v.get_value().shape) for p, v in abs_state.flat_state()}
+    specs = sanitize_specs(specs, shapes, mesh)
+    shard_tree = nnx.State.from_flat_path({
+        p: v.replace(NamedSharding(mesh, specs[p]))
+        for p, v in abs_state.flat_state()
+    })
+
+    def init_fn():
+        return nnx.split(GPT(cfg, rngs=nnx.Rngs(0)), nnx.Param)[1]
+
+    params = jax.jit(init_fn, out_shardings=shard_tree)()
+    tx, _ = make_optimizer(
+        params, learning_rate=6e-4, weight_decay=0.1, beta1=0.9, beta2=0.95,
+        grad_clip=1.0, warmup_iters=10, lr_decay_iters=1000, min_lr=6e-5,
+        use_pallas=use_pallas and on_tpu,
+    )
+    opt_state = jax.jit(tx.init)(params)
+    step_fn, _ = make_step_fns(graphdef, dropout=0.0)
+    step = jit_train_step(step_fn, tx)
+    bsh = NamedSharding(mesh, P(None, ("data", "fsdp"), None))
+
+    rng = np.random.default_rng(0)
+    value = None
+    for batch in batch_candidates:
+        gb = batch * n_chips
+        x = jax.device_put(
+            rng.integers(0, 50304, (1, gb, block)).astype(np.int32), bsh)
+        y = jax.device_put(
+            rng.integers(0, 50304, (1, gb, block)).astype(np.int32), bsh)
+        try:
+            key = jax.random.key(0)
+            p, o = params, opt_state
+            for _ in range(2):  # warmup / compile
+                p, o, m = step(p, o, key, x, y)
+            # NB: a scalar host readback, not block_until_ready — on the
+            # axon-tunneled platform only a D2H transfer reliably fences
+            # the execution queue
+            float(m["loss"])
+            t0 = time.perf_counter()
+            for i in range(steps):
+                p, o, m = step(p, o, key, x, y)
+            float(m["loss"])  # fences the whole donated-state chain
+            dt = time.perf_counter() - t0
+            value = gb * block * steps / dt / n_chips
+            del p, o
+            break
+        except Exception as e:  # OOM at this batch — try smaller
+            if "RESOURCE_EXHAUSTED" not in str(e) and "Out of memory" not in str(e):
+                raise
+            params = jax.jit(init_fn, out_shardings=shard_tree)()
+            opt_state = jax.jit(tx.init)(params)
+
+    assert value is not None, "all batch sizes OOMed"
+
+    n_params = sum(int(np.prod(s)) for s in shapes.values())
+    n_params -= int(np.prod(shapes[("wpe", "embedding")]))
+    fpt = transformer_flops_per_token(n_params, cfg.n_layer, cfg.n_head,
+                                      cfg.n_embd // cfg.n_head, block)
+    mfu = value * fpt / tpu_peak_flops()
+    result = {
+        "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(value / A100_BASELINE_TOKENS_PER_SEC_PER_CHIP, 4),
+        "extra": {
+            "device": str(jax.devices()[0].device_kind),
+            "n_chips": n_chips,
+            "batch_per_chip": batch,
+            "block_size": block,
+            "mfu": round(float(mfu), 4),
+            "pallas": bool(use_pallas and on_tpu),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

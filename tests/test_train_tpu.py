@@ -169,6 +169,63 @@ def test_fsdp_hlo_contains_collectives(char_dataset):
             or "reduce-scatter" in hlo), "no collectives in FSDP HLO"
 
 
+def test_resume_restores_schedule_count(char_dataset, tmp_path):
+    """Resume must restore the LR schedule position, not just adam moments
+    — otherwise warmup silently replays (regression test for the
+    ScaleByScheduleState count)."""
+    from avenir_tpu.train.loop import run_training
+
+    out = tmp_path / "out"
+    cfg = make_cfg(char_dataset["dir"], out, max_iters=6, eval_interval=3,
+                   mesh_shape="data:1")
+    run_training(cfg)
+    cfg2 = make_cfg(char_dataset["dir"], out, max_iters=6, eval_interval=3,
+                    mesh_shape="data:1", init_from="resume")
+    # run 0 extra iters — just restore and verify counts
+    from avenir_tpu.checkpoint.io import _find_adam_state, load_checkpoint
+
+    ckpt = load_checkpoint(str(out))
+    saved_iters = ckpt["iter_num"]
+    assert saved_iters > 0
+
+    import jax
+    from flax import nnx
+
+    from avenir_tpu.checkpoint.io import restore_opt_state, restore_params
+    from avenir_tpu.parallel.mesh import make_mesh
+    from avenir_tpu.train.loop import setup_state
+    from avenir_tpu.train.optimizer import make_optimizer
+
+    mesh = make_mesh("data:1")
+    model_args = dict(ckpt["model_args"])
+    model_args["dropout"] = 0.0
+    st = setup_state(cfg2, mesh, model_args, verbose=False)
+    params = restore_params(ckpt, st["abs_state"], st["shardings"])
+    tx, _ = make_optimizer(
+        params, learning_rate=1e-3, weight_decay=0.1, beta1=0.9, beta2=0.95,
+        grad_clip=1.0, warmup_iters=2, lr_decay_iters=8, min_lr=1e-4,
+    )
+    opt_state = restore_opt_state(
+        ckpt, jax.jit(tx.init)(params), params, st["shardings"]
+    )
+    adam = _find_adam_state(opt_state)
+    assert int(adam.count) == saved_iters
+    # every count-bearing node (incl. the schedule state) agrees
+    def collect(node, acc):
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            if "count" in node._fields:
+                acc.append(int(np.asarray(node.count)))
+            for c in node:
+                collect(c, acc)
+        elif isinstance(node, tuple):
+            for c in node:
+                collect(c, acc)
+        return acc
+
+    counts = collect(opt_state, [])
+    assert counts and all(c == saved_iters for c in counts), counts
+
+
 @pytest.mark.slow
 def test_cross_backend_checkpoint_resume(char_dataset, tmp_path):
     """train 10 iters torch → resume tpu → resume torch again; loss keeps
