@@ -1,0 +1,10 @@
+"""avenir_tpu.ops.pallas — Mosaic/TPU kernels for the hot path
+(SURVEY.md §2b T6; BASELINE.json:5 mandates Pallas for the fused
+attention + AdamW hot path).
+
+Every kernel has a pure-jnp oracle in avenir_tpu/ops/*.py; tests run the
+kernels in interpret mode on CPU against those oracles (SURVEY.md §4).
+"""
+
+from avenir_tpu.ops.pallas.flash_attention import flash_attention
+from avenir_tpu.ops.pallas.rmsnorm import rmsnorm_pallas

@@ -1,0 +1,150 @@
+"""Fused AdamW as a Pallas kernel wrapped in an optax transform
+(SURVEY.md §2b T2; BASELINE.json:5 "fused attention + AdamW hot path as
+Pallas kernels / optax").
+
+One kernel pass per tensor reads (g, p, m, v) and writes (delta, m', v'),
+with the bias-corrected update computed in-register — vs the chain of
+elementwise HLOs optax emits. Semantics are exactly optax.adamw
+(b1/b2/eps, decoupled weight decay, mask) — verified against it in
+tests/test_pallas_kernels.py.
+
+Tensors are flattened and padded to (rows, 128) lanes; the grid streams
+row blocks through VMEM.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+BLOCK_ROWS = 512
+
+
+def _adamw_kernel(g_ref, p_ref, m_ref, v_ref, sc_ref,
+                  delta_ref, m_out_ref, v_out_ref):
+    """sc_ref (SMEM): [lr, b1, b2, eps, wd, bc1, bc2] fp32 scalars."""
+    lr = sc_ref[0]
+    b1 = sc_ref[1]
+    b2 = sc_ref[2]
+    eps = sc_ref[3]
+    wd = sc_ref[4]
+    bc1 = sc_ref[5]  # 1 / (1 - b1^t)
+    bc2 = sc_ref[6]  # 1 / (1 - b2^t)
+    g = g_ref[...].astype(jnp.float32)
+    p = p_ref[...].astype(jnp.float32)
+    m = m_ref[...]
+    v = v_ref[...]
+    m_new = b1 * m + (1.0 - b1) * g
+    v_new = b2 * v + (1.0 - b2) * g * g
+    m_hat = m_new * bc1
+    v_hat = v_new * bc2
+    update = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p
+    delta_ref[...] = (-lr * update).astype(delta_ref.dtype)
+    m_out_ref[...] = m_new
+    v_out_ref[...] = v_new
+
+
+def _pad_rows(flat, rows):
+    pad = rows * LANES - flat.shape[0]
+    return jnp.pad(flat, (0, pad)) if pad else flat
+
+
+def _fused_update_one(g, p, m, v, scalars, interpret):
+    n = g.size
+    rows = -(-n // LANES)
+    block = min(BLOCK_ROWS, rows)
+    rows_padded = -(-rows // block) * block
+
+    def shape2(x):
+        return _pad_rows(x.reshape(-1), rows_padded).reshape(rows_padded, LANES)
+
+    g2, p2, m2, v2 = (shape2(x) for x in (g, p, m, v))
+    delta, m_new, v_new = pl.pallas_call(
+        _adamw_kernel,
+        grid=(rows_padded // block,),
+        in_specs=[
+            pl.BlockSpec((block, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((block, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((block, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((block, LANES), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # scalars, whole array
+        ],
+        out_specs=[
+            pl.BlockSpec((block, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((block, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((block, LANES), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows_padded, LANES), p.dtype),
+            jax.ShapeDtypeStruct((rows_padded, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((rows_padded, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(g2, p2, m2, v2, scalars)
+
+    def unshape(x2, dtype):
+        return x2.reshape(-1)[:n].reshape(g.shape).astype(dtype)
+
+    return (unshape(delta, p.dtype), unshape(m_new, jnp.float32),
+            unshape(v_new, jnp.float32))
+
+
+def fused_adamw(learning_rate, b1=0.9, b2=0.999, eps=1e-8,
+                weight_decay=0.0, mask=None, interpret=False):
+    """optax.GradientTransformation with the update math in one Pallas
+    kernel per tensor. `learning_rate` may be a schedule or float;
+    `mask` is a pytree of bools — True leaves get weight decay."""
+
+    def init(params):
+        zeros = lambda p: jnp.zeros(jnp.shape(p), jnp.float32)
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree.map(zeros, params),
+            nu=jax.tree.map(zeros, params),
+        )
+
+    def update(grads, state, params=None):
+        assert params is not None, "fused_adamw needs params (weight decay)"
+        count = optax.safe_int32_increment(state.count)
+        lr = learning_rate(state.count) if callable(learning_rate) else learning_rate
+        t = count.astype(jnp.float32)
+        bc1 = 1.0 / (1.0 - jnp.power(b1, t))
+        bc2 = 1.0 / (1.0 - jnp.power(b2, t))
+
+        mask_tree = (
+            mask if mask is not None
+            else jax.tree.map(lambda _: True, params)
+        )
+
+        flat_g, treedef = jax.tree.flatten(grads)
+        flat_p = treedef.flatten_up_to(params)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_v = treedef.flatten_up_to(state.nu)
+        flat_mask = treedef.flatten_up_to(mask_tree)
+
+        deltas, mus, nus = [], [], []
+        for g, p, m, v, use_wd in zip(flat_g, flat_p, flat_m, flat_v,
+                                      flat_mask):
+            wd = weight_decay if use_wd else 0.0
+            scalars = jnp.stack([
+                jnp.asarray(lr, jnp.float32),
+                jnp.float32(b1), jnp.float32(b2), jnp.float32(eps),
+                jnp.float32(wd), bc1, bc2,
+            ])
+            d, mn, vn = _fused_update_one(g, p, m, v, scalars, interpret)
+            deltas.append(d)
+            mus.append(mn)
+            nus.append(vn)
+
+        new_state = optax.ScaleByAdamState(
+            count=count,
+            mu=jax.tree.unflatten(treedef, mus),
+            nu=jax.tree.unflatten(treedef, nus),
+        )
+        return jax.tree.unflatten(treedef, deltas), new_state
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,335 @@
+"""Blockwise causal flash attention for TPU (fwd + bwd), SURVEY.md §2b T6.
+
+Design (classic FlashAttention-2 shape, written for the TPU memory
+hierarchy — this is the largest in-repo kernel, §7 "hard parts"):
+
+  - public layout (B, T, H, D) — transposed to (B, H, T, D) so the block's
+    trailing dims (T, D) map onto (sublane, lane) tiles
+  - grid (B, H, T/block): each program owns one q (or kv) stripe in VMEM;
+    the opposing sequence streams through `pl.ds` slices of a
+    whole-sequence VMEM block
+  - online softmax in fp32 carried through `lax.fori_loop` (running max m,
+    normalizer l, accumulator acc); MXU matmuls take bf16 inputs with
+    preferred_element_type=fp32
+  - causal BLOCK SKIPPING: the kv loop stops at the diagonal, halving the
+    work vs masked dense attention; within the diagonal block a
+    broadcasted-iota mask applies
+  - backward = two kernels (no atomics): dq gridded over q blocks, dk/dv
+    gridded over kv blocks, both recomputing p from the saved logsumexp
+  - padding: sequences are padded to the block size; padded kv columns are
+    masked with -1e30 (finite, so fully-padded q rows stay NaN-free and
+    are sliced away by the wrapper)
+
+Semantics match ops.attention.causal_attention_reference (the oracle used
+by tests/test_pallas_kernels.py).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
+                causal, sm_scale, seq_len):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0]  # (BQ, D) input dtype
+    kv_len = k_ref.shape[2]
+    nk_total = kv_len // block_k
+    if causal:
+        # block skipping: only kv blocks touching the lower triangle
+        nk = jnp.minimum(
+            ((qi + 1) * block_q + block_k - 1) // block_k, nk_total
+        )
+    else:
+        nk = nk_total
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]  # (BK, D)
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # (BQ, BK)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_pos < seq_len
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, m_new, l
+
+    acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l)  # (BQ, 1)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               block_q, block_k, causal, sm_scale, seq_len):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0]
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]  # (BQ, 1)
+    delta = delta_ref[0, 0]
+    kv_len = k_ref.shape[2]
+    nk_total = kv_len // block_k
+    nk = (
+        jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, nk_total)
+        if causal else nk_total
+    )
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_pos < seq_len
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)  # (BQ, BK), masked entries ~0
+        dp = jax.lax.dot_general(
+            do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * sm_scale
+        dq = dq + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dq
+
+    dq = jax.lax.fori_loop(
+        0, nk, body, jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    )
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, block_q, block_k, causal, sm_scale,
+                seq_len):
+    ki = pl.program_id(2)
+    k = k_ref[0, 0]  # (BK, D)
+    v = v_ref[0, 0]
+    q_len = q_ref.shape[2]
+    nq_total = q_len // block_q
+    # causal: the first q block that can see this kv block
+    i0 = (ki * block_k) // block_q if causal else 0
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), :]  # (BQ, 1)
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        mask = k_pos < seq_len
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)  # (BQ, BK)
+        dv = dv + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * sm_scale
+        dk = dk + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    D = k.shape[1]
+    dk0 = jnp.zeros((block_k, D), jnp.float32)
+    dv0 = jnp.zeros((block_k, D), jnp.float32)
+    dk, dv = jax.lax.fori_loop(i0, nq_total, body, (dk0, dv0))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _pad_to(x, t_target, axis=2):
+    pad = t_target - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _make_fwd(seq_len):
+    def fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+        B, H, Tp, D = q.shape
+        nq = Tp // block_q
+        kernel = functools.partial(
+            _fwd_kernel, block_q=block_q, block_k=block_k, causal=causal,
+            sm_scale=sm_scale, seq_len=seq_len,
+        )
+        o, lse = pl.pallas_call(
+            kernel,
+            grid=(B, H, nq),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, Tp, D), lambda b, h, i: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, Tp, D), lambda b, h, i: (b, h, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, H, Tp, D), q.dtype),
+                jax.ShapeDtypeStruct((B, H, Tp, 1), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v)
+        return o, lse
+
+    return fwd
+
+
+def _make_bwd(seq_len):
+    def bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
+            interpret):
+        B, H, Tp, D = q.shape
+        nq, nk = Tp // block_q, Tp // block_k
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+            keepdims=True,
+        )  # (B, H, Tp, 1)
+
+        dq = pl.pallas_call(
+            functools.partial(
+                _dq_kernel, block_q=block_q, block_k=block_k, causal=causal,
+                sm_scale=sm_scale, seq_len=seq_len,
+            ),
+            grid=(B, H, nq),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, Tp, D), lambda b, h, i: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, Tp, D), lambda b, h, i: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)
+            ),
+            out_shape=jax.ShapeDtypeStruct((B, H, Tp, D), q.dtype),
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _dkv_kernel, block_q=block_q, block_k=block_k, causal=causal,
+                sm_scale=sm_scale, seq_len=seq_len,
+            ),
+            grid=(B, H, nk),
+            in_specs=[
+                pl.BlockSpec((1, 1, Tp, D), lambda b, h, j: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, Tp, D), lambda b, h, j: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, Tp, 1), lambda b, h, j: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, Tp, 1), lambda b, h, j: (b, h, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, H, Tp, D), k.dtype),
+                jax.ShapeDtypeStruct((B, H, Tp, D), v.dtype),
+            ],
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+        return dq, dk, dv
+
+    return bwd
+
+
+@functools.lru_cache(maxsize=64)
+def _build_flash(seq_len, causal, sm_scale, block_q, block_k, interpret):
+    """One custom_vjp per static config (lru so jit retrace reuses it)."""
+    fwd_impl = _make_fwd(seq_len)
+    bwd_impl = _make_bwd(seq_len)
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        o, _ = fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                        interpret)
+        return o
+
+    def f_fwd(q, k, v):
+        o, lse = fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret)
+        return o, (q, k, v, o, lse)
+
+    def f_bwd(res, do):
+        q, k, v, o, lse = res
+        return bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q,
+                        block_k, interpret)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def flash_attention(q, k, v, *, causal=True, sm_scale=None, block_q=128,
+                    block_k=128, interpret=False):
+    """Flash attention, public layout (B, T, H, D). K/V must already be
+    repeated to Q's head count (ops.attention handles GQA)."""
+    B, T, H, D = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    block_q = min(block_q, max(T, 1))
+    block_k = min(block_k, max(T, 1))
+    Tp = -(-T // max(block_q, block_k)) * max(block_q, block_k)
+
+    qt = _pad_to(q.transpose(0, 2, 1, 3), Tp)
+    kt = _pad_to(k.transpose(0, 2, 1, 3), Tp)
+    vt = _pad_to(v.transpose(0, 2, 1, 3), Tp)
+    f = _build_flash(T, causal, float(sm_scale), block_q, block_k, interpret)
+    o = f(qt, kt, vt)
+    return o[:, :, :T, :].transpose(0, 2, 1, 3)

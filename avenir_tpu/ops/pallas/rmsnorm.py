@@ -1,0 +1,113 @@
+"""RMSNorm Pallas kernel (fwd + dx bwd), SURVEY.md §2b T6.
+
+Rows stream through VMEM in (block_rows, D) tiles; normalization runs in
+fp32. The backward splits work by bandwidth profile: dx (row-local) is a
+kernel, dw (a cross-row reduction) is one jnp einsum XLA handles well.
+
+Math (oracle: ops.rmsnorm.rmsnorm_reference):
+  inv = rsqrt(mean(x^2) + eps);  y = x * inv * w
+  dx  = inv * (w*dy) - x * inv^3 * mean(w*dy*x)
+  dw  = sum_rows(dy * x * inv)
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _fwd_kernel(x_ref, w_ref, y_ref, inv_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)  # (R, D)
+    inv = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    w = w_ref[...].astype(jnp.float32)
+    y_ref[...] = (x * inv * w).astype(y_ref.dtype)
+    inv_ref[...] = inv[:, 0]
+
+
+def _dx_kernel(x_ref, w_ref, dy_ref, inv_ref, dx_ref):
+    x = x_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    inv = inv_ref[...][:, None]  # (R, 1)
+    wdy = w * dy
+    mean_term = jnp.mean(wdy * x, axis=-1, keepdims=True)
+    dx = inv * wdy - x * (inv ** 3) * mean_term
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _choose_rows(n_rows):
+    for r in (256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if n_rows % r == 0:
+            return r
+    return 1
+
+
+def _fwd_call(x2, w, eps, interpret):
+    N, D = x2.shape
+    R = _choose_rows(N)
+    y, inv = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=(N // R,),
+        in_specs=[
+            pl.BlockSpec((R, D), lambda i: (i, 0)),
+            pl.BlockSpec((1, D), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((R, D), lambda i: (i, 0)),
+            pl.BlockSpec((R,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, D), x2.dtype),
+            jax.ShapeDtypeStruct((N,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, w[None, :])
+    return y, inv
+
+
+@functools.lru_cache(maxsize=8)
+def _build(eps, interpret):
+    @jax.custom_vjp
+    def f(x2, w):
+        y, _ = _fwd_call(x2, w, eps, interpret)
+        return y
+
+    def f_fwd(x2, w):
+        y, inv = _fwd_call(x2, w, eps, interpret)
+        return y, (x2, w, inv)
+
+    def f_bwd(res, dy):
+        x2, w, inv = res
+        N, D = x2.shape
+        R = _choose_rows(N)
+        dx = pl.pallas_call(
+            _dx_kernel,
+            grid=(N // R,),
+            in_specs=[
+                pl.BlockSpec((R, D), lambda i: (i, 0)),
+                pl.BlockSpec((1, D), lambda i: (0, 0)),
+                pl.BlockSpec((R, D), lambda i: (i, 0)),
+                pl.BlockSpec((R,), lambda i: (i,)),
+            ],
+            out_specs=pl.BlockSpec((R, D), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((N, D), x2.dtype),
+            interpret=interpret,
+        )(x2, w[None, :], dy, inv)
+        # dw: cross-row reduction — one fused XLA contraction
+        dw = jnp.einsum(
+            "nd,nd,n->d",
+            dy.astype(jnp.float32), x2.astype(jnp.float32), inv,
+        ).astype(w.dtype)
+        return dx, dw
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def rmsnorm_pallas(x, weight, eps=1e-5, interpret=False):
+    """x: (..., D); weight: (D,)."""
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    y = _build(float(eps), interpret)(x2, weight)
+    return y.reshape(shape)

@@ -178,7 +178,7 @@ def run_training(cfg):
         beta1=cfg["beta1"], beta2=cfg["beta2"], grad_clip=cfg["grad_clip"],
         warmup_iters=cfg["warmup_iters"], lr_decay_iters=cfg["lr_decay_iters"],
         min_lr=cfg["min_lr"], decay_lr=cfg["decay_lr"],
-        use_pallas=cfg["use_pallas"],
+        use_pallas=cfg.get("fused_adamw", False),
     )
 
     def init_opt(p):

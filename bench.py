@@ -10,9 +10,12 @@ number the north star targets (BASELINE.json:5 "≥1.0× A100
 tokens/sec/chip"): ~1.06M tokens/sec on 8×A100-40GB ≈ 132,500
 tokens/sec/GPU for the same model/optimizer in PyTorch.
 
-Usage: python bench.py [--steps=N] [--batch=N] [--block=N] [--no_pallas]
-(no pytest conftest here: this must see the REAL chip, not the 8-CPU
-test harness).
+Usage:
+  python bench.py [--steps=N] [--batch=N] [--block=N]
+                  [--attn=pallas|xla] [--opt=pallas|optax] [--no_pallas]
+--no_pallas forces XLA attention; --attn overrides it explicitly. The
+fused-AdamW kernel is opt-in via --opt=pallas (TPU only). (No pytest
+conftest here: this must see the REAL chip, not the 8-CPU test harness.)
 """
 
 import json
@@ -33,6 +36,8 @@ def main():
     steps = int(args.get("steps", 10))
     block = int(args.get("block", 1024))
     use_pallas = "no_pallas" not in args
+    attn_impl_flag = args.get("attn", "")   # '', 'pallas', 'xla'
+    opt_flag = args.get("opt", "")          # '', 'pallas', 'optax'
     on_tpu = jax.default_backend() == "tpu"
 
     from avenir_tpu.models.gpt import GPT, GPTConfig
@@ -51,11 +56,26 @@ def main():
         block = min(block, 256)
         steps = min(steps, 3)
 
+    # resolve the attention impl HERE (not 'auto') so the result JSON
+    # records what actually ran — a silent xla fallback must be visible
+    attn_impl = attn_impl_flag
+    if not attn_impl:
+        attn_impl = "xla"
+        if use_pallas and on_tpu:
+            try:
+                from avenir_tpu.ops.pallas import flash_attention  # noqa: F401
+
+                attn_impl = "pallas"
+            except ImportError:
+                pass
+    # fused-AdamW kernel is opt-in (--opt=pallas, TPU only): measured
+    # slower than XLA-fused optax on v5e (62.6k vs 70.5k tok/s)
+    use_pallas_opt = opt_flag == "pallas" and on_tpu
     cfg = GPTConfig(
         block_size=block, vocab_size=50304, n_layer=12, n_head=12,
         n_embd=768, dropout=0.0, bias=True,
         compute_dtype="bfloat16" if on_tpu else "float32",
-        attn_impl="auto" if (use_pallas and on_tpu) else "xla",
+        attn_impl=attn_impl,
     )
     mesh = make_mesh("")  # all chips on 'data'
     n_chips = int(np.prod(list(mesh.shape.values())))
@@ -78,7 +98,7 @@ def main():
     tx, _ = make_optimizer(
         params, learning_rate=6e-4, weight_decay=0.1, beta1=0.9, beta2=0.95,
         grad_clip=1.0, warmup_iters=10, lr_decay_iters=1000, min_lr=6e-5,
-        use_pallas=use_pallas and on_tpu,
+        use_pallas=use_pallas_opt,
     )
     opt_state = jax.jit(tx.init)(params)
     step_fn, _ = make_step_fns(graphdef, dropout=0.0)
@@ -134,7 +154,8 @@ def main():
             "batch_per_chip": batch,
             "block_size": block,
             "mfu": round(float(mfu), 4),
-            "pallas": bool(use_pallas and on_tpu),
+            "attn": attn_impl,
+            "opt_pallas": bool(use_pallas_opt),
         },
     }
     print(json.dumps(result))

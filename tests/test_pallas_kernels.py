@@ -1,0 +1,136 @@
+"""Pallas kernel vs jnp-oracle tests (SURVEY.md §4 "Unit: kernels"):
+interpret=True runs the kernels on CPU with identical semantics to the
+Mosaic compilation, so fwd AND grads are checked without TPU hardware."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.ops.attention import causal_attention_reference
+from avenir_tpu.ops.pallas.flash_attention import flash_attention
+from avenir_tpu.ops.pallas.rmsnorm import rmsnorm_pallas
+from avenir_tpu.ops.rmsnorm import rmsnorm_reference
+
+
+def _qkv(B=2, T=128, H=2, D=64, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    shape = (B, T, H, D)
+    q = jax.random.normal(ks[0], shape, dtype)
+    k = jax.random.normal(ks[1], shape, dtype)
+    v = jax.random.normal(ks[2], shape, dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("T,block", [(128, 64), (96, 64), (256, 128)])
+def test_flash_attention_forward(T, block):
+    q, k, v = _qkv(T=T)
+    out = flash_attention(q, k, v, causal=True, block_q=block, block_k=block,
+                          interpret=True)
+    ref = causal_attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_grads():
+    q, k, v = _qkv(T=128)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                            interpret=True)
+        return jnp.sum(o * o)
+
+    def loss_ref(q, k, v):
+        o = causal_attention_reference(q, k, v)
+        return jnp.sum(o * o)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-5, rtol=5e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_flash_attention_bf16_close_to_fp32_oracle():
+    q, k, v = _qkv(T=128, dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                          interpret=True)
+    ref = causal_attention_reference(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=3e-2, rtol=3e-2
+    )
+
+
+def test_flash_attention_padding_mask():
+    """T not a multiple of the block: padded kv columns must not leak."""
+    q, k, v = _qkv(T=100)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                          interpret=True)
+    ref = causal_attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_rmsnorm_forward_and_grads():
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (4, 96, 64), jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (64,)) * 0.1 + 1.0
+
+    out = rmsnorm_pallas(x, w, interpret=True)
+    ref = rmsnorm_reference(x, w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-6, rtol=1e-6)
+
+    def loss_k(x, w):
+        return jnp.sum(jnp.sin(rmsnorm_pallas(x, w, interpret=True)))
+
+    def loss_r(x, w):
+        return jnp.sum(jnp.sin(rmsnorm_reference(x, w)))
+
+    gx_k, gw_k = jax.grad(loss_k, argnums=(0, 1))(x, w)
+    gx_r, gw_r = jax.grad(loss_r, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx_k), np.asarray(gx_r),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw_k), np.asarray(gw_r),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_fused_adamw_matches_optax():
+    from avenir_tpu.ops.pallas.adamw import fused_adamw
+
+    params = {
+        "w": jnp.asarray(np.random.default_rng(0).normal(size=(33, 17)),
+                         jnp.float32),
+        "b": jnp.asarray(np.random.default_rng(1).normal(size=(7,)),
+                         jnp.float32),
+    }
+    mask = {"w": True, "b": False}
+    import optax
+
+    sched = optax.linear_schedule(1e-2, 1e-3, 10)
+    ours = fused_adamw(sched, b1=0.9, b2=0.95, eps=1e-8, weight_decay=0.1,
+                       mask=mask, interpret=True)
+    ref = optax.adamw(sched, b1=0.9, b2=0.95, eps=1e-8, weight_decay=0.1,
+                      mask=mask)
+
+    s_ours, s_ref = ours.init(params), ref.init(params)
+    p_ours = p_ref = params
+    for i in range(4):
+        g = jax.tree.map(
+            lambda p: jnp.asarray(
+                np.random.default_rng(10 + i).normal(size=p.shape), jnp.float32
+            ),
+            params,
+        )
+        u_o, s_ours = ours.update(g, s_ours, p_ours)
+        p_ours = optax.apply_updates(p_ours, u_o)
+        u_r, s_ref = ref.update(g, s_ref, p_ref)
+        p_ref = optax.apply_updates(p_ref, u_r)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p_ours[k]), np.asarray(p_ref[k]),
+                                   atol=1e-6, rtol=1e-5, err_msg=k)

@@ -30,7 +30,8 @@ def make_cfg(data_dir, out_dir, **over):
         beta2=0.95, grad_clip=1.0, decay_lr=True, warmup_iters=2,
         lr_decay_iters=8, min_lr=1e-4, backend="tpu", device="cpu",
         dtype="float32", compile=False, seed=1337, mesh_shape="",
-        remat=False, scan_layers=False, use_pallas=False, profile=False,
+        remat=False, scan_layers=False, use_pallas=False, fused_adamw=False,
+        profile=False,
     )
     cfg.update(over)
     return cfg

@@ -78,7 +78,8 @@ seed = 1337
 mesh_shape = ""  # e.g. "data:4,fsdp:2"; "" → all devices on 'data'
 remat = False  # rematerialize blocks (activation checkpointing)
 scan_layers = False  # lax.scan over blocks (fast compiles for deep models)
-use_pallas = True  # pallas kernels on TPU hot path (auto-falls back off-TPU)
+use_pallas = True  # pallas flash attention on TPU (auto-falls back off-TPU)
+fused_adamw = False  # pallas fused-AdamW (XLA-fused optax is faster on v5e; kept for pods)
 profile = False  # capture a jax.profiler trace window
 # -----------------------------------------------------------------------------
 from configurator import configure
