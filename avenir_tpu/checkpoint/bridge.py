@@ -89,10 +89,56 @@ def nnx_path_to_torch_key(path, model_family="gpt"):
     return ".".join(str(p) for p in ([prefix] + parts)), transpose
 
 
+_EXPERT_RE = __import__("re").compile(
+    r"^(?P<pre>.*\bexperts)\.(?P<idx>\d+)\.(?P<w>w[123])\.weight$"
+)
+
+
 def _as_state(model_or_state):
     if isinstance(model_or_state, nnx.Module):
         return nnx.state(model_or_state, nnx.Param)
     return model_or_state
+
+
+def _stack_expert_keys(sd):
+    """HF Mixtral stores one 2-D tensor per expert
+    (…block_sparse_moe.experts.N.w1.weight, (out, in)); our model stacks
+    them as (E, in, out). Group, transpose last two dims, stack — and
+    return the remaining plain entries untouched."""
+    groups, rest = {}, {}
+    for key, arr in sd.items():
+        m = _EXPERT_RE.match(key)
+        if not m:
+            rest[key] = arr
+            continue
+        gkey = (m.group("pre"), m.group("w"))
+        groups.setdefault(gkey, {})[int(m.group("idx"))] = np.asarray(arr)
+    stacked = {}
+    for (pre, w), by_idx in groups.items():
+        arrs = [np.swapaxes(by_idx[i], -1, -2) for i in range(len(by_idx))]
+        parts = pre.split(".")
+        if parts[0] in ("transformer", "model"):
+            parts = parts[1:]
+        path = tuple(int(p) if p.isdigit() else p for p in parts) + (w,)
+        stacked[path] = np.stack(arrs)
+    return stacked, rest
+
+
+def torch_sd_to_flat_paths(sd, tied_lm_head=True):
+    """{torch key: array} → {nnx path: correctly-laid-out numpy array}
+    (transposes applied, per-expert tensors stacked, tied aliases dropped).
+    Shared by in-place loading and sharded checkpoint restore."""
+    stacked, rest = _stack_expert_keys(sd)
+    out = dict(stacked)
+    for key, arr in rest.items():
+        path, transpose = torch_key_to_nnx_path(key, tied_lm_head=tied_lm_head)
+        if path is None:
+            continue  # tied weight
+        arr = np.asarray(arr)
+        if transpose:
+            arr = np.swapaxes(arr, -1, -2)
+        out[path] = arr
+    return out
 
 
 def load_torch_state_dict(model, sd, strict=True, tied_lm_head=True):
@@ -102,24 +148,17 @@ def load_torch_state_dict(model, sd, strict=True, tied_lm_head=True):
     state = nnx.state(model, nnx.Param)
     flat = {path: v for path, v in state.flat_state()}
     seen = set()
-    for key, arr in sd.items():
-        path, transpose = torch_key_to_nnx_path(key, tied_lm_head=tied_lm_head)
-        if path is None:
-            continue  # tied weight
+    for path, arr in torch_sd_to_flat_paths(sd, tied_lm_head).items():
         if path not in flat:
             if strict:
                 raise KeyError(
-                    f"torch key {key!r} maps to nnx path {path!r} "
-                    f"which does not exist in the model"
+                    f"state_dict path {path!r} does not exist in the model"
                 )
             continue
-        arr = np.asarray(arr)
-        if transpose:
-            arr = arr.T
         var = flat[path]
         expected = var.get_value().shape
         assert arr.shape == tuple(expected), (
-            f"{key}: shape {arr.shape} != model {tuple(expected)}"
+            f"{path}: shape {arr.shape} != model {tuple(expected)}"
         )
         var.set_value(arr.astype(np.asarray(var.get_value()).dtype))
         seen.add(path)
@@ -141,10 +180,19 @@ def export_torch_state_dict(model, model_family="gpt", tied_lm_head=True):
     params, or an optimizer-moment tree with the same structure)."""
     state = _as_state(model)
     sd = {}
+    prefix = "transformer" if model_family == "gpt" else "model"
     for path, var in state.flat_state():
-        key, transpose = nnx_path_to_torch_key(path, model_family=model_family)
         arr = np.asarray(var.get_value())
-        sd[key] = arr.T if transpose else arr
+        if path[-1] in ("w1", "w2", "w3") and "experts" in path:
+            # stacked (E, in, out) → HF per-expert (out, in) tensors
+            base = ".".join(str(p) for p in ([prefix] + list(path[:-1])))
+            for e in range(arr.shape[0]):
+                sd[f"{base}.{e}.{path[-1]}.weight"] = np.swapaxes(
+                    arr[e], -1, -2
+                )
+            continue
+        key, transpose = nnx_path_to_torch_key(path, model_family=model_family)
+        sd[key] = np.swapaxes(arr, -1, -2) if transpose else arr
     if tied_lm_head:
         wte_key = (
             "transformer.wte.weight" if model_family == "gpt"

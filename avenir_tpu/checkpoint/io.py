@@ -16,6 +16,7 @@ from flax import nnx
 from avenir_tpu.checkpoint.bridge import (
     export_torch_state_dict,
     torch_key_to_nnx_path,
+    torch_sd_to_flat_paths,
 )
 from avenir_tpu.checkpoint.torch_pt import load_pt, save_pt
 
@@ -189,16 +190,12 @@ def restore_params(ckpt, abs_state, shardings, model_family="gpt"):
     sd = _strip_compile_prefix(dict(ckpt["model"]))
     flat = {p: v for p, v in abs_state.flat_state()}
     out = {}
-    for key, arr in sd.items():
-        path, transpose = torch_key_to_nnx_path(key, tied_lm_head=_tied(model_family))
-        if path is None:
-            continue
-        assert path in flat, f"checkpoint key {key} → {path} not in model"
-        a = np.asarray(arr)
-        if transpose:
-            a = np.ascontiguousarray(a.T)
+    for path, a in torch_sd_to_flat_paths(
+        sd, tied_lm_head=_tied(model_family)
+    ).items():
+        assert path in flat, f"checkpoint path {path} not in model"
         var = flat[path]
-        a = a.astype(var.get_value().dtype)
+        a = np.ascontiguousarray(a).astype(var.get_value().dtype)
         out[path] = var.replace(jax.device_put(a, shardings[path]))
     missing = set(flat) - set(out)
     assert not missing, f"checkpoint missing params: {sorted(missing)}"
@@ -252,18 +249,14 @@ def restore_opt_state(ckpt, opt_state, params, param_shardings,
     else:  # avenir_adamw schema (llama/mixtral)
         assert opt_entry.get("format") == "avenir_adamw", opt_entry.keys()
         step = float(opt_entry["step"])
-        for key, a in opt_entry["exp_avg"].items():
-            path, transpose = torch_key_to_nnx_path(key, tied_lm_head=False)
-            a = np.asarray(a, np.float32)
-            mu_flat[path] = jax.device_put(
-                np.ascontiguousarray(a.T) if transpose else a, flat_shard[path]
-            )
-        for key, a in opt_entry["exp_avg_sq"].items():
-            path, transpose = torch_key_to_nnx_path(key, tied_lm_head=False)
-            a = np.asarray(a, np.float32)
-            nu_flat[path] = jax.device_put(
-                np.ascontiguousarray(a.T) if transpose else a, flat_shard[path]
-            )
+        for src_name, dst in (("exp_avg", mu_flat), ("exp_avg_sq", nu_flat)):
+            for path, a in torch_sd_to_flat_paths(
+                opt_entry[src_name], tied_lm_head=False
+            ).items():
+                dst[path] = jax.device_put(
+                    np.ascontiguousarray(a).astype(np.float32),
+                    flat_shard[path],
+                )
 
     pflat = {p: v for p, v in params.flat_state()}
     mu = nnx.State.from_flat_path(

@@ -1,0 +1,205 @@
+"""Llama-3-family decoder in flax nnx (SURVEY.md §2b T9; BASELINE.json:10
+"Llama-3 8B (RoPE + SwiGLU + RMSNorm → Pallas kernels)").
+
+Structure and parameter names mirror the HF Llama convention
+(model.embed_tokens / model.layers.N.self_attn.{q,k,v,o}_proj /
+mlp.{gate,up,down}_proj / input_layernorm / post_attention_layernorm /
+model.norm / lm_head) so the checkpoint bridge's name rules apply
+unchanged; tests/test_llama.py pins logits parity against
+transformers' torch LlamaForCausalLM on shared random weights.
+
+TPU notes: GQA runs through ops.causal_attention (Pallas flash kernel —
+KV heads repeated to Q heads before the kernel), RMSNorm through the
+ops dispatch (Pallas on TPU), RoPE tables are trace-time constants XLA
+folds. lm_head is UNTIED (Llama-3 convention)."""
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from avenir_tpu.models.common import cross_entropy_loss, resolve_dtype
+from avenir_tpu.ops import apply_rope, causal_attention, rope_frequencies, swiglu
+from avenir_tpu.ops.rmsnorm import rmsnorm
+
+
+def default_ffn_hidden(n_embd):
+    """Llama-style 2/3·4d feed-forward width, rounded up to 256."""
+    h = int(8 * n_embd / 3)
+    return -(-h // 256) * 256
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    block_size: int = 8192
+    vocab_size: int = 128256
+    n_layer: int = 32
+    n_head: int = 32
+    n_kv_head: int = 8
+    n_embd: int = 4096
+    ffn_hidden: int = 14336
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dropout: float = 0.0  # unused (Llama trains without dropout); kept for CLI parity
+    compute_dtype: str = "float32"
+    attn_impl: str = "auto"
+    remat: bool = False
+
+    @classmethod
+    def from_train_config(cls, cfg, model_args):
+        n_kv = cfg.get("n_kv_head", 0) or model_args["n_head"]
+        ffn = cfg.get("ffn_hidden", 0) or default_ffn_hidden(model_args["n_embd"])
+        return cls(
+            block_size=model_args["block_size"],
+            vocab_size=model_args["vocab_size"],
+            n_layer=model_args["n_layer"], n_head=model_args["n_head"],
+            n_kv_head=n_kv, n_embd=model_args["n_embd"], ffn_hidden=ffn,
+            rope_theta=cfg.get("rope_theta", 500000.0),
+            compute_dtype=("float32" if cfg["dtype"] == "float16" else cfg["dtype"]),
+            attn_impl=("auto" if cfg["use_pallas"] else "xla"),
+            remat=cfg["remat"],
+        )
+
+
+class RMSNorm(nnx.Module):
+    def __init__(self, dim, *, eps, rngs):
+        self.scale = nnx.Param(jnp.ones((dim,), jnp.float32))
+        self.eps = eps
+
+    def __call__(self, x):
+        return rmsnorm(x, self.scale.get_value(), eps=self.eps)
+
+
+class LlamaAttention(nnx.Module):
+    def __init__(self, config: LlamaConfig, *, rngs):
+        assert config.n_embd % config.n_head == 0
+        assert config.n_head % config.n_kv_head == 0
+        cdtype = resolve_dtype(config.compute_dtype)
+        hd = config.n_embd // config.n_head
+        init = nnx.initializers.normal(stddev=0.02)
+        o_init = nnx.initializers.normal(
+            stddev=0.02 / math.sqrt(2 * config.n_layer)
+        )
+        lin = lambda i, o, ini: nnx.Linear(
+            i, o, use_bias=False, kernel_init=ini,
+            dtype=cdtype, param_dtype=jnp.float32, rngs=rngs,
+        )
+        self.q_proj = lin(config.n_embd, config.n_head * hd, init)
+        self.k_proj = lin(config.n_embd, config.n_kv_head * hd, init)
+        self.v_proj = lin(config.n_embd, config.n_kv_head * hd, init)
+        self.o_proj = lin(config.n_head * hd, config.n_embd, o_init)
+        self.n_head = config.n_head
+        self.n_kv_head = config.n_kv_head
+        self.head_dim = hd
+        self.rope_theta = config.rope_theta
+        self.max_t = config.block_size
+        self.attn_impl = config.attn_impl
+
+    def __call__(self, x, positions=None):
+        B, T, C = x.shape
+        q = self.q_proj(x).reshape(B, T, self.n_head, self.head_dim)
+        k = self.k_proj(x).reshape(B, T, self.n_kv_head, self.head_dim)
+        v = self.v_proj(x).reshape(B, T, self.n_kv_head, self.head_dim)
+        cos, sin = rope_frequencies(self.head_dim, self.max_t, self.rope_theta)
+        q = apply_rope(q, cos, sin, positions=positions)
+        k = apply_rope(k, cos, sin, positions=positions)
+        y = causal_attention(q, k, v, impl=self.attn_impl)
+        return self.o_proj(y.reshape(B, T, self.n_head * self.head_dim))
+
+
+class LlamaMLP(nnx.Module):
+    def __init__(self, config: LlamaConfig, *, rngs):
+        cdtype = resolve_dtype(config.compute_dtype)
+        init = nnx.initializers.normal(stddev=0.02)
+        d_init = nnx.initializers.normal(
+            stddev=0.02 / math.sqrt(2 * config.n_layer)
+        )
+        lin = lambda i, o, ini: nnx.Linear(
+            i, o, use_bias=False, kernel_init=ini,
+            dtype=cdtype, param_dtype=jnp.float32, rngs=rngs,
+        )
+        self.gate_proj = lin(config.n_embd, config.ffn_hidden, init)
+        self.up_proj = lin(config.n_embd, config.ffn_hidden, init)
+        self.down_proj = lin(config.ffn_hidden, config.n_embd, d_init)
+
+    def __call__(self, x):
+        return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(nnx.Module):
+    def __init__(self, config: LlamaConfig, *, rngs):
+        self.input_layernorm = RMSNorm(config.n_embd, eps=config.norm_eps,
+                                       rngs=rngs)
+        self.self_attn = LlamaAttention(config, rngs=rngs)
+        self.post_attention_layernorm = RMSNorm(
+            config.n_embd, eps=config.norm_eps, rngs=rngs
+        )
+        self.mlp = LlamaMLP(config, rngs=rngs)
+        self._cdtype = resolve_dtype(config.compute_dtype)
+
+    def __call__(self, x, positions=None):
+        x = x + self.self_attn(
+            self.input_layernorm(x).astype(self._cdtype), positions=positions
+        )
+        x = x + self.mlp(self.post_attention_layernorm(x).astype(self._cdtype))
+        return x
+
+
+class Llama(nnx.Module):
+    def __init__(self, config: LlamaConfig, *, rngs,
+                 layer_cls=LlamaDecoderLayer):
+        self.config = config
+        cdtype = resolve_dtype(config.compute_dtype)
+        init = nnx.initializers.normal(stddev=0.02)
+        self.embed_tokens = nnx.Embed(
+            config.vocab_size, config.n_embd, embedding_init=init,
+            dtype=cdtype, param_dtype=jnp.float32, rngs=rngs,
+        )
+        self.layers = nnx.List(
+            [layer_cls(config, rngs=rngs) for _ in range(config.n_layer)]
+        )
+        self.norm = RMSNorm(config.n_embd, eps=config.norm_eps, rngs=rngs)
+        self.lm_head = nnx.Linear(
+            config.n_embd, config.vocab_size, use_bias=False,
+            kernel_init=init, dtype=cdtype, param_dtype=jnp.float32,
+            rngs=rngs,
+        )
+        self._cdtype = cdtype
+
+    def __call__(self, idx, targets=None, *, deterministic=True, rngs=None):
+        B, T = idx.shape
+        assert T <= self.config.block_size
+        x = self.embed_tokens(idx)
+        if self.config.remat:
+            layer_fn = nnx.remat(lambda lyr, h: lyr(h))
+        else:
+            layer_fn = lambda lyr, h: lyr(h)
+        for layer in self.layers:
+            x = layer_fn(layer, x)
+        x = self.norm(x).astype(self._cdtype)
+        if targets is not None:
+            logits = self.lm_head(x)
+            loss = cross_entropy_loss(logits, targets, ignore_index=-1)
+        else:
+            logits = self.lm_head(x[:, -1:, :])
+            loss = None
+        return logits, loss
+
+    def get_num_params(self, non_embedding=True):
+        leaves = jax.tree.leaves(nnx.state(self, nnx.Param))
+        return sum(x.size for x in leaves)
+
+    def generate(self, rng, idx, max_new_tokens, temperature=1.0, top_k=None):
+        for _ in range(max_new_tokens):
+            idx_cond = idx[:, -self.config.block_size:]
+            logits, _ = self(idx_cond)
+            logits = logits[:, -1, :].astype(jnp.float32) / temperature
+            if top_k is not None:
+                kth = jnp.sort(logits, axis=-1)[:, -min(top_k, logits.shape[-1])]
+                logits = jnp.where(logits < kth[:, None], -jnp.inf, logits)
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(sub, logits, axis=-1)
+            idx = jnp.concatenate([idx, nxt[:, None]], axis=1)
+        return idx

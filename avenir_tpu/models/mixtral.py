@@ -1,0 +1,157 @@
+"""Mixtral-family sparse-MoE decoder (SURVEY.md §2b T10; BASELINE.json:11
+"Mixtral-8x7B MoE, expert-parallel all-to-all over ICI").
+
+Reuses the Llama attention/norm stack (Mixtral IS Llama + MoE FFN) and
+swaps the MLP for a top-k routed expert block. Parameter names follow the
+HF convention (block_sparse_moe.gate / block_sparse_moe.experts.N.w1|w2|w3)
+— the bridge stacks per-expert torch tensors into our (E, in, out) arrays.
+
+TPU-first dispatch (GShard/Mesh-TF shape, static everywhere):
+  - capacity C = ceil(topk·N/E · capacity_factor): fixed expert batch, no
+    dynamic shapes under jit; overflow tokens are DROPPED (their combine
+    weight is 0 — they pass through the residual), underflow is padding
+  - dispatch/combine are one-hot einsums; expert tensors carry a
+    with_sharding_constraint on the 'expert' mesh axis, so XLA SPMD emits
+    the all-to-all pair over ICI when EP > 1 (tokens ride the expert axis
+    outside the block — batch_pspec — making dispatch a true a2a, not an
+    all-gather); tests assert the collective appears in HLO
+  - routing follows HF Mixtral: full softmax over E, top-k, renormalize
+    over the selected k (parity-tested vs MixtralForCausalLM)
+"""
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from avenir_tpu.models.common import resolve_dtype
+from avenir_tpu.models.llama import (
+    Llama,
+    LlamaAttention,
+    LlamaConfig,
+    RMSNorm,
+)
+
+
+@dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    n_experts: int = 8
+    n_experts_per_tok: int = 2
+    capacity_factor: float = 1.25
+
+    @classmethod
+    def from_train_config(cls, cfg, model_args):
+        base = LlamaConfig.from_train_config(cfg, model_args)
+        return cls(
+            **{k: getattr(base, k) for k in base.__dataclass_fields__},
+            n_experts=cfg.get("n_experts", 8),
+            n_experts_per_tok=cfg.get("n_experts_per_tok", 2),
+            capacity_factor=cfg.get("capacity_factor", 1.25),
+        )
+
+
+class MixtralExperts(nnx.Module):
+    """Stacked expert FFNs: w1/w3 (E, d, ff) up-projections, w2 (E, ff, d)
+    down-projection; y_e = w2_e(silu(w1_e(x)) * w3_e(x))."""
+
+    def __init__(self, config: MixtralConfig, *, rngs):
+        E, d, ff = config.n_experts, config.n_embd, config.ffn_hidden
+        init = nnx.initializers.normal(stddev=0.02)
+        self.w1 = nnx.Param(init(rngs.params(), (E, d, ff), jnp.float32))
+        self.w3 = nnx.Param(init(rngs.params(), (E, d, ff), jnp.float32))
+        self.w2 = nnx.Param(init(rngs.params(), (E, ff, d), jnp.float32))
+        self._cdtype = resolve_dtype(config.compute_dtype)
+
+    def __call__(self, x):  # x: (E, C, d)
+        cd = self._cdtype
+        w1 = self.w1.get_value().astype(cd)
+        w3 = self.w3.get_value().astype(cd)
+        w2 = self.w2.get_value().astype(cd)
+        h = jax.nn.silu(
+            jnp.einsum("ecd,edf->ecf", x, w1,
+                       preferred_element_type=jnp.float32).astype(jnp.float32)
+        ).astype(cd) * jnp.einsum("ecd,edf->ecf", x, w3,
+                                  preferred_element_type=jnp.float32).astype(cd)
+        return jnp.einsum("ecf,efd->ecd", h, w2,
+                          preferred_element_type=jnp.float32).astype(cd)
+
+
+class MixtralSparseMoeBlock(nnx.Module):
+    def __init__(self, config: MixtralConfig, *, rngs):
+        cdtype = resolve_dtype(config.compute_dtype)
+        self.gate = nnx.Linear(
+            config.n_embd, config.n_experts, use_bias=False,
+            kernel_init=nnx.initializers.normal(stddev=0.02),
+            dtype=cdtype, param_dtype=jnp.float32, rngs=rngs,
+        )
+        self.experts = MixtralExperts(config, rngs=rngs)
+        self.n_experts = config.n_experts
+        self.topk = config.n_experts_per_tok
+        self.capacity_factor = config.capacity_factor
+        self._cdtype = cdtype
+
+    def __call__(self, x):  # (B, T, d)
+        from jax.sharding import PartitionSpec as P
+
+        from avenir_tpu.parallel.partition import constrain
+
+        B, T, d = x.shape
+        N = B * T
+        E, K = self.n_experts, self.topk
+        C = max(1, int(-(-K * N * self.capacity_factor // E)))
+        xf = x.reshape(N, d)
+
+        logits = self.gate(xf).astype(jnp.float32)  # (N, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        topk_probs, topk_idx = jax.lax.top_k(probs, K)  # (N, K)
+        topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
+
+        oh = jax.nn.one_hot(topk_idx, E, dtype=jnp.int32)  # (N, K, E)
+        # queue position of each (token, slot) within its expert, in
+        # (token-major, slot-minor) order — matches sequential routing
+        flat = oh.reshape(N * K, E)
+        pos = jnp.cumsum(flat, axis=0) * flat - 1  # (N·K, E)
+        pos = pos.reshape(N, K, E)
+        pos_tok = jnp.sum(pos * oh, axis=-1)  # (N, K) position in chosen queue
+        keep = pos_tok < C  # capacity mask
+
+        slot_oh = jax.nn.one_hot(pos_tok, C, dtype=jnp.float32) * keep[..., None]
+        # dispatch (N, E, C) / combine (N, E, C)
+        disp = jnp.einsum("nke,nkc->nec", oh.astype(jnp.float32), slot_oh)
+        comb = jnp.einsum("nke,nkc,nk->nec", oh.astype(jnp.float32), slot_oh,
+                          topk_probs)
+
+        expert_in = jnp.einsum("nec,nd->ecd", disp.astype(self._cdtype),
+                               xf.astype(self._cdtype))
+        expert_in = constrain(expert_in, P("expert", None, None))
+        expert_out = self.experts(expert_in)  # (E, C, d)
+        expert_out = constrain(expert_out, P("expert", None, None))
+        out = jnp.einsum("nec,ecd->nd", comb.astype(self._cdtype), expert_out)
+        return out.reshape(B, T, d).astype(x.dtype)
+
+
+class MixtralDecoderLayer(nnx.Module):
+    def __init__(self, config: MixtralConfig, *, rngs):
+        self.input_layernorm = RMSNorm(config.n_embd, eps=config.norm_eps,
+                                       rngs=rngs)
+        self.self_attn = LlamaAttention(config, rngs=rngs)
+        self.post_attention_layernorm = RMSNorm(
+            config.n_embd, eps=config.norm_eps, rngs=rngs
+        )
+        self.block_sparse_moe = MixtralSparseMoeBlock(config, rngs=rngs)
+        self._cdtype = resolve_dtype(config.compute_dtype)
+
+    def __call__(self, x, positions=None):
+        x = x + self.self_attn(
+            self.input_layernorm(x).astype(self._cdtype), positions=positions
+        )
+        x = x + self.block_sparse_moe(
+            self.post_attention_layernorm(x).astype(self._cdtype)
+        )
+        return x
+
+
+class Mixtral(Llama):
+    def __init__(self, config: MixtralConfig, *, rngs):
+        super().__init__(config, rngs=rngs, layer_cls=MixtralDecoderLayer)

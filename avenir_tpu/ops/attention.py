@@ -87,6 +87,14 @@ def causal_attention(q, k, v, *, dropout_rate=0.0, deterministic=True,
                 impl = "xla"
         else:
             impl = "xla"
+    if impl == "ring":
+        # context parallelism: sequence sharded over the 'context' mesh
+        # axis, kv rotating via ppermute (parallel/ring_attention.py)
+        assert not use_dropout, "ring attention does not support attn dropout"
+        assert segment_ids is None, "ring attention does not take segment_ids"
+        from avenir_tpu.parallel.ring_attention import ring_causal_attention
+
+        return ring_causal_attention(q, k, v)
     if impl == "pallas":
         assert not use_dropout, "pallas flash attention does not support attn dropout"
         assert segment_ids is None, "pallas flash attention does not take segment_ids"

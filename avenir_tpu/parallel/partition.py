@@ -47,12 +47,12 @@ LLAMA_RULES = (
     (r"(input_layernorm|post_attention_layernorm|norm)/scale$", P()),
 )
 
-MIXTRAL_RULES = LLAMA_RULES + (
-    # experts are stacked on a leading 'expert' axis: (E, in, out)
-    (r"experts/(w1|w3)/kernel$", P("expert", "fsdp", "tensor")),
-    (r"experts/w2/kernel$", P("expert", "tensor", "fsdp")),
-    (r"gate/kernel$", P(None, None)),
-)
+MIXTRAL_RULES = (
+    # experts are stacked nnx.Params on a leading 'expert' axis: (E, in, out)
+    (r"experts/(w1|w3)$", P("expert", "fsdp", "tensor")),
+    (r"experts/w2$", P("expert", "tensor", "fsdp")),
+    (r"block_sparse_moe/gate/kernel$", P(None, None)),  # tiny router, replicated
+) + LLAMA_RULES
 
 
 def rules_for_model(model_type: str):
@@ -113,16 +113,31 @@ def sanitize_specs(spec_by_path, shapes, mesh):
 
 def batch_pspec(with_accum: bool = True) -> P:
     """Global batch layout: batch dim sharded over every data-parallel-like
-    axis (pure DP + ZeRO), sequence dim over 'context' (ring attention).
-    `with_accum`: leading unsharded grad-accumulation axis (train batches
-    are (accum, B, T); eval batches are (B, T))."""
-    per_batch = (("data", "fsdp"), "context")
+    axis — 'expert' is a data axis outside the MoE blocks (the standard EP
+    layout: tokens ride the expert axis so dispatch/combine become
+    all-to-alls over ICI, BASELINE.json:11) — sequence dim over 'context'
+    (ring attention). `with_accum`: leading unsharded grad-accumulation
+    axis (train batches are (accum, B, T); eval batches are (B, T))."""
+    per_batch = (("data", "fsdp", "expert"), "context")
     return P(None, *per_batch) if with_accum else P(*per_batch)
 
 
 def activation_pspec() -> P:
     """Between-block activation constraint (B, T, C)."""
     return P(("data", "fsdp"), "context", None)
+
+
+def constrain(x, spec: P):
+    """with_sharding_constraint that degrades to a no-op when no mesh is
+    in context (single-device tests, model used standalone). The training
+    loop installs the mesh via `jax.set_mesh`, making these constraints
+    live; without one the constraint is meaningless anyway."""
+    import jax
+
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (RuntimeError, ValueError):
+        return x
 
 
 def named_shardings(mesh, spec_by_path):

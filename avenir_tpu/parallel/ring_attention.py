@@ -1,0 +1,98 @@
+"""Ring attention — context/sequence parallelism over the ICI ring
+(SURVEY.md §2c SP/CP row, §5 long-context note; first-class per the build
+brief).
+
+The sequence axis is sharded over the 'context' mesh axis. Each device
+keeps its q stripe resident and the kv stripes ROTATE around the ring via
+`lax.ppermute` (one hop per step, n-1 hops total), overlapping each hop
+with the local block attention. Blocks are combined with the same
+online-softmax algebra as flash attention (normalized partial outputs +
+logsumexp weights), so the result is bit-comparable to full attention up
+to fp accumulation order.
+
+Causality across blocks: a kv stripe that lies entirely in the future of
+this device's q stripe contributes -1e30 scores → zero combine weight (no
+dynamic skipping: the hop count is uniform across devices, which is what
+keeps the ring in lockstep).
+
+Layout contract matches ops.causal_attention: (B, T, H, D), GQA already
+expanded. Runs inside jit: `jax.shard_map` over the context axis of the
+ambient mesh (installed by the训练loop via jax.set_mesh).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, q_offset, kv_offset, sm_scale, seq_len):
+    """One (q-stripe × kv-stripe) causal attention in fp32. Returns the
+    locally-normalized output (B, Tq, H, D) and logsumexp (B, H, Tq, 1)."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    q_pos = q_offset + jnp.arange(Tq)
+    k_pos = kv_offset + jnp.arange(Tk)
+    mask = (q_pos[:, None] >= k_pos[None, :]) & (k_pos < seq_len)[None, :]
+    s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # (B, H, Tq, 1)
+    p = jnp.exp(s - m)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    o = jnp.einsum("bhqk,bkhd->bqhd", (p / l).astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return o.astype(jnp.float32), m + jnp.log(l)
+
+
+def _ring_body(q, k, v, *, axis_name, seq_len, sm_scale):
+    """shard_map body: local stripes (B, T/c, H, D)."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    Tl = q.shape[1]
+
+    o = jnp.zeros(q.shape, jnp.float32)
+    lse = jnp.full((q.shape[0], q.shape[2], Tl, 1), NEG_INF, jnp.float32)
+    kv = (k, v)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    for i in range(n):  # static unroll: n is the mesh axis size
+        src = (idx - i) % n  # original owner of the kv stripe we now hold
+        o_i, lse_i = _block_attention(
+            q, kv[0], kv[1],
+            q_offset=idx * Tl, kv_offset=src * Tl,
+            sm_scale=sm_scale, seq_len=seq_len,
+        )
+        # online merge of normalized partials
+        lse_new = jnp.logaddexp(lse, lse_i)
+        w_old = jnp.exp(lse - lse_new)  # (B, H, Tq, 1)
+        w_new = jnp.exp(lse_i - lse_new)
+        tr = lambda w: jnp.transpose(w, (0, 2, 1, 3))  # → (B, Tq, H, 1)
+        o = o * tr(w_old) + o_i * tr(w_new)
+        lse = lse_new
+        if i < n - 1:
+            # rotate kv one hop around the ring while the next block computes
+            kv = jax.lax.ppermute(kv, axis_name, perm)
+    return o.astype(q.dtype)
+
+
+def ring_causal_attention(q, k, v, *, axis_name="context", mesh=None,
+                          sm_scale=None):
+    """Causal attention with the sequence sharded over `axis_name`.
+    q, k, v: GLOBAL (B, T, H, D) under jit; T must divide by the axis
+    size. Uses the ambient mesh (jax.set_mesh) when `mesh` is None."""
+    B, T, H, D = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    spec = P(("data", "fsdp", "expert"), axis_name, None, None)
+    body = functools.partial(
+        _ring_body, axis_name=axis_name, seq_len=T, sm_scale=sm_scale
+    )
+    kwargs = dict(in_specs=(spec, spec, spec), out_specs=spec,
+                  check_vma=False)
+    if mesh is not None:
+        kwargs["mesh"] = mesh
+    return jax.shard_map(body, **kwargs)(q, k, v)

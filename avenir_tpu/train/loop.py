@@ -45,9 +45,18 @@ from avenir_tpu.train.optimizer import make_optimizer
 from avenir_tpu.train.step import jit_train_step, make_step_fns
 
 
-def build_model_factory(cfg, model_args):
-    """Return (model_type, config_obj, ctor) for the configured family."""
+def build_model_factory(cfg, model_args, mesh=None):
+    """Return (model_type, config_obj, ctor) for the configured family.
+    A 'context' mesh axis > 1 switches attention to the ring impl
+    (sequence parallelism — parallel/ring_attention.py)."""
+    import dataclasses
+
     mt = cfg["model_type"]
+    ring = mesh is not None and mesh.shape.get("context", 1) > 1
+    if ring:
+        assert model_args["dropout"] == 0.0, (
+            "ring attention requires dropout=0"
+        )
     if mt == "gpt":
         gcfg = GPTConfig(
             block_size=model_args["block_size"],
@@ -56,7 +65,7 @@ def build_model_factory(cfg, model_args):
             n_embd=model_args["n_embd"], dropout=model_args["dropout"],
             bias=model_args["bias"],
             compute_dtype=("float32" if cfg["dtype"] == "float16" else cfg["dtype"]),
-            attn_impl=("auto" if cfg["use_pallas"] else "xla"),
+            attn_impl=("ring" if ring else ("auto" if cfg["use_pallas"] else "xla")),
             remat=cfg["remat"],
         )
         return mt, gcfg, (lambda seed: GPT(gcfg, rngs=nnx.Rngs(seed)))
@@ -64,11 +73,15 @@ def build_model_factory(cfg, model_args):
         from avenir_tpu.models.llama import Llama, LlamaConfig
 
         lcfg = LlamaConfig.from_train_config(cfg, model_args)
+        if ring:
+            lcfg = dataclasses.replace(lcfg, attn_impl="ring")
         return mt, lcfg, (lambda seed: Llama(lcfg, rngs=nnx.Rngs(seed)))
     if mt == "mixtral":
         from avenir_tpu.models.mixtral import Mixtral, MixtralConfig
 
         mcfg = MixtralConfig.from_train_config(cfg, model_args)
+        if ring:
+            mcfg = dataclasses.replace(mcfg, attn_impl="ring")
         return mt, mcfg, (lambda seed: Mixtral(mcfg, rngs=nnx.Rngs(seed)))
     raise ValueError(f"unknown model_type {mt!r}")
 
@@ -76,7 +89,8 @@ def build_model_factory(cfg, model_args):
 def setup_state(cfg, mesh, model_args, *, verbose=True):
     """Shared bring-up for training and sampling: sharded param init (or
     abstract shapes only), partition specs, graphdef."""
-    mt, gcfg, ctor = build_model_factory(cfg, model_args)
+    mt, gcfg, ctor = build_model_factory(cfg, model_args, mesh=mesh)
+    jax.set_mesh(mesh)  # context mesh: makes in-model PartitionSpec constraints live
     model_abs = nnx.eval_shape(lambda: ctor(cfg["seed"]))
     graphdef, abs_state = nnx.split(model_abs, nnx.Param)
     paths = [p for p, _ in abs_state.flat_state()]
@@ -104,7 +118,8 @@ def run_training(cfg):
     initialize_distributed()
     master = is_coordinator()
     mesh = make_mesh(cfg["mesh_shape"])
-    n_dp = mesh.shape["data"] * mesh.shape["fsdp"]
+    # every batch-sharding axis counts as data parallelism (see batch_pspec)
+    n_dp = mesh.shape["data"] * mesh.shape["fsdp"] * mesh.shape["expert"]
 
     grad_accum_total = cfg["gradient_accumulation_steps"]
     assert grad_accum_total % n_dp == 0, (

@@ -1,0 +1,106 @@
+"""Llama parity tests: our nnx Llama vs transformers' torch
+LlamaForCausalLM on shared random weights (the strongest available oracle
+— the HF implementation defines the reference RoPE/GQA/RMSNorm
+semantics). SURVEY.md §4 "Unit: model parity"."""
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from avenir_tpu.checkpoint.bridge import load_torch_state_dict
+from avenir_tpu.models.llama import Llama, LlamaConfig
+
+TINY = dict(
+    block_size=32, vocab_size=96, n_layer=2, n_head=4, n_kv_head=2,
+    n_embd=64, ffn_hidden=128, rope_theta=10000.0,
+)
+
+
+def _hf_llama():
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM
+
+    hf_cfg = HFConfig(
+        vocab_size=TINY["vocab_size"], hidden_size=TINY["n_embd"],
+        intermediate_size=TINY["ffn_hidden"],
+        num_hidden_layers=TINY["n_layer"],
+        num_attention_heads=TINY["n_head"],
+        num_key_value_heads=TINY["n_kv_head"],
+        max_position_embeddings=TINY["block_size"],
+        rms_norm_eps=1e-5, rope_theta=TINY["rope_theta"],
+        tie_word_embeddings=False, attention_bias=False, mlp_bias=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    m = LlamaForCausalLM(hf_cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def pair():
+    tm = _hf_llama()
+    jm = Llama(LlamaConfig(**TINY), rngs=nnx.Rngs(0))
+    sd = {k: v.detach().numpy() for k, v in tm.state_dict().items()}
+    load_torch_state_dict(jm, sd, tied_lm_head=False)
+    return tm, jm
+
+
+def test_logits_parity(pair):
+    tm, jm = pair
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, TINY["vocab_size"], (2, 24))
+    with torch.no_grad():
+        t_logits = tm(torch.from_numpy(idx)).logits
+    j_logits, _ = jm(jnp.asarray(idx), jnp.asarray(idx))
+    np.testing.assert_allclose(
+        np.asarray(j_logits), t_logits.numpy(), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_loss_matches_torch_ce(pair):
+    tm, jm = pair
+    rng = np.random.default_rng(1)
+    idx = rng.integers(0, TINY["vocab_size"], (2, 16))
+    tgt = rng.integers(0, TINY["vocab_size"], (2, 16))
+    tgt[0, :3] = -1
+    with torch.no_grad():
+        t_logits = tm(torch.from_numpy(idx)).logits
+        t_loss = torch.nn.functional.cross_entropy(
+            t_logits.reshape(-1, TINY["vocab_size"]),
+            torch.from_numpy(tgt).reshape(-1), ignore_index=-1,
+        )
+    _, j_loss = jm(jnp.asarray(idx), jnp.asarray(tgt))
+    np.testing.assert_allclose(float(j_loss), float(t_loss), atol=2e-5,
+                               rtol=2e-5)
+
+
+def test_gqa_head_counts(pair):
+    _, jm = pair
+    att = jm.layers[0].self_attn
+    assert att.q_proj.kernel[...].shape == (64, 64)
+    assert att.k_proj.kernel[...].shape == (64, 32)  # 2 kv heads × 16
+
+
+def test_llama_trains_end_to_end(char_dataset, tmp_path):
+    """model_type=llama through the real training loop (tiny)."""
+    from tests.test_train_tpu import make_cfg
+
+    from avenir_tpu.train.loop import run_training
+
+    cfg = make_cfg(char_dataset["dir"], tmp_path / "out", max_iters=10,
+                   mesh_shape="data:1", model_type="llama", n_kv_head=2,
+                   n_head=4, n_embd=32, ffn_hidden=64, eval_interval=5)
+    res = run_training(cfg)
+    losses = [l for _, l in res["loss_history"]]
+    assert losses[-1] < losses[0], losses
+    # resume from the saved checkpoint (avenir_adamw optimizer schema)
+    cfg2 = make_cfg(char_dataset["dir"], tmp_path / "out", max_iters=12,
+                    mesh_shape="data:1", model_type="llama", n_kv_head=2,
+                    n_head=4, n_embd=32, ffn_hidden=64, eval_interval=5,
+                    init_from="resume")
+    res2 = run_training(cfg2)
+    assert res2["iter_num"] >= 12

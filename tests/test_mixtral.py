@@ -1,0 +1,150 @@
+"""Mixtral MoE tests: routing/logits parity vs transformers' torch
+MixtralForCausalLM (capacity set high enough that no tokens drop — HF
+never drops), EP sharding on the 8 fake devices, and all-to-all presence
+in the EP HLO (SURVEY.md §4; BASELINE.json:11)."""
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from avenir_tpu.checkpoint.bridge import load_torch_state_dict
+from avenir_tpu.models.mixtral import Mixtral, MixtralConfig
+
+TINY = dict(
+    block_size=32, vocab_size=96, n_layer=2, n_head=4, n_kv_head=2,
+    n_embd=64, ffn_hidden=128, rope_theta=10000.0, n_experts=4,
+    n_experts_per_tok=2,
+)
+
+
+def _hf_mixtral():
+    from transformers import MixtralConfig as HFConfig, MixtralForCausalLM
+
+    hf_cfg = HFConfig(
+        vocab_size=TINY["vocab_size"], hidden_size=TINY["n_embd"],
+        intermediate_size=TINY["ffn_hidden"],
+        num_hidden_layers=TINY["n_layer"],
+        num_attention_heads=TINY["n_head"],
+        num_key_value_heads=TINY["n_kv_head"],
+        max_position_embeddings=TINY["block_size"],
+        rms_norm_eps=1e-5, rope_theta=TINY["rope_theta"],
+        num_local_experts=TINY["n_experts"],
+        num_experts_per_tok=TINY["n_experts_per_tok"],
+        tie_word_embeddings=False, attention_bias=False,
+        attn_implementation="eager", output_router_logits=False,
+    )
+    torch.manual_seed(0)
+    m = MixtralForCausalLM(hf_cfg)
+    m.eval()
+    return m
+
+
+def test_logits_parity_no_drop():
+    tm = _hf_mixtral()
+    # capacity_factor = E/K → C = N: nothing can drop, matches HF exactly
+    jm = Mixtral(
+        MixtralConfig(capacity_factor=TINY["n_experts"] / TINY["n_experts_per_tok"],
+                      **TINY),
+        rngs=nnx.Rngs(0),
+    )
+    sd = {k: v.detach().numpy() for k, v in tm.state_dict().items()}
+    load_torch_state_dict(jm, sd, tied_lm_head=False)
+
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, TINY["vocab_size"], (2, 16))
+    with torch.no_grad():
+        t_logits = tm(torch.from_numpy(idx)).logits
+    j_logits, _ = jm(jnp.asarray(idx), jnp.asarray(idx))
+    np.testing.assert_allclose(
+        np.asarray(j_logits), t_logits.numpy(), atol=5e-4, rtol=5e-4
+    )
+
+
+def test_capacity_drops_are_graceful():
+    """With a tight capacity factor, outputs stay finite and overflow
+    tokens degrade to the residual path (combine weight 0)."""
+    jm = Mixtral(MixtralConfig(capacity_factor=0.5, **TINY), rngs=nnx.Rngs(0))
+    idx = jnp.zeros((2, 16), jnp.int32)  # all identical → heavy overflow
+    logits, loss = jm(idx, idx)
+    assert np.isfinite(float(loss))
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_mixtral_trains_and_resumes(char_dataset, tmp_path):
+    from tests.test_train_tpu import make_cfg
+
+    from avenir_tpu.train.loop import run_training
+
+    kw = dict(model_type="mixtral", n_kv_head=2, n_head=4, n_embd=32,
+              ffn_hidden=64, n_experts=4, eval_interval=5, mesh_shape="data:1")
+    cfg = make_cfg(char_dataset["dir"], tmp_path / "out", max_iters=10, **kw)
+    res = run_training(cfg)
+    losses = [l for _, l in res["loss_history"]]
+    assert losses[-1] < losses[0], losses
+    cfg2 = make_cfg(char_dataset["dir"], tmp_path / "out", max_iters=12,
+                    init_from="resume", **kw)
+    res2 = run_training(cfg2)
+    assert res2["iter_num"] >= 12
+
+
+def test_ep_trajectory_matches_and_hlo_has_all_to_all(char_dataset, tmp_path):
+    """expert:4 mesh must reproduce the single-device trajectory (EP is
+    pure layout) and the compiled step must contain an all-to-all."""
+    from tests.test_train_tpu import make_cfg
+
+    from avenir_tpu.train.loop import run_training
+
+    kw = dict(model_type="mixtral", n_kv_head=2, n_head=4, n_embd=32,
+              ffn_hidden=64, n_experts=4, eval_interval=50,
+              gradient_accumulation_steps=4)
+    ref = run_training(
+        make_cfg(char_dataset["dir"], tmp_path / "o1", max_iters=5,
+                 mesh_shape="data:1", **kw)
+    )
+    got = run_training(
+        make_cfg(char_dataset["dir"], tmp_path / "o2", max_iters=5,
+                 mesh_shape="expert:4", **kw)
+    )
+    ref_l = np.array([l for _, l in ref["loss_history"]])
+    got_l = np.array([l for _, l in got["loss_history"]])
+    np.testing.assert_allclose(got_l, ref_l, atol=3e-4, rtol=3e-4)
+
+
+def test_ep_hlo_contains_all_to_all(char_dataset):
+    from flax import nnx as _nnx
+    from jax.sharding import NamedSharding
+
+    from tests.test_train_tpu import make_cfg
+
+    from avenir_tpu.parallel.mesh import make_mesh
+    from avenir_tpu.parallel.partition import batch_pspec
+    from avenir_tpu.train.loop import setup_state
+    from avenir_tpu.train.optimizer import make_optimizer
+    from avenir_tpu.train.step import make_step_fns
+
+    mesh = make_mesh("expert:4")
+    cfg = make_cfg("x", "y", model_type="mixtral")
+    model_args = dict(n_layer=1, n_head=4, n_embd=32, block_size=32,
+                      bias=False, vocab_size=64, dropout=0.0)
+    st = setup_state(cfg, mesh, model_args, verbose=False)
+
+    params = jax.jit(
+        lambda: _nnx.split(st["ctor"](0), _nnx.Param)[1],
+        out_shardings=st["shard_tree"],
+    )()
+    tx, _ = make_optimizer(
+        params, learning_rate=1e-3, weight_decay=0.1, beta1=0.9, beta2=0.95,
+        grad_clip=1.0, warmup_iters=2, lr_decay_iters=8, min_lr=1e-4,
+    )
+    opt_state = jax.jit(tx.init)(params)
+    train_step, _ = make_step_fns(st["graphdef"], dropout=0.0)
+    bsh = NamedSharding(mesh, batch_pspec())
+    x = jax.device_put(np.zeros((1, 8, 32), np.int32), bsh)
+    hlo = jax.jit(
+        lambda p, o, r, xx, yy: train_step(p, o, tx, r, xx, yy)
+    ).lower(params, opt_state, jax.random.key(0), x, x).compile().as_text()
+    assert "all-to-all" in hlo, "EP dispatch did not lower to all-to-all"
