@@ -1,21 +1,28 @@
 """Blockwise causal flash attention for TPU (fwd + bwd), SURVEY.md §2b T6.
 
-Design (classic FlashAttention-2 shape, written for the TPU memory
-hierarchy — this is the largest in-repo kernel, §7 "hard parts"):
+Design (FlashAttention-2 recurrence on the TPU memory hierarchy — the
+largest in-repo kernel, SURVEY.md §7 "hard parts"):
 
-  - public layout (B, T, H, D) — transposed to (B, H, T, D) so the block's
+  - public layout (B, T, H, D) — transposed to (B, H, T, D) so each block's
     trailing dims (T, D) map onto (sublane, lane) tiles
-  - grid (B, H, T/block): each program owns one q (or kv) stripe in VMEM;
-    the opposing sequence streams through `pl.ds` slices of a
-    whole-sequence VMEM block
-  - online softmax in fp32 carried through `lax.fori_loop` (running max m,
-    normalizer l, accumulator acc); MXU matmuls take bf16 inputs with
-    preferred_element_type=fp32
-  - causal BLOCK SKIPPING: the kv loop stops at the diagonal, halving the
-    work vs masked dense attention; within the diagonal block a
-    broadcasted-iota mask applies
-  - backward = two kernels (no atomics): dq gridded over q blocks, dk/dv
-    gridded over kv blocks, both recomputing p from the saved logsumexp
+  - KV STREAMING VIA THE GRID: grid (B, H, nq, nk) with the kv index as the
+    innermost ("arbitrary") dimension. Each kv block arrives as its own
+    BlockSpec slice, so Mosaic double-buffers the HBM→VMEM DMAs and every
+    in-kernel index is static. (The round-1 kernel held the whole KV
+    sequence in one VMEM block and walked it with `pl.ds` inside a
+    `fori_loop`; measured on v5e that serialized ~2x slower than this
+    form and capped VMEM at long T. Measured in BASELINE.md.)
+  - online softmax in fp32 carried in VMEM scratch across the kv grid steps
+    (running max m, normalizer l, accumulator acc); MXU matmuls take bf16
+    inputs with preferred_element_type=fp32
+  - causal BLOCK SKIPPING: kv grid steps above the diagonal skip all
+    compute via `pl.when` (the DMA still lands, bandwidth is cheap; the
+    MXU/VPU work — the expensive part — is halved). The diagonal block
+    applies a broadcasted-iota mask.
+  - backward = two kernels (no atomics): dq gridded (B, H, nq, nk) with a
+    dq scratch accumulated over kv steps; dk/dv gridded (B, H, nk, nq)
+    with dk/dv scratch accumulated over q steps; both recompute p from the
+    saved logsumexp
   - padding: sequences are padded to the block size; padded kv columns are
     masked with -1e30 (finite, so fully-padded q rows stay NaN-free and
     are sliced away by the wrapper)
@@ -30,144 +37,340 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+# m/l scratch rows keep a full 128-wide lane tile (column 0 is the value);
+# a (bq, 1) scratch would be padded to this anyway, the explicit shape keeps
+# the loads/stores layout-friendly.
+_LANES = 128
+# Sequences up to this padded length take the single-KV-block fast path:
+# softmax computed directly (no online-softmax scratch carry). Measured on
+# v5e the scratch carry costs ~2x on the fwd kernel (BASELINE.md attention
+# table); the fast path's VMEM working set is the (block_q, T) fp32 score
+# block, which at 2048 and block_q=512 is 4MB.
+_FAST_PATH_MAX_T = 2048
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
-                causal, sm_scale, seq_len):
-    qi = pl.program_id(2)
-    q = q_ref[0, 0]  # (BQ, D) input dtype
-    kv_len = k_ref.shape[2]
-    nk_total = kv_len // block_k
+def _mask_scores(s, q_off, k_off, causal, seq_len):
+    """Apply padded-kv and (optionally) causal masking to a score block.
+    `s` is (BQ, BK) fp32; q_off/k_off are the block's global row/col bases."""
+    bq, bk = s.shape
+    k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = k_pos < seq_len
     if causal:
-        # block skipping: only kv blocks touching the lower triangle
-        nk = jnp.minimum(
-            ((qi + 1) * block_q + block_k - 1) // block_k, nk_total
-        )
-    else:
-        nk = nk_total
+        q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        mask = mask & (q_pos >= k_pos)
+    return jnp.where(mask, s, NEG_INF)
 
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
+
+
+def _compiler_params(n_parallel):
+    """dimension_semantics hint: all grid dims except the innermost
+    (the streamed/accumulated one) are parallel."""
+    sem = ("parallel",) * n_parallel + ("arbitrary",)
+    try:
+        return pltpu.CompilerParams(dimension_semantics=sem)
+    except (AttributeError, TypeError):  # older jax spelling
+        return pltpu.TPUCompilerParams(dimension_semantics=sem)
+
+
+# ---------------------------------------------------------------------------
+# Fast path: the whole (padded) KV sequence is a single block per grid step,
+# so the softmax is computed directly — no scratch carry, no pl.when. Grid is
+# (B*H, nq) over a (B*H, T, D) view. Wins ~2x over the online-softmax form on
+# v5e at GPT-2 sequence lengths (BASELINE.md).
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel_fast(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
+                     causal, sm_scale, seq_len):
+    i = pl.program_id(1)
+    q = q_ref[0]  # (BQ, D)
+    k = k_ref[0]  # (Tp, D)
+    v = v_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    ) * sm_scale  # (BQ, Tp)
+    s = _mask_scores(s, i * block_q, 0, causal, seq_len)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    o = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
     )
+    o_ref[0] = (o / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
 
-    def body(j, carry):
-        acc, m, l = carry
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]  # (BK, D)
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+
+def _dq_kernel_fast(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                    *, block_q, causal, sm_scale, seq_len):
+    i = pl.program_id(1)
+    q = q_ref[0]
+    k = k_ref[0]  # (Tp, D)
+    v = v_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]  # (BQ, 1)
+    delta = delta_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    ) * sm_scale
+    s = _mask_scores(s, i * block_q, 0, causal, seq_len)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta) * sm_scale
+    dq_ref[0] = jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dq_ref.dtype)
+
+
+def _dkv_kernel_fast(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, *, block_k, causal, sm_scale, seq_len):
+    j = pl.program_id(1)
+    q = q_ref[0]  # (Tp, D) — all q rows
+    k = k_ref[0]  # (BK, D)
+    v = v_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]  # (Tp, 1)
+    delta = delta_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    ) * sm_scale  # (Tp, BK)
+    s = _mask_scores(s, 0, j * block_k, causal, seq_len)
+    p = jnp.exp(s - lse)  # (Tp, BK)
+    dv_ref[0] = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dv_ref.dtype)
+    dp = jax.lax.dot_general(
+        do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta) * sm_scale
+    dk_ref[0] = jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dk_ref.dtype)
+
+
+def _make_fwd_fast(seq_len):
+    def fwd(q, k, v, causal, sm_scale, block_q, interpret):
+        BH, Tp, D = q.shape
+        nq = Tp // block_q
+        o, lse = pl.pallas_call(
+            functools.partial(
+                _fwd_kernel_fast, block_q=block_q, causal=causal,
+                sm_scale=sm_scale, seq_len=seq_len,
+            ),
+            grid=(BH, nq),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda g, i: (g, i, 0)),
+                pl.BlockSpec((1, Tp, D), lambda g, i: (g, 0, 0)),
+                pl.BlockSpec((1, Tp, D), lambda g, i: (g, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, D), lambda g, i: (g, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda g, i: (g, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((BH, Tp, D), q.dtype),
+                jax.ShapeDtypeStruct((BH, Tp, 1), jnp.float32),
+            ],
+            compiler_params=_compiler_params(1),
+            interpret=interpret,
+        )(q, k, v)
+        return o, lse
+
+    return fwd
+
+
+def _make_bwd_fast(seq_len):
+    def bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
+            interpret):
+        BH, Tp, D = q.shape
+        nq, nk = Tp // block_q, Tp // block_k
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+            keepdims=True,
+        )  # (BH, Tp, 1)
+
+        dq = pl.pallas_call(
+            functools.partial(
+                _dq_kernel_fast, block_q=block_q, causal=causal,
+                sm_scale=sm_scale, seq_len=seq_len,
+            ),
+            grid=(BH, nq),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda g, i: (g, i, 0)),
+                pl.BlockSpec((1, Tp, D), lambda g, i: (g, 0, 0)),
+                pl.BlockSpec((1, Tp, D), lambda g, i: (g, 0, 0)),
+                pl.BlockSpec((1, block_q, D), lambda g, i: (g, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda g, i: (g, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda g, i: (g, i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, D), lambda g, i: (g, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((BH, Tp, D), q.dtype),
+            compiler_params=_compiler_params(1),
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _dkv_kernel_fast, block_k=block_k, causal=causal,
+                sm_scale=sm_scale, seq_len=seq_len,
+            ),
+            grid=(BH, nk),
+            in_specs=[
+                pl.BlockSpec((1, Tp, D), lambda g, j: (g, 0, 0)),
+                pl.BlockSpec((1, block_k, D), lambda g, j: (g, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda g, j: (g, j, 0)),
+                pl.BlockSpec((1, Tp, D), lambda g, j: (g, 0, 0)),
+                pl.BlockSpec((1, Tp, 1), lambda g, j: (g, 0, 0)),
+                pl.BlockSpec((1, Tp, 1), lambda g, j: (g, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, D), lambda g, j: (g, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda g, j: (g, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((BH, Tp, D), k.dtype),
+                jax.ShapeDtypeStruct((BH, Tp, D), v.dtype),
+            ],
+            compiler_params=_compiler_params(1),
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+        return dq, dk, dv
+
+    return bwd
+
+
+# ---------------------------------------------------------------------------
+# Blocked path (long sequences): KV streamed via the grid with an
+# online-softmax scratch carry.
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, block_q, block_k, causal, sm_scale, seq_len):
+    i, j = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: kv blocks fully above the diagonal contribute nothing;
+    # when not causal every step runs unconditionally (no pl.when region)
+    def _step():
+        q = q_ref[0, 0]  # (BQ, D) input dtype
+        k = k_ref[0, 0]  # (BK, D)
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale  # (BQ, BK)
-        k_pos = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        mask = k_pos < seq_len
-        if causal:
-            mask = mask & (q_pos >= k_pos)
-        s = jnp.where(mask, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        s = _mask_scores(s, i * block_q, j * block_k, causal, seq_len)
+
+        m_prev = m_ref[:, :1]  # (BQ, 1)
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot_general(
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return acc, m_new, l
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l)  # (BQ, 1)
+    if causal:
+        pl.when(j * block_k < (i + 1) * block_q)(_step)
+    else:
+        _step()
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:, :1] + jnp.log(l)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               block_q, block_k, causal, sm_scale, seq_len):
-    qi = pl.program_id(2)
-    q = q_ref[0, 0]
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]  # (BQ, 1)
-    delta = delta_ref[0, 0]
-    kv_len = k_ref.shape[2]
-    nk_total = kv_len // block_k
-    nk = (
-        jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, nk_total)
-        if causal else nk_total
-    )
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
-    )
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc_ref, *, block_q, block_k, causal, sm_scale, seq_len):
+    i, j = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
 
-    def body(j, dq):
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+    @pl.when(j == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]  # (BQ, 1)
+        delta = delta_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
-        k_pos = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        mask = k_pos < seq_len
-        if causal:
-            mask = mask & (q_pos >= k_pos)
-        s = jnp.where(mask, s, NEG_INF)
+        s = _mask_scores(s, i * block_q, j * block_k, causal, seq_len)
         p = jnp.exp(s - lse)  # (BQ, BK), masked entries ~0
         dp = jax.lax.dot_general(
             do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta) * sm_scale
-        dq = dq + jax.lax.dot_general(
+        dq_acc_ref[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dq
 
-    dq = jax.lax.fori_loop(
-        0, nk, body, jnp.zeros((block_q, q.shape[1]), jnp.float32)
-    )
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+    if causal:
+        pl.when(j * block_k < (i + 1) * block_q)(_step)
+    else:
+        _step()
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc_ref[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, block_q, block_k, causal, sm_scale,
-                seq_len):
-    ki = pl.program_id(2)
-    k = k_ref[0, 0]  # (BK, D)
-    v = v_ref[0, 0]
-    q_len = q_ref.shape[2]
-    nq_total = q_len // block_q
-    # causal: the first q block that can see this kv block
-    i0 = (ki * block_k) // block_q if causal else 0
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1
-    )
+                dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, block_q, block_k,
+                causal, sm_scale, seq_len):
+    j, i = pl.program_id(2), pl.program_id(3)  # kv outer, q inner
+    nq = pl.num_programs(3)
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :]
-        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), :]  # (BQ, 1)
-        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), :]
+    @pl.when(i == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    # causal: q blocks strictly above this kv block see none of it
+    def _step():
+        q = q_ref[0, 0]  # (BQ, D)
+        k = k_ref[0, 0]  # (BK, D)
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]  # (BQ, 1)
+        delta = delta_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
-        q_pos = i * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        mask = k_pos < seq_len
-        if causal:
-            mask = mask & (q_pos >= k_pos)
-        s = jnp.where(mask, s, NEG_INF)
+        s = _mask_scores(s, i * block_q, j * block_k, causal, seq_len)
         p = jnp.exp(s - lse)  # (BQ, BK)
-        dv = dv + jax.lax.dot_general(
+        dv_acc_ref[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -176,18 +379,20 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta) * sm_scale
-        dk = dk + jax.lax.dot_general(
+        dk_acc_ref[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dk, dv
 
-    D = k.shape[1]
-    dk0 = jnp.zeros((block_k, D), jnp.float32)
-    dv0 = jnp.zeros((block_k, D), jnp.float32)
-    dk, dv = jax.lax.fori_loop(i0, nq_total, body, (dk0, dv0))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    if causal:
+        pl.when((i + 1) * block_q > j * block_k)(_step)
+    else:
+        _step()
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
 def _pad_to(x, t_target, axis=2):
@@ -202,27 +407,33 @@ def _pad_to(x, t_target, axis=2):
 def _make_fwd(seq_len):
     def fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         B, H, Tp, D = q.shape
-        nq = Tp // block_q
+        nq, nk = Tp // block_q, Tp // block_k
         kernel = functools.partial(
             _fwd_kernel, block_q=block_q, block_k=block_k, causal=causal,
             sm_scale=sm_scale, seq_len=seq_len,
         )
         o, lse = pl.pallas_call(
             kernel,
-            grid=(B, H, nq),
+            grid=(B, H, nq, nk),
             in_specs=[
-                pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, Tp, D), lambda b, h, i: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, Tp, D), lambda b, h, i: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((B, H, Tp, D), q.dtype),
                 jax.ShapeDtypeStruct((B, H, Tp, 1), jnp.float32),
             ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, D), jnp.float32),
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+            ],
+            compiler_params=_compiler_params(3),
             interpret=interpret,
         )(q, k, v)
         return o, lse
@@ -245,19 +456,21 @@ def _make_bwd(seq_len):
                 _dq_kernel, block_q=block_q, block_k=block_k, causal=causal,
                 sm_scale=sm_scale, seq_len=seq_len,
             ),
-            grid=(B, H, nq),
+            grid=(B, H, nq, nk),
             in_specs=[
-                pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, Tp, D), lambda b, h, i: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, Tp, D), lambda b, h, i: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
             ],
             out_specs=pl.BlockSpec(
-                (1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)
+                (1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)
             ),
             out_shape=jax.ShapeDtypeStruct((B, H, Tp, D), q.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+            compiler_params=_compiler_params(3),
             interpret=interpret,
         )(q, k, v, do, lse, delta)
 
@@ -266,28 +479,58 @@ def _make_bwd(seq_len):
                 _dkv_kernel, block_q=block_q, block_k=block_k, causal=causal,
                 sm_scale=sm_scale, seq_len=seq_len,
             ),
-            grid=(B, H, nk),
+            grid=(B, H, nk, nq),
             in_specs=[
-                pl.BlockSpec((1, 1, Tp, D), lambda b, h, j: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
-                pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
-                pl.BlockSpec((1, 1, Tp, D), lambda b, h, j: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, Tp, 1), lambda b, h, j: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, Tp, 1), lambda b, h, j: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
-                pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((B, H, Tp, D), k.dtype),
                 jax.ShapeDtypeStruct((B, H, Tp, D), v.dtype),
             ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, D), jnp.float32),
+                pltpu.VMEM((block_k, D), jnp.float32),
+            ],
+            compiler_params=_compiler_params(3),
             interpret=interpret,
         )(q, k, v, do, lse, delta)
         return dq, dk, dv
 
     return bwd
+
+
+@functools.lru_cache(maxsize=64)
+def _build_flash_fast(seq_len, causal, sm_scale, block_q, block_k,
+                      interpret):
+    """Fast-path custom_vjp, operating on a (B*H, Tp, D) view."""
+    fwd_impl = _make_fwd_fast(seq_len)
+    bwd_impl = _make_bwd_fast(seq_len)
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        o, _ = fwd_impl(q, k, v, causal, sm_scale, block_q, interpret)
+        return o
+
+    def f_fwd(q, k, v):
+        o, lse = fwd_impl(q, k, v, causal, sm_scale, block_q, interpret)
+        return o, (q, k, v, o, lse)
+
+    def f_bwd(res, do):
+        q, k, v, o, lse = res
+        return bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q,
+                        block_k, interpret)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
 
 
 @functools.lru_cache(maxsize=64)
@@ -316,20 +559,45 @@ def _build_flash(seq_len, causal, sm_scale, block_q, block_k, interpret):
     return f
 
 
-def flash_attention(q, k, v, *, causal=True, sm_scale=None, block_q=128,
-                    block_k=128, interpret=False):
+def flash_attention(q, k, v, *, causal=True, sm_scale=None, block_q=512,
+                    block_k=1024, interpret=False):
     """Flash attention, public layout (B, T, H, D). K/V must already be
-    repeated to Q's head count (ops.attention handles GQA)."""
+    repeated to Q's head count (ops.attention handles GQA).
+
+    Sequences with padded length <= _FAST_PATH_MAX_T dispatch to the
+    single-KV-block kernels; longer ones stream KV blocks through the grid
+    with the online-softmax carry. Default block sizes are the v5e sweep
+    winner for GPT-2 shapes (BASELINE.md attention table); both clamp to
+    the padded sequence.
+    """
     B, T, H, D = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
-    block_q = min(block_q, max(T, 1))
-    block_k = min(block_k, max(T, 1))
-    Tp = -(-T // max(block_q, block_k)) * max(block_q, block_k)
+    # Clamp oversized blocks to the next power of two >= T (never to the raw
+    # T: a non-power-of-two clamp breaks the mutual divisibility that the
+    # grids assume — q rows would silently be dropped). Then pad T to a
+    # multiple of both block sizes and fail loud if user-supplied blocks
+    # can't tile it.
+    t_pow2 = 1 << max(T - 1, 1).bit_length()
+    block_q = min(block_q, t_pow2)
+    block_k = min(block_k, t_pow2)
+    step = math.lcm(block_q, block_k)
+    Tp = -(-T // step) * step
+    assert Tp % block_q == 0 and Tp % block_k == 0, (
+        f"block_q={block_q}, block_k={block_k} cannot tile padded seq {Tp}"
+    )
 
     qt = _pad_to(q.transpose(0, 2, 1, 3), Tp)
     kt = _pad_to(k.transpose(0, 2, 1, 3), Tp)
     vt = _pad_to(v.transpose(0, 2, 1, 3), Tp)
-    f = _build_flash(T, causal, float(sm_scale), block_q, block_k, interpret)
-    o = f(qt, kt, vt)
+    if Tp <= _FAST_PATH_MAX_T:
+        f = _build_flash_fast(T, causal, float(sm_scale), block_q, block_k,
+                              interpret)
+        o = f(qt.reshape(B * H, Tp, D), kt.reshape(B * H, Tp, D),
+              vt.reshape(B * H, Tp, D))
+        o = o.reshape(B, H, Tp, D)
+    else:
+        f = _build_flash(T, causal, float(sm_scale), block_q, block_k,
+                         interpret)
+        o = f(qt, kt, vt)
     return o[:, :, :T, :].transpose(0, 2, 1, 3)

@@ -8,10 +8,25 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+import importlib
+
+# the package __init__ re-exports the function under the module's name, so a
+# plain `import ... as` would bind the function; importlib gets the module
+_fa_mod = importlib.import_module("avenir_tpu.ops.pallas.flash_attention")
+
 from avenir_tpu.ops.attention import causal_attention_reference
 from avenir_tpu.ops.pallas.flash_attention import flash_attention
 from avenir_tpu.ops.pallas.rmsnorm import rmsnorm_pallas
 from avenir_tpu.ops.rmsnorm import rmsnorm_reference
+
+
+@pytest.fixture(params=["fast", "blocked"])
+def fa_path(request, monkeypatch):
+    """Run flash-attention tests on both dispatch paths: the single-KV-block
+    fast path and the online-softmax blocked path (normally long-T only)."""
+    if request.param == "blocked":
+        monkeypatch.setattr(_fa_mod, "_FAST_PATH_MAX_T", 0)
+    return request.param
 
 
 def _qkv(B=2, T=128, H=2, D=64, dtype=jnp.float32, seed=0):
@@ -24,7 +39,7 @@ def _qkv(B=2, T=128, H=2, D=64, dtype=jnp.float32, seed=0):
 
 
 @pytest.mark.parametrize("T,block", [(128, 64), (96, 64), (256, 128)])
-def test_flash_attention_forward(T, block):
+def test_flash_attention_forward(T, block, fa_path):
     q, k, v = _qkv(T=T)
     out = flash_attention(q, k, v, causal=True, block_q=block, block_k=block,
                           interpret=True)
@@ -33,11 +48,12 @@ def test_flash_attention_forward(T, block):
                                atol=2e-5, rtol=2e-5)
 
 
-def test_flash_attention_grads():
+@pytest.mark.parametrize("bq,bk", [(64, 64), (64, 128), (128, 64)])
+def test_flash_attention_grads(bq, bk, fa_path):
     q, k, v = _qkv(T=128)
 
     def loss_flash(q, k, v):
-        o = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+        o = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk,
                             interpret=True)
         return jnp.sum(o * o)
 
@@ -64,6 +80,18 @@ def test_flash_attention_bf16_close_to_fp32_oracle():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref), atol=3e-2, rtol=3e-2
     )
+
+
+def test_flash_attention_default_blocks_odd_seq(fa_path):
+    """Regression: with the production default block sizes and a sequence
+    length in (block_q, block_k) — e.g. 600 — every q row must be written
+    (round-2 bug: Tp was not padded to a multiple of both block sizes, so
+    rows past nq*block_q came back uninitialized/NaN)."""
+    q, k, v = _qkv(B=1, T=600, H=1)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = causal_attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
 
 
 def test_flash_attention_padding_mask():

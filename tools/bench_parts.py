@@ -1,0 +1,136 @@
+"""Component-level timing of the GPT-2-124M train step on the real chip.
+
+Decomposes the step into: full step, trunk-only (no lm_head/CE), lm_head+CE
+alone, attention alone (pallas vs xla) — so BASELINE.md perf claims point at
+measured numbers, not guesses. Dev tool; not part of the test suite.
+
+Usage: python tools/bench_parts.py [--batch=16] [--block=1024]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, warmup=3, iters=10):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    # D2H readback fences the queue on the axon-tunneled platform
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[0]
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[0]
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    args = {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
+            for a in sys.argv[1:]}
+    B = int(args.get("batch", 16))
+    T = int(args.get("block", 1024))
+    C, H, V, L = 768, 12, 50304, 12
+
+    rng = np.random.default_rng(0)
+    x_tok = jnp.asarray(rng.integers(0, V, (B, T)).astype(np.int32))
+    y_tok = jnp.asarray(rng.integers(0, V, (B, T)).astype(np.int32))
+    xf = jnp.asarray(rng.standard_normal((B, T, C)).astype(np.float32) * 0.02,
+                     jnp.bfloat16)
+    wte = jnp.asarray(rng.standard_normal((V, C)).astype(np.float32) * 0.02)
+    q = jnp.asarray(rng.standard_normal((B, T, H, C // H)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, T, H, C // H)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, T, H, C // H)), jnp.bfloat16)
+
+    results = {}
+
+    # ---- full train step (the bench.py number, minus data movement) ----
+    from flax import nnx
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+    from avenir_tpu.train.optimizer import make_optimizer
+    from avenir_tpu.train.step import jit_train_step, make_step_fns
+
+    for attn in ("pallas", "xla"):
+        cfg = GPTConfig(block_size=T, vocab_size=V, n_layer=L, n_head=H,
+                        n_embd=C, dropout=0.0, bias=True,
+                        compute_dtype="bfloat16", attn_impl=attn)
+        model = GPT(cfg, rngs=nnx.Rngs(0))
+        graphdef, params = nnx.split(model, nnx.Param)
+        tx, _ = make_optimizer(params, learning_rate=6e-4, weight_decay=0.1,
+                               beta1=0.9, beta2=0.95, grad_clip=1.0,
+                               warmup_iters=10, lr_decay_iters=1000,
+                               min_lr=6e-5)
+        opt_state = jax.jit(tx.init)(params)
+        step_fn, _ = make_step_fns(graphdef, dropout=0.0)
+        step = jit_train_step(step_fn, tx)
+        key = jax.random.key(0)
+        xb, yb = x_tok[None], y_tok[None]
+
+        def run(p, o):
+            p2, o2, m = step(p, o, key, xb, yb)
+            return m["loss"]
+
+        # donation: re-init state each call would skew; time the chain instead
+        for _ in range(3):
+            params, opt_state, m = step(params, opt_state, key, xb, yb)
+        float(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(10):
+            params, opt_state, m = step(params, opt_state, key, xb, yb)
+        float(m["loss"])
+        dt = (time.perf_counter() - t0) / 10
+        results[f"full_step_{attn}"] = dt
+        del params, opt_state
+
+    # ---- trunk only: fwd+bwd through blocks, NO lm_head/CE ----
+    cfg = GPTConfig(block_size=T, vocab_size=V, n_layer=L, n_head=H,
+                    n_embd=C, dropout=0.0, bias=True,
+                    compute_dtype="bfloat16", attn_impl="pallas")
+    model = GPT(cfg, rngs=nnx.Rngs(0))
+    graphdef, params = nnx.split(model, nnx.Param)
+
+    def trunk_loss(p, idx):
+        m = nnx.merge(graphdef, p)
+        pos = jnp.arange(T, dtype=jnp.int32)
+        h = m.wte(idx) + m.wpe(pos)[None]
+        for blk in m.h:
+            h = blk(h)
+        h = m.ln_f(h)
+        return h.astype(jnp.float32).mean()
+
+    g_trunk = jax.jit(jax.grad(trunk_loss))
+    results["trunk_fwd_bwd"] = timeit(lambda: g_trunk(params, x_tok))
+
+    # ---- lm_head + CE alone: grad wrt (x, wte) ----
+    from avenir_tpu.models.common import cross_entropy_loss
+
+    def head_loss(xh, w, tgt):
+        logits = (xh @ w.astype(xh.dtype).T)
+        return cross_entropy_loss(logits, tgt, ignore_index=-1)
+
+    g_head = jax.jit(jax.grad(head_loss, argnums=(0, 1)))
+    results["lm_head_ce_fwd_bwd"] = timeit(lambda: g_head(xf, wte, y_tok))
+
+    # ---- attention alone, fwd+bwd ----
+    from avenir_tpu.ops import causal_attention
+
+    for impl in ("pallas", "xla"):
+        def attn_loss(q_, k_, v_):
+            return causal_attention(q_, k_, v_, impl=impl).astype(
+                jnp.float32).mean()
+
+        g_attn = jax.jit(jax.grad(attn_loss, argnums=(0, 1, 2)))
+        results[f"attn_fwd_bwd_{impl}"] = timeit(lambda: g_attn(q, k, v))
+        # x12 layers
+        results[f"attn_fwd_bwd_{impl}_x{L}"] = results[f"attn_fwd_bwd_{impl}"] * L
+
+    for name, dt in results.items():
+        print(f"{name:32s} {dt * 1e3:8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
