@@ -52,6 +52,13 @@ _LANES = 128
 _FAST_PATH_MAX_T = 2048
 
 
+def _branch(pred, then_fn, else_fn):
+    """Exactly one of the two branches runs per grid step (the else branch
+    is the negation by construction — non-exclusive pairs unrepresentable)."""
+    pl.when(pred)(then_fn)
+    pl.when(jnp.logical_not(pred))(else_fn)
+
+
 def _mask_scores(s, q_off, k_off, causal, seq_len):
     """Apply padded-kv and (optionally) causal masking to a score block.
     `s` is (BQ, BK) fp32; q_off/k_off are the block's global row/col bases."""
@@ -86,76 +93,116 @@ def _compiler_params(n_parallel):
 def _fwd_kernel_fast(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
                      causal, sm_scale, seq_len):
     i = pl.program_id(1)
+    nq = pl.num_programs(1)
     q = q_ref[0]  # (BQ, D)
-    k = k_ref[0]  # (Tp, D)
-    v = v_ref[0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
-    ) * sm_scale  # (BQ, Tp)
-    s = _mask_scores(s, i * block_q, 0, causal, seq_len)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
-    o = jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    o_ref[0] = (o / l).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)
+    tp = k_ref.shape[1]
+
+    def _attend(kv_len):
+        # static upper bound on the kv columns this q block can see
+        k = k_ref[0, :kv_len, :]
+        v = v_ref[0, :kv_len, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # (BQ, kv_len)
+        s = _mask_scores(s, i * block_q, 0, causal, seq_len)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+        o = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        o_ref[0] = (o / l).astype(o_ref.dtype)
+        lse_ref[0] = m + jnp.log(l)
+
+    # causal halving: q blocks in the first half of the sequence only see
+    # the first half of KV — a static-slice branch, so the MXU/VPU work for
+    # those blocks is halved (pl.when picks the branch per grid step)
+    if causal and nq >= 2 and tp % 2 == 0:
+        _branch((i + 1) * block_q <= tp // 2,
+                lambda: _attend(tp // 2), lambda: _attend(tp))
+    else:
+        _attend(tp)
 
 
 def _dq_kernel_fast(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                     *, block_q, causal, sm_scale, seq_len):
     i = pl.program_id(1)
+    nq = pl.num_programs(1)
     q = q_ref[0]
-    k = k_ref[0]  # (Tp, D)
-    v = v_ref[0]
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0]  # (BQ, 1)
     delta = delta_ref[0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
-    ) * sm_scale
-    s = _mask_scores(s, i * block_q, 0, causal, seq_len)
-    p = jnp.exp(s - lse)
-    dp = jax.lax.dot_general(
-        do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    ds = p * (dp - delta) * sm_scale
-    dq_ref[0] = jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ).astype(dq_ref.dtype)
+    tp = k_ref.shape[1]
+
+    def _grad(kv_len):
+        k = k_ref[0, :kv_len, :]
+        v = v_ref[0, :kv_len, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        s = _mask_scores(s, i * block_q, 0, causal, seq_len)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * sm_scale
+        dq_ref[0] = jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(dq_ref.dtype)
+
+    if causal and nq >= 2 and tp % 2 == 0:
+        _branch((i + 1) * block_q <= tp // 2,
+                lambda: _grad(tp // 2), lambda: _grad(tp))
+    else:
+        _grad(tp)
 
 
 def _dkv_kernel_fast(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      dk_ref, dv_ref, *, block_k, causal, sm_scale, seq_len):
     j = pl.program_id(1)
-    q = q_ref[0]  # (Tp, D) — all q rows
+    nk = pl.num_programs(1)
     k = k_ref[0]  # (BK, D)
     v = v_ref[0]
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]  # (Tp, 1)
-    delta = delta_ref[0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
-    ) * sm_scale  # (Tp, BK)
-    s = _mask_scores(s, 0, j * block_k, causal, seq_len)
-    p = jnp.exp(s - lse)  # (Tp, BK)
-    dv_ref[0] = jax.lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ).astype(dv_ref.dtype)
-    dp = jax.lax.dot_general(
-        do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    ds = p * (dp - delta) * sm_scale
-    dk_ref[0] = jax.lax.dot_general(
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ).astype(dk_ref.dtype)
+    tp = q_ref.shape[1]
+
+    def _grad(q_start):
+        # static lower bound on the q rows that can see this kv block
+        q = q_ref[0, q_start:, :]
+        do = do_ref[0, q_start:, :].astype(jnp.float32)
+        lse = lse_ref[0, q_start:, :]
+        delta = delta_ref[0, q_start:, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # (Tp - q_start, BK)
+        s = _mask_scores(s, q_start, j * block_k, causal, seq_len)
+        p = jnp.exp(s - lse)
+        dv_ref[0] = jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(dv_ref.dtype)
+        dp = jax.lax.dot_general(
+            do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * sm_scale
+        dk_ref[0] = jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(dk_ref.dtype)
+
+    # causal halving: kv blocks in the second half of the sequence are only
+    # seen by the second half of the q rows
+    if causal and nk >= 2 and tp % 2 == 0:
+        _branch(j * block_k >= tp // 2,
+                lambda: _grad(tp // 2), lambda: _grad(0))
+    else:
+        _grad(0)
 
 
 def _make_fwd_fast(seq_len):

@@ -76,6 +76,7 @@ def main():
         n_embd=768, dropout=0.0, bias=True,
         compute_dtype="bfloat16" if on_tpu else "float32",
         attn_impl=attn_impl,
+        remat=args.get("remat", "") in ("1", "True", "true"),
     )
     mesh = make_mesh("")  # all chips on 'data'
     n_chips = int(np.prod(list(mesh.shape.values())))
@@ -156,6 +157,7 @@ def main():
             "mfu": round(float(mfu), 4),
             "attn": attn_impl,
             "opt_pallas": bool(use_pallas_opt),
+            "remat": cfg.remat,
         },
     }
     print(json.dumps(result))
