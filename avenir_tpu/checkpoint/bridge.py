@@ -19,6 +19,19 @@ the partition-rule miss policy (SURVEY.md §4).
 import numpy as np
 from flax import nnx
 
+from avenir_tpu.checkpoint.torch_pt import LazyArray, lazy_unstack
+
+
+def _swap_last2(arr):
+    """Transpose the last two axes, staying lazy for LazyArray entries
+    (the streaming checkpoint path materializes one tensor at a time)."""
+    if isinstance(arr, LazyArray):
+        shp = arr.shape[:-2] + (arr.shape[-1], arr.shape[-2])
+        return arr.transform(
+            lambda x: np.ascontiguousarray(np.swapaxes(x, -1, -2)), shape=shp
+        )
+    return np.swapaxes(np.asarray(arr), -1, -2)
+
 # module attribute names that are nnx.Linear (torch weight needs transpose)
 _LINEAR = {
     "c_attn", "c_proj", "c_fc",                      # gpt
@@ -100,6 +113,77 @@ def _as_state(model_or_state):
     return model_or_state
 
 
+# ---- scan-stacked layer containers (models/common.stacked_layers) ----
+#
+# A model built with scan_layers=True stores its L homogeneous layers as ONE
+# submodule named `<base>_scan` (h_scan, layers_scan) whose params carry a
+# leading (L, ...) axis. On disk we keep the EXACT per-layer torch schema
+# (transformer.h.0..., model.layers.0...), so scanned and unscanned models
+# produce byte-identical checkpoints: export splits the stacked arrays,
+# import re-stacks them when the target model expects the scanned layout.
+
+
+def _scan_seg_index(path):
+    for i, seg in enumerate(path):
+        if isinstance(seg, str) and seg.endswith("_scan"):
+            return i
+    return None
+
+
+def unstack_scanned_paths(flat):
+    """{nnx path: array} → same dict with every `<base>_scan` entry split
+    into per-layer `(<base>, l, ...)` entries along its leading axis.
+    LazyArray entries split into lazy slices (base gathered once, freed
+    after the last slice is consumed)."""
+    out = {}
+    for path, arr in flat.items():
+        i = _scan_seg_index(path)
+        if i is None:
+            out[path] = arr
+            continue
+        base = path[i][: -len("_scan")]
+        n = int(arr.shape[0])
+        if isinstance(arr, LazyArray):
+            slices = lazy_unstack(arr, n)
+        else:
+            a = np.asarray(arr)
+            slices = [a[l] for l in range(n)]
+        for l in range(n):
+            out[path[:i] + (base, l) + path[i + 1:]] = slices[l]
+    return out
+
+
+def restack_scanned_paths(flat, target_paths):
+    """Inverse of unstack_scanned_paths: for each target path that crosses a
+    `<base>_scan` container, collect the per-layer `(<base>, l, ...)` source
+    entries from `flat` and stack them. Non-scan entries pass through.
+    Lazy sources stay lazy (the stack happens when the target is placed on
+    device, one stacked tensor on host at a time)."""
+    out = dict(flat)
+    for tp in target_paths:
+        i = _scan_seg_index(tp)
+        if i is None:
+            continue
+        base = tp[i][: -len("_scan")]
+        layers = []
+        while True:
+            src = tp[:i] + (base, len(layers)) + tp[i + 1:]
+            if src not in out:
+                break
+            layers.append(out.pop(src))
+        if not layers:
+            continue
+        if any(isinstance(a, LazyArray) for a in layers):
+            first = layers[0]
+            out[tp] = LazyArray(
+                (len(layers),) + tuple(first.shape), first.dtype,
+                lambda ls=layers: np.stack([np.asarray(a) for a in ls]),
+            )
+        else:
+            out[tp] = np.stack([np.asarray(a) for a in layers])
+    return out
+
+
 def _stack_expert_keys(sd):
     """HF Mixtral stores one 2-D tensor per expert
     (…block_sparse_moe.experts.N.w1.weight, (out, in)); our model stacks
@@ -112,15 +196,24 @@ def _stack_expert_keys(sd):
             rest[key] = arr
             continue
         gkey = (m.group("pre"), m.group("w"))
-        groups.setdefault(gkey, {})[int(m.group("idx"))] = np.asarray(arr)
+        groups.setdefault(gkey, {})[int(m.group("idx"))] = arr
     stacked = {}
     for (pre, w), by_idx in groups.items():
-        arrs = [np.swapaxes(by_idx[i], -1, -2) for i in range(len(by_idx))]
+        arrs = [_swap_last2(by_idx[i]) for i in range(len(by_idx))]
         parts = pre.split(".")
         if parts[0] in ("transformer", "model"):
             parts = parts[1:]
         path = tuple(int(p) if p.isdigit() else p for p in parts) + (w,)
-        stacked[path] = np.stack(arrs)
+        if any(isinstance(a, LazyArray) for a in arrs):
+            # keep the stack lazy: expert tensors are the bulk of an MoE
+            # model — materializing all E here would defeat streaming
+            first = arrs[0]
+            stacked[path] = LazyArray(
+                (len(arrs),) + tuple(first.shape), first.dtype,
+                lambda ls=arrs: np.stack([np.asarray(a) for a in ls]),
+            )
+        else:
+            stacked[path] = np.stack(arrs)
     return stacked, rest
 
 
@@ -134,9 +227,10 @@ def torch_sd_to_flat_paths(sd, tied_lm_head=True):
         path, transpose = torch_key_to_nnx_path(key, tied_lm_head=tied_lm_head)
         if path is None:
             continue  # tied weight
-        arr = np.asarray(arr)
         if transpose:
-            arr = np.swapaxes(arr, -1, -2)
+            arr = _swap_last2(arr)
+        elif not isinstance(arr, LazyArray):
+            arr = np.asarray(arr)
         out[path] = arr
     return out
 
@@ -148,7 +242,10 @@ def load_torch_state_dict(model, sd, strict=True, tied_lm_head=True):
     state = nnx.state(model, nnx.Param)
     flat = {path: v for path, v in state.flat_state()}
     seen = set()
-    for path, arr in torch_sd_to_flat_paths(sd, tied_lm_head).items():
+    arrays = restack_scanned_paths(
+        torch_sd_to_flat_paths(sd, tied_lm_head), flat.keys()
+    )
+    for path, arr in arrays.items():
         if path not in flat:
             if strict:
                 raise KeyError(
@@ -181,18 +278,26 @@ def export_torch_state_dict(model, model_family="gpt", tied_lm_head=True):
     state = _as_state(model)
     sd = {}
     prefix = "transformer" if model_family == "gpt" else "model"
-    for path, var in state.flat_state():
-        arr = np.asarray(var.get_value())
+
+    def _host(v):
+        x = v.get_value()
+        return x if isinstance(x, LazyArray) else np.asarray(x)
+
+    flat = unstack_scanned_paths(
+        {path: _host(var) for path, var in state.flat_state()}
+    )
+    for path, arr in flat.items():
         if path[-1] in ("w1", "w2", "w3") and "experts" in path:
             # stacked (E, in, out) → HF per-expert (out, in) tensors
             base = ".".join(str(p) for p in ([prefix] + list(path[:-1])))
-            for e in range(arr.shape[0]):
-                sd[f"{base}.{e}.{path[-1]}.weight"] = np.swapaxes(
-                    arr[e], -1, -2
-                )
+            E = int(arr.shape[0])
+            slices = (lazy_unstack(arr, E) if isinstance(arr, LazyArray)
+                      else [arr[e] for e in range(E)])
+            for e in range(E):
+                sd[f"{base}.{e}.{path[-1]}.weight"] = _swap_last2(slices[e])
             continue
         key, transpose = nnx_path_to_torch_key(path, model_family=model_family)
-        sd[key] = np.swapaxes(arr, -1, -2) if transpose else arr
+        sd[key] = _swap_last2(arr) if transpose else arr
     if tied_lm_head:
         wte_key = (
             "transformer.wte.weight" if model_family == "gpt"

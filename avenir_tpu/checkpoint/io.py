@@ -15,10 +15,11 @@ from flax import nnx
 
 from avenir_tpu.checkpoint.bridge import (
     export_torch_state_dict,
+    restack_scanned_paths,
     torch_key_to_nnx_path,
     torch_sd_to_flat_paths,
 )
-from avenir_tpu.checkpoint.torch_pt import load_pt, save_pt
+from avenir_tpu.checkpoint.torch_pt import LazyArray, load_pt, save_pt
 
 
 def torch_param_order(sd, model_family="gpt"):
@@ -81,18 +82,38 @@ def _replace_adam_state(opt_state, new_adam):
     return walk(opt_state)
 
 
-def gather_to_host(tree):
-    """Pull (possibly sharded) jax arrays to replicated host numpy. On a
+def _gather_one(x):
+    """Pull one (possibly sharded) jax array to host numpy. On a
     multi-host mesh every process participates in the all-gather; the
     coordinator alone writes the file (SURVEY.md §3.4 ⟨proc⟩ note)."""
-    def gather(x):
-        if isinstance(x, jax.Array) and not x.is_fully_addressable:
-            from jax.experimental import multihost_utils
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
 
-            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
-        return np.asarray(jax.device_get(x))
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(jax.device_get(x))
 
-    return jax.tree.map(gather, tree)
+
+def gather_to_host(tree):
+    """Eager whole-tree host gather (small trees / tests)."""
+    return jax.tree.map(_gather_one, tree)
+
+
+def lazy_gather_tree(tree):
+    """Replace every jax array leaf with a LazyArray that gathers it on
+    materialize. The streaming .pt writer then pulls ONE tensor to host at
+    a time — peak host memory is the largest tensor, not the full tree
+    (the big-model save path, SURVEY.md §5 checkpoint bullet)."""
+    def lazy(x):
+        if isinstance(x, jax.Array):
+            out = LazyArray(x.shape, np.dtype(x.dtype),
+                            lambda x=x: _gather_one(x), source=x)
+            # device-side slicing hook for lazy_unstack: x[i] slices on
+            # device; gather pulls just that layer to host
+            out.gather_fn = _gather_one
+            return out
+        return np.asarray(x)
+
+    return jax.tree.map(lazy, tree)
 
 
 def _tied(model_family):
@@ -110,16 +131,20 @@ def save_checkpoint(out_dir, *, params, opt_state, hyper, model_args,
     mixtral have no torch counterpart in-repo; their moments are stored
     under torch-style KEYS instead of indices ("format": "avenir_adamw"),
     same container."""
-    params_host = gather_to_host(params)
     tied = _tied(model_family)
-    sd = export_torch_state_dict(params_host, model_family=model_family,
+    # lazy leaves: nothing is gathered here — the streaming save_pt pulls
+    # one tensor to host at a time while writing
+    sd = export_torch_state_dict(lazy_gather_tree(params),
+                                 model_family=model_family,
                                  tied_lm_head=tied)
-    adam = _find_adam_state(gather_to_host(opt_state))
-    mu_sd = export_torch_state_dict(adam.mu, model_family=model_family,
+    adam = _find_adam_state(opt_state)
+    mu_sd = export_torch_state_dict(lazy_gather_tree(adam.mu),
+                                    model_family=model_family,
                                     tied_lm_head=False)
-    nu_sd = export_torch_state_dict(adam.nu, model_family=model_family,
+    nu_sd = export_torch_state_dict(lazy_gather_tree(adam.nu),
+                                    model_family=model_family,
                                     tied_lm_head=False)
-    step = float(np.asarray(adam.count))
+    step = float(np.asarray(_gather_one(adam.count)))
 
     if model_family == "gpt":
         order = torch_param_order(sd, model_family)
@@ -168,15 +193,20 @@ def save_checkpoint(out_dir, *, params, opt_state, hyper, model_args,
         "config": dict(config),
         "model_family": model_family,
     }
-    if jax.process_index() == 0:
+    # every process materializes (collective per-leaf gathers); only the
+    # coordinator writes the file
+    write = jax.process_index() == 0
+    if write:
         os.makedirs(out_dir, exist_ok=True)
-        save_pt(ckpt, os.path.join(out_dir, "ckpt.pt"))
+    save_pt(ckpt, os.path.join(out_dir, "ckpt.pt"), write=write)
 
 
-def load_checkpoint(out_dir):
+def load_checkpoint(out_dir, lazy=False):
     """Read out_dir/ckpt.pt (either backend's) into host numpy. Returns the
-    raw dict; use restore_params/restore_opt_state to place on device."""
-    return load_pt(os.path.join(out_dir, "ckpt.pt"))
+    raw dict; use restore_params/restore_opt_state to place on device.
+    `lazy=True`: tensors are LazyArray stubs read from the zip only when
+    restore places them — the host never holds the full tree."""
+    return load_pt(os.path.join(out_dir, "ckpt.pt"), lazy=lazy)
 
 
 def _strip_compile_prefix(sd):
@@ -190,13 +220,18 @@ def restore_params(ckpt, abs_state, shardings, model_family="gpt"):
     sd = _strip_compile_prefix(dict(ckpt["model"]))
     flat = {p: v for p, v in abs_state.flat_state()}
     out = {}
-    for path, a in torch_sd_to_flat_paths(
-        sd, tied_lm_head=_tied(model_family)
-    ).items():
+    arrays = restack_scanned_paths(
+        torch_sd_to_flat_paths(sd, tied_lm_head=_tied(model_family)),
+        flat.keys(),
+    )
+    for path, a in arrays.items():
         assert path in flat, f"checkpoint path {path} not in model"
         var = flat[path]
-        a = np.ascontiguousarray(a).astype(var.get_value().dtype)
+        # materialize ONE tensor at a time (lazy checkpoints) and free the
+        # host copy as soon as device_put returns
+        a = np.ascontiguousarray(np.asarray(a)).astype(var.get_value().dtype)
         out[path] = var.replace(jax.device_put(a, shardings[path]))
+        del a
     missing = set(flat) - set(out)
     assert not missing, f"checkpoint missing params: {sorted(missing)}"
     return nnx.State.from_flat_path(out)
@@ -237,15 +272,16 @@ def restore_opt_state(ckpt, opt_state, params, param_shardings,
         indexed = decay + nodecay
         tstate = opt_entry["state"]
         step = 0.0
+        from avenir_tpu.checkpoint.bridge import _swap_last2
+
         for i, key in enumerate(indexed):
             ent = tstate[i]
             path, transpose = torch_key_to_nnx_path(key)
-            step = float(np.asarray(ent["step"]))
+            # torch may store step as a 0-d or 1-element tensor
+            step = float(np.asarray(ent["step"]).reshape(-1)[0])
             for src, dst in (("exp_avg", mu_flat), ("exp_avg_sq", nu_flat)):
-                a = np.asarray(ent[src], dtype=np.float32)
-                if transpose:
-                    a = np.ascontiguousarray(a.T)
-                dst[path] = jax.device_put(a, flat_shard[path])
+                a = ent[src]  # may be a LazyArray; stays lazy until placed
+                dst[path] = _swap_last2(a) if transpose else a
     else:  # avenir_adamw schema (llama/mixtral)
         assert opt_entry.get("format") == "avenir_adamw", opt_entry.keys()
         step = float(opt_entry["step"])
@@ -253,11 +289,19 @@ def restore_opt_state(ckpt, opt_state, params, param_shardings,
             for path, a in torch_sd_to_flat_paths(
                 opt_entry[src_name], tied_lm_head=False
             ).items():
-                dst[path] = jax.device_put(
-                    np.ascontiguousarray(a).astype(np.float32),
-                    flat_shard[path],
-                )
+                dst[path] = a
 
+    def _place(flat):
+        # one tensor on host at a time: materialize → device_put → free
+        out = {}
+        for p, a in restack_scanned_paths(flat, flat_shard.keys()).items():
+            arr = np.ascontiguousarray(np.asarray(a), dtype=np.float32)
+            out[p] = jax.device_put(arr, flat_shard[p])
+            del arr
+        return out
+
+    mu_flat = _place(mu_flat)
+    nu_flat = _place(nu_flat)
     pflat = {p: v for p, v in params.flat_state()}
     mu = nnx.State.from_flat_path(
         {p: pflat[p].replace(mu_flat[p]) for p in pflat}

@@ -37,6 +37,102 @@ import numpy as np
 
 BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
 
+
+class LazyArray:
+    """A tensor placeholder carrying (shape, dtype) metadata plus a
+    provider fn, materialized only at the moment its bytes are needed.
+    The streaming checkpoint path (SURVEY.md §5 "sharded-read": no host
+    ever holds the full fp32 tree) threads these through the bridge and
+    the .pt writer/reader: save gathers ONE tensor at a time while
+    writing the zip; load reads ONE storage at a time while device_put
+    places it. numpy interop via __array__ (any numpy op materializes)."""
+
+    def __init__(self, shape, dtype, fn, source=None):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(dtype)
+        self._fn = fn
+        # optional device-array handle: lets consumers slice ON DEVICE
+        # (lazy_unstack gathers one layer at a time instead of holding the
+        # whole stacked base on host across the layer-major write order)
+        self.source = source
+
+    @property
+    def size(self):
+        out = 1
+        for s in self.shape:
+            out *= s
+        return out
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def materialize(self):
+        arr = np.asarray(self._fn())
+        assert arr.shape == self.shape and arr.dtype == self.dtype, (
+            f"lazy provider returned {arr.shape}/{arr.dtype}, "
+            f"declared {self.shape}/{self.dtype}"
+        )
+        return arr
+
+    def __array__(self, dtype=None, copy=None):
+        arr = self.materialize()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def transform(self, f, shape=None, dtype=None):
+        """Deferred elementwise/layout transform (transpose, cast, ...)."""
+        return LazyArray(self.shape if shape is None else shape,
+                         self.dtype if dtype is None else dtype,
+                         lambda: f(self.materialize()))
+
+    def astype(self, dtype):
+        return self.materialize().astype(dtype)
+
+    # torch checkpoints store scalars like best_val_loss as 0-d tensors;
+    # lazy loads must still support float()/int() on them
+    def __float__(self):
+        return float(self.materialize().reshape(-1)[0])
+
+    def __int__(self):
+        return int(self.materialize().reshape(-1)[0])
+
+
+def lazy_unstack(a, n):
+    """Split a stacked (n, ...) LazyArray/ndarray into n lazy slices.
+
+    When the LazyArray carries a device-array `source`, each slice gathers
+    ONLY its own layer from device (x[i] is a device-side slice) — nothing
+    larger than one layer ever lands on host, regardless of consumption
+    order. Otherwise the base is materialized once, shared, and refcounted
+    (freed after the last slice is consumed) — but note the base stays
+    live from the first slice to the last, so prefer sourced arrays for
+    big stacks."""
+    shape = tuple(a.shape[1:])
+    dtype = a.dtype
+    src = getattr(a, "source", None)
+    if src is not None:
+        gather = a.gather_fn if getattr(a, "gather_fn", None) else np.asarray
+        return [
+            LazyArray(shape, dtype,
+                      lambda i=i: np.asarray(gather(src[i])))
+            for i in range(n)
+        ]
+    state = {"v": None, "left": n}
+
+    def make(i):
+        def fn():
+            if state["v"] is None:
+                state["v"] = np.asarray(a)
+            out = np.ascontiguousarray(state["v"][i])
+            state["left"] -= 1
+            if state["left"] <= 0:
+                state["v"] = None
+            return out
+
+        return fn
+
+    return [LazyArray(shape, dtype, make(i)) for i in range(n)]
+
 # torch legacy storage class name ↔ numpy dtype
 _STORAGE_TO_DTYPE = {
     "DoubleStorage": np.dtype("<f8"),
@@ -62,14 +158,38 @@ class _StorageType:
         self.name = name
 
 
+class _LazyStorage:
+    """Deferred zip storage read for load_pt(lazy=True)."""
+
+    def __init__(self, path, entry, dtype):
+        self.path = path
+        self.entry = entry
+        self.dtype = dtype
+
+    def load(self):
+        with zipfile.ZipFile(self.path, "r") as zf:
+            return np.frombuffer(zf.read(self.entry), dtype=self.dtype)
+
+
 def _rebuild_tensor_v2(storage, offset, size, stride, requires_grad,
                        backward_hooks, metadata=None):
-    """Reconstruct a tensor as a numpy array from a flat storage array."""
-    itemsize = storage.dtype.itemsize
-    byte_strides = tuple(s * itemsize for s in stride)
-    return np.lib.stride_tricks.as_strided(
-        storage[offset:], shape=tuple(size), strides=byte_strides, writeable=False
-    )
+    """Reconstruct a tensor as a numpy array (or LazyArray when reading
+    lazily) from a flat storage."""
+    def strided(flat):
+        itemsize = flat.dtype.itemsize
+        byte_strides = tuple(s * itemsize for s in stride)
+        return np.lib.stride_tricks.as_strided(
+            flat[offset:], shape=tuple(size), strides=byte_strides,
+            writeable=False,
+        )
+
+    if isinstance(storage, _LazyStorage):
+        # np.array (not ascontiguousarray: it promotes 0-d to 1-d)
+        return LazyArray(
+            tuple(size), storage.dtype,
+            lambda: np.array(strided(storage.load())),
+        )
+    return strided(storage)
 
 
 class _Unpickler(pickle.Unpickler):
@@ -104,9 +224,14 @@ class _Unpickler(pickle.Unpickler):
         return self._load_storage(str(key), dtype)
 
 
-def load_pt(path_or_file):
+def load_pt(path_or_file, lazy=False):
     """Load a torch-format .pt file. Returns the object with every tensor
-    as a numpy array (copies — safe after the zip closes)."""
+    as a numpy array (copies — safe after the zip closes).
+
+    `lazy=True` (requires a real path): tensors come back as LazyArray
+    stubs that re-open the zip and read their storage only when
+    materialized — restore places one tensor on device at a time without
+    the host ever holding the full tree (SURVEY.md §5 sharded-read)."""
     with zipfile.ZipFile(path_or_file, "r") as zf:
         names = zf.namelist()
         pkl_name = next(n for n in names if n.endswith("/data.pkl"))
@@ -114,6 +239,8 @@ def load_pt(path_or_file):
         cache = {}
 
         def load_storage(key, dtype):
+            if lazy:
+                return _LazyStorage(path_or_file, f"{prefix}data/{key}", dtype)
             if key not in cache:
                 raw = zf.read(f"{prefix}data/{key}")
                 cache[key] = np.frombuffer(raw, dtype=dtype)
@@ -173,7 +300,7 @@ class _MiniPickler:
         elif isinstance(obj, str):
             raw = obj.encode("utf-8")
             self.w(b"X" + struct.pack("<I", len(raw)) + raw)
-        elif isinstance(obj, np.ndarray):
+        elif isinstance(obj, (np.ndarray, LazyArray)):
             self.save_tensor(obj)
         elif isinstance(obj, (dict, collections.OrderedDict)):
             self.save_dict(obj)
@@ -282,19 +409,34 @@ def _pickle_checkpoint(obj, storages):
     return out.getvalue()
 
 
-def save_pt(obj, path, stem="archive"):
-    """Write `obj` (dicts/lists/scalars/str/numpy arrays) as a torch-format
-    .pt that real `torch.load` accepts. Arrays become CPU tensors."""
+def save_pt(obj, path, stem="archive", write=True):
+    """Write `obj` (dicts/lists/scalars/str/numpy/LazyArray) as a
+    torch-format .pt that real `torch.load` accepts. Arrays become CPU
+    tensors. LazyArray entries are STREAMED: each is materialized only
+    while its storage bytes are written, then freed — peak host memory is
+    one tensor, not the tree.
+
+    `write=False` materializes every storage without touching the file:
+    on a multi-host mesh every process must participate in the per-leaf
+    allgathers, but only the coordinator writes (SURVEY.md §3.4 ⟨proc⟩)."""
     storages = {}
     pkl = _pickle_checkpoint(obj, storages)
+
+    def storage_bytes(arr):
+        data = np.ascontiguousarray(
+            arr.materialize() if isinstance(arr, LazyArray) else arr
+        )
+        if data.dtype == BFLOAT16:
+            return data.tobytes()
+        return data.astype(data.dtype.newbyteorder("<"), copy=False).tobytes()
+
+    if not write:
+        for _key, arr in storages.values():
+            storage_bytes(arr)  # collective participation only
+        return
     with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as zf:
         zf.writestr(f"{stem}/data.pkl", pkl)
         zf.writestr(f"{stem}/byteorder", "little")
         for key, arr in storages.values():
-            data = np.ascontiguousarray(arr)
-            if data.dtype == BFLOAT16:
-                raw = data.tobytes()
-            else:
-                raw = data.astype(data.dtype.newbyteorder("<"), copy=False).tobytes()
-            zf.writestr(f"{stem}/data/{key}", raw)
+            zf.writestr(f"{stem}/data/{key}", storage_bytes(arr))
         zf.writestr(f"{stem}/version", "3\n")

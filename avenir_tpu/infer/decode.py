@@ -1,0 +1,238 @@
+"""Jitted fixed-shape KV-cache decoding (SURVEY.md §3.5 "TPU: jit with a
+fixed-size KV cache"; the fast path models/gpt.py:generate documents).
+
+Design (TPU-first: everything static-shaped, one compile per
+(prompt_len, max_len) pair, single dispatch per generated token):
+
+  - KVCache: (L, B, T_max, H_kv, D) stacked over layers, donated through
+    the jitted step so the update is in-place in HBM. GQA models cache
+    only the KV heads (memory / bandwidth win vs repeating to Q heads).
+  - prefill: ONE full forward over the prompt that also writes the cache
+    (causal masking via per-query positions), returning the last logits.
+  - step: single-token forward attending against the cache — the
+    (B, 1, H, D) query attends to T_max cached keys with positions > pos
+    masked; `lax.dynamic_update_slice` writes the new KV at pos.
+  - sampling math (temperature / top-k / categorical and the rng fold
+    sequence) mirrors GPT.generate exactly, so `generate_cached` is
+    token-for-token identical to the recompute-full-prefix path
+    (tests/test_decode.py asserts this).
+
+Works for GPT (learned pos emb, MHA), Llama (RoPE, GQA) and Mixtral (MoE
+layers), in both layer layouts (python-loop modules and scan-stacked
+`*_scan` modules).
+"""
+
+import functools
+import math
+import weakref
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+# jitted prefill/step closures cached per live model object: repeated
+# generate_cached calls (sample.py's num_samples loop) must reuse ONE
+# compile per (B, prompt_len, max_t) instead of retracing fresh closures
+_DECODE_CACHE = weakref.WeakKeyDictionary()
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # (L, B, T_max, H_kv, D)
+    v: jax.Array
+
+
+def init_cache(*, n_layer, batch, max_t, n_kv_head, head_dim, dtype):
+    shape = (n_layer, batch, max_t, n_kv_head, head_dim)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def _attend_cached(q, kc, vc, q_pos):
+    """q: (B, T, H, D) at absolute positions q_pos (T,); kc/vc the full
+    (B, T_max, H_kv, D) cache. Each query attends to cached positions
+    <= its own. fp32 softmax, mirrors ops.causal_attention_reference."""
+    B, Tm, Hkv, D = kc.shape
+    H = q.shape[2]
+    if H != Hkv:
+        rep = H // Hkv
+        kc = jnp.repeat(kc, rep, axis=2)
+        vc = jnp.repeat(vc, rep, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kc,
+                   preferred_element_type=jnp.float32)
+    s = s * (1.0 / math.sqrt(D))
+    k_idx = jnp.arange(Tm)
+    mask = k_idx[None, :] <= q_pos[:, None]  # (T, T_max)
+    s = jnp.where(mask[None, None], s, float("-inf"))
+    p = jax.nn.softmax(s, axis=-1).astype(vc.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vc,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _write_cache(kc, vc, k, v, pos):
+    """Write (B, T, H_kv, D) new keys/values at absolute position pos."""
+    kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, pos, 0, 0))
+    vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, pos, 0, 0))
+    return kc, vc
+
+
+# ---- per-layer steps (reach into the module's own submodules so the
+# weights/semantics are the model's; parity is pinned by tests) ----
+
+
+def _gpt_block_step(blk, x, kc, vc, pos, q_pos):
+    B, T, C = x.shape
+    h = blk.ln_1(x).astype(x.dtype)
+    qkv = blk.attn.c_attn(h)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    H = blk.attn.n_head
+    q = q.reshape(B, T, H, C // H)
+    k = k.reshape(B, T, H, C // H)
+    v = v.reshape(B, T, H, C // H)
+    kc, vc = _write_cache(kc, vc, k, v, pos)
+    y = _attend_cached(q, kc, vc, q_pos).reshape(B, T, C)
+    x = x + blk.attn.c_proj(y)
+    x = x + blk.mlp(blk.ln_2(x).astype(x.dtype))
+    return x, kc, vc
+
+
+def _llama_layer_step(lyr, x, kc, vc, pos, q_pos, cos, sin):
+    from avenir_tpu.ops import apply_rope
+
+    B, T, C = x.shape
+    attn = lyr.self_attn
+    h = lyr.input_layernorm(x).astype(x.dtype)
+    q = attn.q_proj(h).reshape(B, T, attn.n_head, attn.head_dim)
+    k = attn.k_proj(h).reshape(B, T, attn.n_kv_head, attn.head_dim)
+    v = attn.v_proj(h).reshape(B, T, attn.n_kv_head, attn.head_dim)
+    positions = jnp.broadcast_to(q_pos[None], (B, T))
+    q = apply_rope(q, cos, sin, positions=positions)
+    k = apply_rope(k, cos, sin, positions=positions)
+    kc, vc = _write_cache(kc, vc, k, v, pos)
+    y = _attend_cached(q, kc, vc, q_pos)
+    x = x + attn.o_proj(y.reshape(B, T, attn.n_head * attn.head_dim))
+    h2 = lyr.post_attention_layernorm(x).astype(x.dtype)
+    if hasattr(lyr, "block_sparse_moe"):
+        moe_out, _ = lyr.block_sparse_moe(h2)
+        x = x + moe_out
+    else:
+        x = x + lyr.mlp(h2)
+    return x, kc, vc
+
+
+def _run_layers(model, x, cache, pos, q_pos, layer_step):
+    """Apply layer_step across the model's layers, handling both the
+    python-loop and the scan-stacked layouts. Returns (x, new_cache)."""
+    scanned = getattr(model, "h_scan", None) or getattr(
+        model, "layers_scan", None
+    )
+    if scanned is not None:
+        @nnx.scan(in_axes=(nnx.Carry, 0, 0, 0), out_axes=(nnx.Carry, 0, 0))
+        def body(h, layer, kc, vc):
+            h, kc, vc = layer_step(layer, h, kc, vc, pos, q_pos)
+            return h, kc, vc
+
+        x, k_new, v_new = body(x, scanned, cache.k, cache.v)
+        return x, KVCache(k_new, v_new)
+    layers = getattr(model, "h", None) or model.layers
+    ks, vs = [], []
+    for l, layer in enumerate(layers):
+        x, kc, vc = layer_step(layer, x, cache.k[l], cache.v[l], pos, q_pos)
+        ks.append(kc)
+        vs.append(vc)
+    return x, KVCache(jnp.stack(ks), jnp.stack(vs))
+
+
+def _forward_cached(model, idx, cache, pos):
+    """Forward `idx` (B, T) at absolute start position `pos`, reading and
+    writing the cache. Returns (last-position fp32 logits, new cache)."""
+    B, T = idx.shape
+    q_pos = pos + jnp.arange(T)
+    if hasattr(model, "wte"):  # GPT
+        x = model.wte(idx) + model.wpe(q_pos)[None]
+        x, cache = _run_layers(model, x, cache, pos, q_pos, _gpt_block_step)
+        x = model.ln_f(x[:, -1:]).astype(x.dtype)
+        logits = model.wte.attend(x)
+    else:  # Llama / Mixtral
+        from avenir_tpu.ops import rope_frequencies
+
+        cfg = model.config
+        cos, sin = rope_frequencies(
+            cfg.n_embd // cfg.n_head, cfg.block_size, cfg.rope_theta
+        )
+        x = model.embed_tokens(idx)
+        x, cache = _run_layers(
+            model, x, cache, pos, q_pos,
+            lambda lyr, h, kc, vc, p, qp: _llama_layer_step(
+                lyr, h, kc, vc, p, qp, cos, sin),
+        )
+        x = model.norm(x[:, -1:]).astype(x.dtype)
+        logits = model.lm_head(x)
+    return logits[:, -1].astype(jnp.float32), cache
+
+
+def _sample(rng, logits, temperature, top_k):
+    """GPT.generate's sampling math, verbatim (models/gpt.py)."""
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[:, -min(top_k, logits.shape[-1])]
+        logits = jnp.where(logits < kth[:, None], -jnp.inf, logits)
+    rng, sub = jax.random.split(rng)
+    return rng, jax.random.categorical(sub, logits, axis=-1)
+
+
+def generate_cached(model, rng, idx, max_new_tokens, temperature=1.0,
+                    top_k=None):
+    """Drop-in replacement for model.generate: same outputs, one jitted
+    single-token dispatch per new token instead of a full-prefix recompute.
+    Total length must fit the model's position table (block_size)."""
+    cfg = model.config
+    B, T0 = idx.shape
+    max_t = T0 + max_new_tokens
+    assert max_t <= cfg.block_size, (
+        f"cache decoding needs prompt+new <= block_size "
+        f"({max_t} > {cfg.block_size})"
+    )
+    n_kv = getattr(cfg, "n_kv_head", cfg.n_head)
+    from avenir_tpu.models.common import resolve_dtype
+
+    cache = init_cache(
+        n_layer=cfg.n_layer, batch=B, max_t=max_t, n_kv_head=n_kv,
+        head_dim=cfg.n_embd // cfg.n_head,
+        dtype=resolve_dtype(cfg.compute_dtype),
+    )
+    try:
+        per_model = _DECODE_CACHE.setdefault(model, {})
+    except TypeError:  # model not weakref-able: still works, just retraces
+        per_model = {}
+    key = (B, T0, max_t)
+    if key not in per_model:
+        graphdef, state = nnx.split(model)
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def prefill(state, idx, cache):
+            m = nnx.merge(graphdef, state)
+            return _forward_cached(m, idx, cache, 0)
+
+        # pos is a traced scalar: ONE compile serves every decode position
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def step(state, tok, cache, pos):
+            m = nnx.merge(graphdef, state)
+            return _forward_cached(m, tok, cache, pos)
+
+        per_model[key] = (prefill, step)
+    prefill, step = per_model[key]
+    # state re-split per call (cheap): picks up in-place weight mutations
+    state = nnx.split(model)[1]
+
+    logits, cache = prefill(state, idx, cache)
+    out = [idx]
+    pos = T0
+    for t in range(max_new_tokens):
+        rng, nxt = _sample(rng, logits, temperature, top_k)
+        out.append(nxt[:, None])
+        if t + 1 < max_new_tokens:  # the last sampled token needs no forward
+            logits, cache = step(state, nxt[:, None], cache,
+                                 jnp.int32(pos))
+            pos += 1
+    return jnp.concatenate(out, axis=1)

@@ -1,8 +1,48 @@
-"""Shared model utilities: loss, dtype resolution, MFU accounting."""
+"""Shared model utilities: loss, dtype resolution, MFU accounting, and the
+scan-over-layers layer stack (SURVEY.md §3.3 "nnx.scan over the L blocks")."""
 
 import jax
 import jax.numpy as jnp
 import optax
+from flax import nnx
+
+
+def stacked_layers(n_layer, make_layer, rngs):
+    """Create `n_layer` homogeneous layers as ONE module whose params carry
+    a leading (n_layer, ...) axis — the storage form `nnx.scan` consumes
+    directly. One trace for all layers (compile time O(1) in depth, the
+    point of scan_layers) and no per-step stack/unstack copies in HBM.
+
+    Convention: models store the result under an attribute ending in
+    `_scan` (GPT.h_scan, Llama.layers_scan). That suffix is the single
+    marker the partition rules (leading None axis) and the checkpoint
+    bridge (split/stack to per-layer torch keys) key off, so the on-disk
+    `.pt` schema is identical for scanned and unscanned models."""
+
+    @nnx.split_rngs(splits=n_layer)
+    @nnx.vmap(in_axes=(0,), out_axes=0)
+    def create(r):
+        return make_layer(r)
+
+    return create(rngs)
+
+
+def scan_layer_stack(x, layers, *, call=None, remat=False):
+    """Run `x` through a stacked layer module via nnx.scan. `call(layer, h)`
+    applies one layer (default `layer(h)`); with `remat` the per-layer
+    activations are rematerialized on the backward pass (jax.checkpoint per
+    scan step — memory O(1) in depth at the cost of one extra forward)."""
+    if call is None:
+        call = lambda lyr, h: lyr(h)
+
+    def body(h, layer):
+        if remat:
+            return nnx.remat(call)(layer, h)
+        return call(layer, h)
+
+    return nnx.scan(body, in_axes=(nnx.Carry, 0), out_axes=nnx.Carry)(
+        x, layers
+    )
 
 
 def resolve_dtype(name):

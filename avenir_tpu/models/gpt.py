@@ -32,6 +32,8 @@ from flax import nnx
 from avenir_tpu.models.common import (
     cross_entropy_loss,
     resolve_dtype,
+    scan_layer_stack,
+    stacked_layers,
     transformer_flops_per_token,
 )
 from avenir_tpu.ops import causal_attention
@@ -50,6 +52,11 @@ class GPTConfig:
     compute_dtype: str = "float32"  # 'bfloat16' on TPU; params stay fp32
     attn_impl: str = "auto"  # 'auto' | 'pallas' | 'xla'
     remat: bool = False  # rematerialize each block on the backward pass
+    # lax.scan over the L homogeneous blocks: one trace regardless of depth
+    # (compile time for the 48-layer 1.5B config, SURVEY.md §3.3). Params
+    # are stored stacked (L, ...) under `h_scan`; checkpoint format and
+    # partition rules are unchanged (bridge splits/stacks per layer).
+    scan_layers: bool = False
 
 
 class CausalSelfAttention(nnx.Module):
@@ -170,9 +177,14 @@ class GPT(nnx.Module):
             dtype=cdtype, param_dtype=jnp.float32, rngs=rngs,
         )
         self.drop = nnx.Dropout(config.dropout)
-        self.h = nnx.List(
-            [Block(config, rngs=rngs) for _ in range(config.n_layer)]
-        )
+        if config.scan_layers:
+            self.h_scan = stacked_layers(
+                config.n_layer, lambda r: Block(config, rngs=r), rngs
+            )
+        else:
+            self.h = nnx.List(
+                [Block(config, rngs=rngs) for _ in range(config.n_layer)]
+            )
         self.ln_f = nnx.LayerNorm(
             config.n_embd, epsilon=1e-5, use_bias=config.bias,
             dtype=jnp.float32, param_dtype=jnp.float32, rngs=rngs,
@@ -188,17 +200,28 @@ class GPT(nnx.Module):
         x = self.wte(idx) + self.wpe(pos)[None]
         x = self.drop(x, deterministic=deterministic, rngs=rngs)
 
-        if self.config.remat:
+        if self.config.scan_layers:
             assert self.config.dropout == 0.0 or deterministic, (
-                "remat + dropout rng threading not supported; train with dropout=0"
+                "scan_layers + dropout rng threading not supported; "
+                "train with dropout=0"
             )
-            block_fn = nnx.remat(lambda blk, h: blk(h, deterministic=deterministic))
+            x = scan_layer_stack(
+                x, self.h_scan,
+                call=lambda blk, h: blk(h, deterministic=deterministic),
+                remat=self.config.remat,
+            )
         else:
-            block_fn = lambda blk, h: blk(
-                h, deterministic=deterministic, rngs=rngs
-            )
-        for block in self.h:
-            x = block_fn(block, x)
+            if self.config.remat:
+                assert self.config.dropout == 0.0 or deterministic, (
+                    "remat + dropout rng threading not supported; train with dropout=0"
+                )
+                block_fn = nnx.remat(lambda blk, h: blk(h, deterministic=deterministic))
+            else:
+                block_fn = lambda blk, h: blk(
+                    h, deterministic=deterministic, rngs=rngs
+                )
+            for block in self.h:
+                x = block_fn(block, x)
         x = self.ln_f(x).astype(self._cdtype)
 
         if targets is not None:

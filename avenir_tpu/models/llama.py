@@ -20,7 +20,12 @@ import jax
 import jax.numpy as jnp
 from flax import nnx
 
-from avenir_tpu.models.common import cross_entropy_loss, resolve_dtype
+from avenir_tpu.models.common import (
+    cross_entropy_loss,
+    resolve_dtype,
+    scan_layer_stack,
+    stacked_layers,
+)
 from avenir_tpu.ops import apply_rope, causal_attention, rope_frequencies, swiglu
 from avenir_tpu.ops.rmsnorm import rmsnorm
 
@@ -46,6 +51,7 @@ class LlamaConfig:
     compute_dtype: str = "float32"
     attn_impl: str = "auto"
     remat: bool = False
+    scan_layers: bool = False  # lax.scan over stacked layers (see models/gpt.py)
 
     @classmethod
     def from_train_config(cls, cfg, model_args):
@@ -60,6 +66,7 @@ class LlamaConfig:
             compute_dtype=("float32" if cfg["dtype"] == "float16" else cfg["dtype"]),
             attn_impl=("auto" if cfg["use_pallas"] else "xla"),
             remat=cfg["remat"],
+            scan_layers=cfg.get("scan_layers", False),
         )
 
 
@@ -157,9 +164,14 @@ class Llama(nnx.Module):
             config.vocab_size, config.n_embd, embedding_init=init,
             dtype=cdtype, param_dtype=jnp.float32, rngs=rngs,
         )
-        self.layers = nnx.List(
-            [layer_cls(config, rngs=rngs) for _ in range(config.n_layer)]
-        )
+        if config.scan_layers:
+            self.layers_scan = stacked_layers(
+                config.n_layer, lambda r: layer_cls(config, rngs=r), rngs
+            )
+        else:
+            self.layers = nnx.List(
+                [layer_cls(config, rngs=rngs) for _ in range(config.n_layer)]
+            )
         self.norm = RMSNorm(config.n_embd, eps=config.norm_eps, rngs=rngs)
         self.lm_head = nnx.Linear(
             config.n_embd, config.vocab_size, use_bias=False,
@@ -172,20 +184,51 @@ class Llama(nnx.Module):
         B, T = idx.shape
         assert T <= self.config.block_size
         x = self.embed_tokens(idx)
-        if self.config.remat:
-            layer_fn = nnx.remat(lambda lyr, h: lyr(h))
+        # layer protocol: plain layers return x; MoE layers return
+        # (x, router_stats) — a stats pytree summed across layers through
+        # the loop or scan carry, turned into the aux loss at the top (the
+        # family overrides _zero_router_stats/_router_aux_loss)
+        def apply(lyr, h):
+            out = lyr(h)
+            return out if isinstance(out, tuple) else (
+                out, self._zero_router_stats()
+            )
+
+        stats_sum = self._zero_router_stats()
+        if self.config.scan_layers:
+            def scan_call(lyr, carry):
+                h, acc = carry
+                h, s = apply(lyr, h)
+                return (h, jax.tree.map(jnp.add, acc, s))
+
+            x, stats_sum = scan_layer_stack(
+                (x, stats_sum), self.layers_scan, call=scan_call,
+                remat=self.config.remat,
+            )
         else:
-            layer_fn = lambda lyr, h: lyr(h)
-        for layer in self.layers:
-            x = layer_fn(layer, x)
+            layer_fn = nnx.remat(apply) if self.config.remat else apply
+            for layer in self.layers:
+                x, s = layer_fn(layer, x)
+                stats_sum = jax.tree.map(jnp.add, stats_sum, s)
         x = self.norm(x).astype(self._cdtype)
         if targets is not None:
             logits = self.lm_head(x)
             loss = cross_entropy_loss(logits, targets, ignore_index=-1)
+            coef = getattr(self.config, "router_aux_loss_coef", 0.0)
+            if coef:
+                loss = loss + coef * self._router_aux_loss(stats_sum)
         else:
             logits = self.lm_head(x[:, -1:, :])
             loss = None
         return logits, loss
+
+    # router load-balancing hooks (overridden by MoE families)
+
+    def _zero_router_stats(self):
+        return jnp.float32(0.0)
+
+    def _router_aux_loss(self, stats_sum):
+        return jnp.float32(0.0)
 
     def get_num_params(self, non_embedding=True):
         leaves = jax.tree.leaves(nnx.state(self, nnx.Param))

@@ -39,6 +39,10 @@ class MixtralConfig(LlamaConfig):
     n_experts: int = 8
     n_experts_per_tok: int = 2
     capacity_factor: float = 1.25
+    # Switch/Mixtral load-balancing auxiliary loss (HF router_aux_loss_coef
+    # default 0.02). Without it, top-k routing + fixed capacity dropping is
+    # prone to expert collapse during training. 0.0 disables.
+    router_aux_loss_coef: float = 0.02
 
     @classmethod
     def from_train_config(cls, cfg, model_args):
@@ -48,6 +52,7 @@ class MixtralConfig(LlamaConfig):
             n_experts=cfg.get("n_experts", 8),
             n_experts_per_tok=cfg.get("n_experts_per_tok", 2),
             capacity_factor=cfg.get("capacity_factor", 1.25),
+            router_aux_loss_coef=cfg.get("router_aux_loss_coef", 0.02),
         )
 
 
@@ -108,6 +113,13 @@ class MixtralSparseMoeBlock(nnx.Module):
         topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
 
         oh = jax.nn.one_hot(topk_idx, E, dtype=jnp.int32)  # (N, K, E)
+        # router stats for the Switch/Mixtral load-balancing loss: this
+        # layer's mean one-hot assignment (K, E) and mean router probs
+        # (E,), both pre-capacity (on intent, not on what fit). The model
+        # top combines them across layers exactly like HF's
+        # load_balancing_loss_func over concatenated router logits.
+        stats = (jnp.mean(oh.astype(jnp.float32), axis=0),
+                 jnp.mean(probs, axis=0))
         # queue position of each (token, slot) within its expert, in
         # (token-major, slot-minor) order — matches sequential routing
         flat = oh.reshape(N * K, E)
@@ -128,7 +140,7 @@ class MixtralSparseMoeBlock(nnx.Module):
         expert_out = self.experts(expert_in)  # (E, C, d)
         expert_out = constrain(expert_out, P("expert", None, None))
         out = jnp.einsum("nec,ecd->nd", comb.astype(self._cdtype), expert_out)
-        return out.reshape(B, T, d).astype(x.dtype)
+        return out.reshape(B, T, d).astype(x.dtype), stats
 
 
 class MixtralDecoderLayer(nnx.Module):
@@ -146,12 +158,29 @@ class MixtralDecoderLayer(nnx.Module):
         x = x + self.self_attn(
             self.input_layernorm(x).astype(self._cdtype), positions=positions
         )
-        x = x + self.block_sparse_moe(
+        moe_out, stats = self.block_sparse_moe(
             self.post_attention_layernorm(x).astype(self._cdtype)
         )
-        return x
+        # layers may return (x, router_stats); Llama.__call__ accumulates
+        return x + moe_out, stats
 
 
 class Mixtral(Llama):
     def __init__(self, config: MixtralConfig, *, rngs):
         super().__init__(config, rngs=rngs, layer_cls=MixtralDecoderLayer)
+
+    def _zero_router_stats(self):
+        K, E = self.config.n_experts_per_tok, self.config.n_experts
+        return (jnp.zeros((K, E), jnp.float32), jnp.zeros((E,), jnp.float32))
+
+    def _router_aux_loss(self, stats_sum):
+        """HF load_balancing_loss_func over all layers' router outputs
+        CONCATENATED: with equal token counts per layer, tokens_per_expert
+        and router_prob_per_expert over the concat equal the across-layer
+        means, so aux = E · Σ_{k,e} mean_l(m)[k,e] · mean_l(p)[e] — the
+        product of means, not the mean of per-layer products."""
+        m_sum, p_sum = stats_sum  # sums over layers of per-layer means
+        L = self.config.n_layer
+        return self.config.n_experts * jnp.sum(
+            (m_sum / L) * (p_sum / L)[None, :]
+        )

@@ -60,6 +60,22 @@ def causal_attention_reference(q, k, v, *, dropout_rate=0.0, deterministic=True,
     return out.astype(q.dtype)
 
 
+def resolve_attention_impl(impl, *, use_dropout=False, segment_ids=None):
+    """Resolve 'auto' to the concrete impl that will run ('pallas' or
+    'xla'). Used by the dispatch below AND by the training loop's startup
+    log, so a silent fallback to the slow path is always visible."""
+    if impl != "auto":
+        return impl
+    if _on_tpu() and not use_dropout and segment_ids is None:
+        try:  # fall back gracefully while/where the kernel is unavailable
+            from avenir_tpu.ops.pallas import flash_attention  # noqa: F401
+
+            return "pallas"
+        except ImportError:
+            return "xla"
+    return "xla"
+
+
 def causal_attention(q, k, v, *, dropout_rate=0.0, deterministic=True,
                      dropout_rng=None, impl="auto", segment_ids=None):
     """Causal multi-head attention. q, k, v: (B, T, H, D).
@@ -77,16 +93,8 @@ def causal_attention(q, k, v, *, dropout_rate=0.0, deterministic=True,
         v = jnp.repeat(v, rep, axis=2)
 
     use_dropout = dropout_rate > 0.0 and not deterministic
-    if impl == "auto":
-        if _on_tpu() and not use_dropout and segment_ids is None:
-            try:  # fall back gracefully while/where the kernel is unavailable
-                from avenir_tpu.ops.pallas import flash_attention  # noqa: F401
-
-                impl = "pallas"
-            except ImportError:
-                impl = "xla"
-        else:
-            impl = "xla"
+    impl = resolve_attention_impl(impl, use_dropout=use_dropout,
+                                  segment_ids=segment_ids)
     if impl == "ring":
         # context parallelism: sequence sharded over the 'context' mesh
         # axis, kv rotating via ppermute (parallel/ring_attention.py)

@@ -34,8 +34,9 @@ def apply_rope_reference(x, cos, sin, positions=None):
     return out.astype(orig)
 
 
-def apply_rope(x, cos, sin, positions=None, impl="xla"):
+def apply_rope(x, cos, sin, positions=None):
     """Apply rotary embeddings. The op is elementwise and XLA fuses it into
-    the surrounding matmuls, so the pallas variant only pays off inside the
-    fused attention kernel; standalone use takes the xla path."""
+    the surrounding matmuls on its own; a dedicated pallas kernel would only
+    pay off fused INSIDE the attention kernel (measured rationale in
+    BASELINE.md), so there is deliberately no impl switch here."""
     return apply_rope_reference(x, cos, sin, positions=positions)

@@ -13,5 +13,5 @@ def swiglu_reference(gate, up):
     return jax.nn.silu(gate.astype(jnp.float32)).astype(gate.dtype) * up
 
 
-def swiglu(gate, up, impl="xla"):
+def swiglu(gate, up):
     return swiglu_reference(gate, up)

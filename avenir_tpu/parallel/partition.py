@@ -67,16 +67,27 @@ def path_str(path) -> str:
     return "/".join(str(p) for p in path)
 
 
+def has_scan_segment(path) -> bool:
+    """True if the param path crosses a scan-stacked layer container
+    (attribute named `*_scan`, models/common.stacked_layers): its array
+    carries a leading (n_layer, ...) axis the per-layer rules don't know
+    about."""
+    segs = path.split("/") if isinstance(path, str) else [str(p) for p in path]
+    return any(s.endswith("_scan") for s in segs)
+
+
 def match_partition_rules(rules, paths):
     """Map each path (tuple or string) to its first matching PartitionSpec.
-    Raises ValueError listing every unmatched path."""
+    Params under a scan-stacked container get a leading None axis (the
+    layer axis is never sharded — each scan step must find its full layer
+    weights locally). Raises ValueError listing every unmatched path."""
     out = {}
     misses = []
     for path in paths:
         s = path_str(path) if not isinstance(path, str) else path
         for pattern, spec in rules:
             if re.search(pattern, s):
-                out[path] = spec
+                out[path] = P(None, *tuple(spec)) if has_scan_segment(path) else spec
                 break
         else:
             misses.append(s)
@@ -128,16 +139,16 @@ def activation_pspec() -> P:
 
 
 def constrain(x, spec: P):
-    """with_sharding_constraint that degrades to a no-op when no mesh is
-    in context (single-device tests, model used standalone). The training
-    loop installs the mesh via `jax.set_mesh`, making these constraints
-    live; without one the constraint is meaningless anyway."""
+    """with_sharding_constraint that is a no-op when no mesh is in context
+    (single-device tests, model used standalone) and FAIL-LOUD when one is:
+    the training loop installs the mesh via `jax.set_mesh`, and a genuine
+    constraint error inside a real mesh must surface, not be swallowed."""
     import jax
 
-    try:
-        return jax.lax.with_sharding_constraint(x, spec)
-    except (RuntimeError, ValueError):
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
         return x
+    return jax.lax.with_sharding_constraint(x, spec)
 
 
 def named_shardings(mesh, spec_by_path):

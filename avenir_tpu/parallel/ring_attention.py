@@ -17,7 +17,7 @@ keeps the ring in lockstep).
 
 Layout contract matches ops.causal_attention: (B, T, H, D), GQA already
 expanded. Runs inside jit: `jax.shard_map` over the context axis of the
-ambient mesh (installed by the训练loop via jax.set_mesh).
+ambient mesh (installed by the training loop via jax.set_mesh).
 """
 
 import functools

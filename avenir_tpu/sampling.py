@@ -35,9 +35,18 @@ def run_sampling(*, out_dir, init_from, start, num_samples, max_new_tokens,
     encode, decode = load_codec()
     x = jnp.asarray(encode(start), dtype=jnp.int32)[None, :]
     rng = jax.random.key(seed)
+    # jitted KV-cache decoder when the total length fits the position
+    # table; recompute-full-prefix (parity path) otherwise
+    use_cache = x.shape[1] + max_new_tokens <= model.config.block_size
     for s in range(num_samples):
         rng, sub = jax.random.split(rng)
-        y = model.generate(sub, x, max_new_tokens, temperature=temperature,
-                           top_k=top_k)
+        if use_cache:
+            from avenir_tpu.infer.decode import generate_cached
+
+            y = generate_cached(model, sub, x, max_new_tokens,
+                                temperature=temperature, top_k=top_k)
+        else:
+            y = model.generate(sub, x, max_new_tokens,
+                               temperature=temperature, top_k=top_k)
         print(decode([int(t) for t in y[0]]))
         print("---------------")

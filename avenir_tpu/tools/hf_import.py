@@ -1,0 +1,105 @@
+"""HF GPT-2 weight import for the TPU backend (SURVEY.md §7 PR3 "HF GPT-2
+import through the same key-map"; mirrors model.py:210-254 from_pretrained).
+
+The HF checkpoint stores Conv1D projection weights as (in, out) — already
+the nnx kernel layout — but we deliberately route through the torch-layout
+bridge (transpose to (out, in), then let load_torch_state_dict transpose
+back) so HF import exercises the EXACT key-map the checkpoint format uses.
+
+No torch import: weights are read from the local HF cache via safetensors
+(numpy) when available, falling back to transformers' torch loader only if
+the safetensors file is absent. The sandbox has no egress, so all paths use
+local_files_only and fail with a clear message when the cache is cold.
+"""
+
+import numpy as np
+from flax import nnx
+
+from avenir_tpu.checkpoint.bridge import load_torch_state_dict
+from avenir_tpu.models.gpt import GPT, GPTConfig
+
+HF_CONFIGS = {
+    "gpt2": dict(n_layer=12, n_head=12, n_embd=768),
+    "gpt2-medium": dict(n_layer=24, n_head=16, n_embd=1024),
+    "gpt2-large": dict(n_layer=36, n_head=20, n_embd=1280),
+    "gpt2-xl": dict(n_layer=48, n_head=25, n_embd=1600),
+}
+
+# HF uses Conv1D ((in, out) storage) for these; torch-Linear layout is (out, in)
+_CONV1D_SUFFIXES = (
+    "attn.c_attn.weight", "attn.c_proj.weight",
+    "mlp.c_fc.weight", "mlp.c_proj.weight",
+)
+
+
+def gpt2_config(model_type, dropout=0.0, compute_dtype="float32",
+                attn_impl="auto"):
+    assert model_type in HF_CONFIGS, (
+        f"unknown HF model {model_type!r}; one of {sorted(HF_CONFIGS)}"
+    )
+    return GPTConfig(
+        vocab_size=50257, block_size=1024, bias=True, dropout=dropout,
+        compute_dtype=compute_dtype, attn_impl=attn_impl,
+        **HF_CONFIGS[model_type],
+    )
+
+
+def hf_sd_to_torch_layout(hf_sd):
+    """Normalize a raw HF GPT-2 state dict (numpy arrays) to the torch
+    reference layout our bridge key-map consumes:
+      - ensure the `transformer.` prefix (the hub gpt2 files omit it),
+      - drop attention mask buffers and the tied lm_head alias,
+      - transpose Conv1D weights to torch Linear (out, in)."""
+    out = {}
+    for key, arr in hf_sd.items():
+        if key.startswith("transformer."):
+            key = key[len("transformer."):]
+        if key.endswith((".attn.bias", ".attn.masked_bias")):
+            continue  # causal-mask buffers, not params
+        if key == "lm_head.weight":
+            continue  # tied to wte (model.py:149-151)
+        arr = np.asarray(arr)
+        if any(key.endswith(s) for s in _CONV1D_SUFFIXES):
+            arr = np.ascontiguousarray(arr.T)
+        out["transformer." + key] = arr
+    return out
+
+
+def _load_hf_numpy_sd(model_type):
+    """Read the HF checkpoint from the local cache as {key: numpy}."""
+    try:
+        from safetensors.numpy import load_file
+        from transformers.utils import cached_file
+
+        path = cached_file(model_type, "model.safetensors",
+                           local_files_only=True)
+        return load_file(path)
+    except Exception:
+        pass
+    # fallback: the torch loader (e.g. cache only has pytorch_model.bin)
+    try:
+        from transformers import GPT2LMHeadModel
+
+        hf = GPT2LMHeadModel.from_pretrained(model_type,
+                                             local_files_only=True)
+        return {k: v.numpy() for k, v in hf.state_dict().items()}
+    except Exception as e:
+        raise RuntimeError(
+            f"could not load {model_type!r} from the local HF cache "
+            "(this sandbox has no network egress; populate the cache "
+            f"first): {e}"
+        ) from e
+
+
+def load_hf_gpt2_sd(model, hf_sd):
+    """Load a raw HF GPT-2 state dict into an nnx GPT via the bridge."""
+    return load_torch_state_dict(model, hf_sd_to_torch_layout(hf_sd))
+
+
+def gpt2_from_hf(model_type, *, dropout=0.0, compute_dtype="float32",
+                 attn_impl="auto", seed=0):
+    """Build an nnx GPT and load HF GPT-2 weights (model.py:210-254)."""
+    cfg = gpt2_config(model_type, dropout=dropout,
+                      compute_dtype=compute_dtype, attn_impl=attn_impl)
+    model = GPT(cfg, rngs=nnx.Rngs(seed))
+    return load_hf_gpt2_sd(model, _load_hf_numpy_sd(model_type))

@@ -67,6 +67,7 @@ def build_model_factory(cfg, model_args, mesh=None):
             compute_dtype=("float32" if cfg["dtype"] == "float16" else cfg["dtype"]),
             attn_impl=("ring" if ring else ("auto" if cfg["use_pallas"] else "xla")),
             remat=cfg["remat"],
+            scan_layers=cfg.get("scan_layers", False),
         )
         return mt, gcfg, (lambda seed: GPT(gcfg, rngs=nnx.Rngs(seed)))
     if mt == "llama":
@@ -117,6 +118,10 @@ def setup_state(cfg, mesh, model_args, *, verbose=True):
 def run_training(cfg):
     initialize_distributed()
     master = is_coordinator()
+    if cfg.get("debug_nans"):
+        # re-runs the offending dispatch op-by-op and raises at the first
+        # NaN-producing primitive (SURVEY.md §5 "Race/NaN detection")
+        jax.config.update("jax_debug_nans", True)
     mesh = make_mesh(cfg["mesh_shape"])
     # every batch-sharding axis counts as data parallelism (see batch_pspec)
     n_dp = mesh.shape["data"] * mesh.shape["fsdp"] * mesh.shape["expert"]
@@ -156,32 +161,58 @@ def run_training(cfg):
     iter_num = 0
     best_val_loss = 1e9
     ckpt = None
+    hf_init = None
     if cfg["init_from"] == "scratch":
         model_args["vocab_size"] = meta_vocab_size if meta_vocab_size else 50304
     elif cfg["init_from"] == "resume":
-        ckpt = load_checkpoint(cfg["out_dir"])
+        # lazy: tensors stream from the zip one at a time during restore
+        ckpt = load_checkpoint(cfg["out_dir"], lazy=True)
         for k in ("n_layer", "n_head", "n_embd", "block_size", "bias", "vocab_size"):
             model_args[k] = ckpt["model_args"][k]
-        iter_num = ckpt["iter_num"]
-        best_val_loss = ckpt["best_val_loss"]
+        # coerce NOW: lazy/tensor scalars must not outlive the ckpt file
+        # (the next save overwrites it, invalidating lazy readers)
+        iter_num = int(ckpt["iter_num"])
+        best_val_loss = float(ckpt["best_val_loss"])
         if master:
             print(f"resuming from {cfg['out_dir']} at iter {iter_num}")
+    elif cfg["init_from"].startswith("gpt2"):
+        # finetune from HF GPT-2 (train.py:167-176 torch equivalent)
+        from avenir_tpu.tools.hf_import import HF_CONFIGS, hf_sd_to_torch_layout, _load_hf_numpy_sd
+
+        assert cfg["model_type"] == "gpt", "gpt2* init requires model_type=gpt"
+        hf_init = hf_sd_to_torch_layout(_load_hf_numpy_sd(cfg["init_from"]))
+        model_args.update(HF_CONFIGS[cfg["init_from"]])
+        model_args.update(vocab_size=50257, block_size=1024, bias=True)
+        if master:
+            print(f"initializing from HF weights: {cfg['init_from']}")
     else:
-        raise ValueError(
-            f"init_from={cfg['init_from']!r} not supported on the tpu "
-            "backend (gpt2* HF import: use sample.py / tools)"
-        )
+        raise ValueError(f"init_from={cfg['init_from']!r}")
 
     st = setup_state(cfg, mesh, model_args)
     graphdef, shardings = st["graphdef"], st["shardings"]
+    if master:
+        # print the RESOLVED hot-path impls — a silent fallback to the slow
+        # path on a misconfigured pod must be visible at startup
+        from avenir_tpu.ops.attention import resolve_attention_impl
 
-    # ---- params: sharded init or checkpoint restore ----
-    if ckpt is None:
+        attn_resolved = resolve_attention_impl(
+            getattr(st["model_config"], "attn_impl", "auto"),
+            use_dropout=model_args["dropout"] > 0,
+        )
+        print(f"[tpu] attention={attn_resolved} optimizer=optax_adamw "
+              f"scan_layers={cfg.get('scan_layers', False)} "
+              f"remat={cfg.get('remat', False)}")
+
+    # ---- params: sharded init, HF weights, or checkpoint restore ----
+    if ckpt is None and hf_init is None:
         def init_fn():
             m = st["ctor"](cfg["seed"])
             return nnx.split(m, nnx.Param)[1]
 
         params = jax.jit(init_fn, out_shardings=st["shard_tree"])()
+    elif hf_init is not None:
+        params = restore_params({"model": hf_init}, st["abs_state"],
+                                shardings, model_family=st["model_type"])
     else:
         params = restore_params(ckpt, st["abs_state"], shardings,
                                 model_family=st["model_type"])
@@ -193,7 +224,6 @@ def run_training(cfg):
         beta1=cfg["beta1"], beta2=cfg["beta2"], grad_clip=cfg["grad_clip"],
         warmup_iters=cfg["warmup_iters"], lr_decay_iters=cfg["lr_decay_iters"],
         min_lr=cfg["min_lr"], decay_lr=cfg["decay_lr"],
-        use_pallas=cfg.get("fused_adamw", False),
     )
 
     def init_opt(p):
@@ -326,8 +356,17 @@ def run_training(cfg):
         t1 = time.time()
         dt = t1 - t0
         t0 = t1
-        if iter_num % cfg["log_interval"] == 0 and master:
+        if iter_num % cfg["log_interval"] == 0:
             lossf = float(metrics["loss"])  # sync point, log cadence only
+            # every process checks (loss is a global value, identical on
+            # all of them): a master-only raise would leave the other
+            # processes blocked in the next collective on a pod
+            if not np.isfinite(lossf):
+                raise FloatingPointError(
+                    f"non-finite loss {lossf} at iter {iter_num}; rerun "
+                    "with --debug_nans=True to locate the producing op"
+                )
+        if iter_num % cfg["log_interval"] == 0 and master:
             loss_history.append((iter_num, lossf))
             if local_iter_num >= 5:
                 seqs_per_iter = cfg["batch_size"] * grad_accum_total

@@ -46,31 +46,22 @@ def make_optimizer(params, *, learning_rate, weight_decay, beta1, beta2,
                    decay_lr=True, use_pallas=False):
     """Build the optax chain. `params` is only used to shape the decay mask.
 
-    `use_pallas` swaps the adamw transform for the fused Pallas kernel
-    (avenir_tpu/ops/pallas/adamw.py) on TPU; the optax path is the
-    reference semantics either way."""
+    There is deliberately NO Pallas AdamW kernel: XLA fuses this optax
+    chain into the jit'd step with zero launch boundaries, and two rounds
+    of kernel variants measured slower on v5e (BASELINE.md "fused AdamW"
+    section: per-tensor launches + the extra apply-updates pass cost
+    ~9-29ms/step at 124M). `use_pallas` is accepted and ignored for config
+    compatibility. BASELINE.json:5's "AdamW hot path as Pallas kernels /
+    optax" is satisfied by the optax arm."""
+    del use_pallas
     schedule = make_lr_schedule(
         learning_rate, warmup_iters, lr_decay_iters, min_lr, decay_lr
     )
     mask = decay_mask(params)
-    if use_pallas:
-        try:
-            from avenir_tpu.ops.pallas.adamw import fused_adamw
-
-            adamw = fused_adamw(
-                learning_rate=schedule, b1=beta1, b2=beta2, eps=1e-8,
-                weight_decay=weight_decay, mask=mask,
-            )
-        except ImportError:
-            adamw = optax.adamw(
-                learning_rate=schedule, b1=beta1, b2=beta2, eps=1e-8,
-                weight_decay=weight_decay, mask=mask,
-            )
-    else:
-        adamw = optax.adamw(
-            learning_rate=schedule, b1=beta1, b2=beta2, eps=1e-8,
-            weight_decay=weight_decay, mask=mask,
-        )
+    adamw = optax.adamw(
+        learning_rate=schedule, b1=beta1, b2=beta2, eps=1e-8,
+        weight_decay=weight_decay, mask=mask,
+    )
     chain = []
     if grad_clip and grad_clip > 0.0:
         chain.append(optax.clip_by_global_norm(grad_clip))

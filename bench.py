@@ -11,11 +11,12 @@ tokens/sec/chip"): ~1.06M tokens/sec on 8×A100-40GB ≈ 132,500
 tokens/sec/GPU for the same model/optimizer in PyTorch.
 
 Usage:
-  python bench.py [--steps=N] [--batch=N] [--block=N]
-                  [--attn=pallas|xla] [--opt=pallas|optax] [--no_pallas]
+  python bench.py [--steps=N] [--batch=N] [--block=N] [--scan=1]
+                  [--attn=pallas|xla] [--no_pallas]
 --no_pallas forces XLA attention; --attn overrides it explicitly. The
-fused-AdamW kernel is opt-in via --opt=pallas (TPU only). (No pytest
-conftest here: this must see the REAL chip, not the 8-CPU test harness.)
+optimizer is always XLA-fused optax (the measured winner — BASELINE.md
+"fused AdamW" section). (No pytest conftest here: this must see the REAL
+chip, not the 8-CPU test harness.)
 """
 
 import json
@@ -37,7 +38,6 @@ def main():
     block = int(args.get("block", 1024))
     use_pallas = "no_pallas" not in args
     attn_impl_flag = args.get("attn", "")   # '', 'pallas', 'xla'
-    opt_flag = args.get("opt", "")          # '', 'pallas', 'optax'
     on_tpu = jax.default_backend() == "tpu"
 
     from avenir_tpu.models.gpt import GPT, GPTConfig
@@ -68,15 +68,13 @@ def main():
                 attn_impl = "pallas"
             except ImportError:
                 pass
-    # fused-AdamW kernel is opt-in (--opt=pallas, TPU only): measured
-    # slower than XLA-fused optax on v5e (62.6k vs 70.5k tok/s)
-    use_pallas_opt = opt_flag == "pallas" and on_tpu
     cfg = GPTConfig(
         block_size=block, vocab_size=50304, n_layer=12, n_head=12,
         n_embd=768, dropout=0.0, bias=True,
         compute_dtype="bfloat16" if on_tpu else "float32",
         attn_impl=attn_impl,
         remat=args.get("remat", "") in ("1", "True", "true"),
+        scan_layers=args.get("scan", "") in ("1", "True", "true"),
     )
     mesh = make_mesh("")  # all chips on 'data'
     n_chips = int(np.prod(list(mesh.shape.values())))
@@ -99,7 +97,6 @@ def main():
     tx, _ = make_optimizer(
         params, learning_rate=6e-4, weight_decay=0.1, beta1=0.9, beta2=0.95,
         grad_clip=1.0, warmup_iters=10, lr_decay_iters=1000, min_lr=6e-5,
-        use_pallas=use_pallas_opt,
     )
     opt_state = jax.jit(tx.init)(params)
     step_fn, _ = make_step_fns(graphdef, dropout=0.0)
@@ -132,7 +129,10 @@ def main():
             del p, o
             break
         except Exception as e:  # OOM at this batch — try smaller
-            if "RESOURCE_EXHAUSTED" not in str(e) and "Out of memory" not in str(e):
+            msg = str(e)
+            if not any(s in msg for s in (
+                "RESOURCE_EXHAUSTED", "Out of memory", "Ran out of memory",
+            )):
                 raise
             params = jax.jit(init_fn, out_shardings=shard_tree)()
             opt_state = jax.jit(tx.init)(params)
@@ -156,8 +156,9 @@ def main():
             "block_size": block,
             "mfu": round(float(mfu), 4),
             "attn": attn_impl,
-            "opt_pallas": bool(use_pallas_opt),
+            "opt": "optax_xla_fused",
             "remat": cfg.remat,
+            "scan_layers": cfg.scan_layers,
         },
     }
     print(json.dumps(result))

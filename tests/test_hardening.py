@@ -1,0 +1,192 @@
+"""Hardening tests (SURVEY.md §4 "Multi-process" + §5 "Race detection /
+Failure detection"): SIGKILL-mid-run resume (fault injection), 2-process
+jax.distributed rendezvous, 2-process gloo DDP for the torch branch,
+checkify over the train step, and the NaN guard."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _tpu_cli(char_dataset, out, **over):
+    args = dict(
+        dataset=char_dataset["dir"], out_dir=out, backend="tpu",
+        device="cpu", compile=False, eval_interval=5, eval_iters=2,
+        log_interval=1, batch_size=4, block_size=32, n_layer=2, n_head=2,
+        n_embd=32, dropout=0.0, gradient_accumulation_steps=2,
+        always_save_checkpoint=True, warmup_iters=2, lr_decay_iters=60,
+        learning_rate=1e-3, use_pallas=False, mesh_shape="data:1",
+    )
+    args.update(over)
+    return [sys.executable, "train.py"] + [f"--{k}={v}" for k, v in args.items()]
+
+
+@pytest.mark.slow
+def test_sigkill_mid_run_resume(char_dataset, tmp_path):
+    """Fault injection (SURVEY.md §5 'Failure detection'): SIGKILL the
+    trainer after a checkpoint lands, resume, training completes."""
+    out = str(tmp_path / "out")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    proc = subprocess.Popen(
+        _tpu_cli(char_dataset, out, max_iters=500),
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    # wait for the iter-5 checkpoint ("saving checkpoint" printed at eval
+    # cadence), then kill hard mid-step
+    deadline = time.time() + 300
+    saved = False
+    for line in proc.stdout:
+        if "saving checkpoint" in line:
+            saved = True
+        if saved and "iter 7" in line:
+            break
+        assert time.time() < deadline, "trainer never reached iter 7"
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=60)
+    assert os.path.exists(os.path.join(out, "ckpt.pt"))
+
+    r = subprocess.run(
+        _tpu_cli(char_dataset, out, max_iters=12, init_from="resume"),
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "resuming" in r.stdout
+    assert "iter 12" in r.stdout
+
+
+@pytest.mark.slow
+def test_two_process_jax_distributed_smoke():
+    """SURVEY.md §4 'Multi-process': 2-process jax.distributed.initialize
+    rendezvous on localhost via the env contract initialize_distributed
+    reads (the branch no in-process test can reach)."""
+    port = _free_port()
+    script = (
+        "import os, jax\n"
+        "os.environ.pop('XLA_FLAGS', None)\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from avenir_tpu.parallel.mesh import initialize_distributed\n"
+        "initialize_distributed()\n"
+        "assert jax.process_count() == 2, jax.process_count()\n"
+        "assert jax.device_count() == 2, jax.device_count()\n"
+        "from jax.experimental import multihost_utils\n"
+        "got = multihost_utils.process_allgather("
+        "jax.numpy.asarray([jax.process_index()]))\n"
+        "assert sorted(got.ravel().tolist()) == [0, 1], got\n"
+        "print('OK', jax.process_index())\n"
+    )
+    procs = []
+    for pid in range(2):
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    outs = [p.communicate(timeout=240)[0] for p in procs]
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, o
+        assert "OK" in o, o
+
+
+@pytest.mark.slow
+def test_two_process_gloo_ddp(char_dataset, tmp_path):
+    """The torch DDP branch (train.py:107-119) over gloo on CPU: two ranks,
+    three iters, both exit clean and rank0 logs losses."""
+    port = _free_port()
+    out = str(tmp_path / "out")
+    cli = [
+        sys.executable, "train.py",
+        f"--dataset={char_dataset['dir']}", f"--out_dir={out}",
+        "--device=cpu", "--compile=False", "--eval_interval=10",
+        "--eval_iters=2", "--log_interval=1", "--batch_size=2",
+        "--block_size=32", "--n_layer=2", "--n_head=2", "--n_embd=32",
+        "--gradient_accumulation_steps=2", "--max_iters=3",
+        "--warmup_iters=1", "--lr_decay_iters=10", "--dtype=float32",
+    ]
+    procs = []
+    for rank in range(2):
+        env = dict(
+            os.environ, RANK=str(rank), LOCAL_RANK=str(rank),
+            WORLD_SIZE="2", MASTER_ADDR="127.0.0.1", MASTER_PORT=str(port),
+        )
+        procs.append(subprocess.Popen(
+            cli, cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        ))
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, o
+    assert "iter 3" in outs[0], outs[0]  # rank 0 is master
+    assert "iter 3" not in outs[1]       # non-master stays quiet
+
+
+def test_checkify_train_step_clean(char_dataset):
+    """jax.experimental.checkify over the jit step: no NaN/div-by-zero/OOB
+    errors on a healthy config (SURVEY.md §5 'Race detection')."""
+    from flax import nnx
+    from jax.experimental import checkify
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+    from avenir_tpu.train.optimizer import make_optimizer
+    from avenir_tpu.train.step import make_step_fns
+
+    cfg = GPTConfig(block_size=16, vocab_size=64, n_layer=2, n_head=2,
+                    n_embd=32, dropout=0.0, bias=True, attn_impl="xla")
+    model = GPT(cfg, rngs=nnx.Rngs(0))
+    graphdef, params = nnx.split(model, nnx.Param)
+    tx, _ = make_optimizer(params, learning_rate=1e-3, weight_decay=0.1,
+                           beta1=0.9, beta2=0.95, grad_clip=1.0,
+                           warmup_iters=0, lr_decay_iters=10, min_lr=1e-4)
+    opt_state = tx.init(params)
+    step_fn, _ = make_step_fns(graphdef, dropout=0.0)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 64, (1, 2, 16)).astype(np.int32))
+    y = jnp.asarray(rng.integers(0, 64, (1, 2, 16)).astype(np.int32))
+
+    checked = checkify.checkify(
+        lambda p, o, r, xx, yy: step_fn(p, o, tx, r, xx, yy),
+        errors=checkify.float_checks,
+    )
+    err, (params, opt_state, metrics) = jax.jit(checked)(
+        params, opt_state, jax.random.key(0), x, y
+    )
+    err.throw()  # no error on a healthy step
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_loop_raises_on_nonfinite_loss(char_dataset, tmp_path, monkeypatch):
+    """The loop's NaN guard: poison the LR to produce a NaN loss fast and
+    assert the FloatingPointError fires (rather than silently logging nan)."""
+    from tests.test_train_tpu import make_cfg
+
+    from avenir_tpu.train.loop import run_training
+
+    cfg = make_cfg(char_dataset["dir"], tmp_path / "out", max_iters=30,
+                   learning_rate=1e6, grad_clip=0.0, eval_interval=100,
+                   warmup_iters=0, mesh_shape="data:1")
+    with pytest.raises(FloatingPointError):
+        run_training(cfg)

@@ -1,0 +1,96 @@
+"""HF GPT-2 import (avenir_tpu/tools/hf_import.py) — offline tests.
+
+The real HF cache is absent in CI, so the mapping is exercised against a
+synthetic HF-style state dict built from the torch reference model
+(model.py), whose Conv1D/prefix conventions from_pretrained documents
+(model.py:210-254): keys unprefixed, Conv1D projections stored (in, out),
+mask buffers present, lm_head alias present.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import model as torch_model
+from avenir_tpu.tools.hf_import import (
+    HF_CONFIGS,
+    gpt2_config,
+    gpt2_from_hf,
+    hf_sd_to_torch_layout,
+    load_hf_gpt2_sd,
+)
+
+_CONV1D = ("attn.c_attn.weight", "attn.c_proj.weight",
+           "mlp.c_fc.weight", "mlp.c_proj.weight")
+
+
+def _fake_hf_sd(tmodel):
+    """torch reference state_dict → the raw HF on-hub layout."""
+    sd = {}
+    for k, v in tmodel.state_dict().items():
+        if k.endswith(".attn.causal_mask"):
+            continue
+        arr = v.detach().numpy()
+        if k.startswith("transformer."):
+            k = k[len("transformer."):]
+        if any(k.endswith(s) for s in _CONV1D):
+            arr = np.ascontiguousarray(arr.T)  # HF Conv1D stores (in, out)
+        sd[k] = arr
+    # HF checkpoints carry mask buffers the importer must skip
+    sd["h.0.attn.bias"] = np.tril(np.ones((1, 1, 8, 8), np.uint8))
+    return sd
+
+
+def test_hf_import_logits_match_torch():
+    cfg = torch_model.GPTConfig(block_size=8, vocab_size=32, n_layer=2,
+                                n_head=2, n_embd=16, dropout=0.0, bias=True)
+    tm = torch_model.GPT(cfg)
+    tm.eval()
+
+    from flax import nnx
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+
+    jm = GPT(GPTConfig(block_size=8, vocab_size=32, n_layer=2, n_head=2,
+                       n_embd=16, dropout=0.0, bias=True, attn_impl="xla"),
+             rngs=nnx.Rngs(0))
+    load_hf_gpt2_sd(jm, _fake_hf_sd(tm))
+
+    idx = np.random.default_rng(0).integers(0, 32, (2, 8))
+    with torch.no_grad():
+        tl, _ = tm(torch.from_numpy(idx), torch.from_numpy(idx))
+    jl, _ = jm(idx, idx)
+    np.testing.assert_allclose(np.asarray(jl), tl.numpy(), atol=2e-5)
+
+
+def test_hf_layout_normalization():
+    sd = {
+        "wte.weight": np.zeros((4, 2)),
+        "h.0.attn.c_attn.weight": np.zeros((2, 6)),  # Conv1D (in, out)
+        "h.0.attn.bias": np.zeros((1, 1, 4, 4)),     # mask buffer → dropped
+        "lm_head.weight": np.zeros((4, 2)),          # tied alias → dropped
+        "transformer.ln_f.weight": np.zeros((2,)),   # prefixed variant kept
+    }
+    out = hf_sd_to_torch_layout(sd)
+    assert set(out) == {"transformer.wte.weight",
+                        "transformer.h.0.attn.c_attn.weight",
+                        "transformer.ln_f.weight"}
+    assert out["transformer.h.0.attn.c_attn.weight"].shape == (6, 2)
+
+
+def test_gpt2_config_table_matches_torch_reference():
+    for name, args in HF_CONFIGS.items():
+        cfg = gpt2_config(name)
+        assert cfg.vocab_size == 50257 and cfg.block_size == 1024 and cfg.bias
+        assert (cfg.n_layer, cfg.n_head, cfg.n_embd) == (
+            args["n_layer"], args["n_head"], args["n_embd"])
+
+
+def test_gpt2_from_hf_reaches_weight_load_or_skips():
+    """With a cold HF cache the loader must fail with the clear egress
+    message, not an ImportError/ModuleNotFoundError (VERDICT r1 item 4)."""
+    try:
+        gpt2_from_hf("gpt2")
+    except RuntimeError as e:
+        assert "local HF cache" in str(e)
+        pytest.skip("HF cache not populated (expected in sandbox)")

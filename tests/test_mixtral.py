@@ -148,3 +148,51 @@ def test_ep_hlo_contains_all_to_all(char_dataset):
         lambda p, o, r, xx, yy: train_step(p, o, tx, r, xx, yy)
     ).lower(params, opt_state, jax.random.key(0), x, x).compile().as_text()
     assert "all-to-all" in hlo, "EP dispatch did not lower to all-to-all"
+
+
+def test_router_aux_loss_matches_hf_formula():
+    """The load-balancing loss added to the training loss must equal HF's
+    load_balancing_loss_func on the same router outputs (coef * mean over
+    layers), and vanish when the knob is 0."""
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, TINY["vocab_size"], (2, 16))
+    tgt = rng.integers(0, TINY["vocab_size"], (2, 16))
+
+    def loss_with(coef):
+        jm = Mixtral(
+            MixtralConfig(capacity_factor=2.0, router_aux_loss_coef=coef,
+                          **TINY),
+            rngs=nnx.Rngs(0),
+        )
+        _, loss = jm(jnp.asarray(idx), jnp.asarray(tgt))
+        return jm, float(loss)
+
+    jm, base = loss_with(0.0)
+    _, with_aux = loss_with(0.02)
+    assert with_aux > base  # aux is nonnegative and generically > 0
+
+    # recompute HF load_balancing_loss_func by hand: router outputs of ALL
+    # layers CONCATENATED, then E * sum(tokens_per_expert * prob_per_expert)
+    all_oh, all_probs = [], []
+    h = jm.embed_tokens(jnp.asarray(idx))
+    E, K = TINY["n_experts"], TINY["n_experts_per_tok"]
+    for layer in jm.layers:
+        pre = layer.input_layernorm(h).astype(jnp.float32)
+        h = h + layer.self_attn(pre)
+        moe_in = layer.post_attention_layernorm(h).astype(jnp.float32)
+        N = moe_in.shape[0] * moe_in.shape[1]
+        logits = layer.block_sparse_moe.gate(
+            moe_in.reshape(N, -1)).astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        _, topk_idx = jax.lax.top_k(probs, K)
+        all_oh.append(jax.nn.one_hot(topk_idx, E))
+        all_probs.append(probs)
+        moe_out, _ = layer.block_sparse_moe(moe_in.astype(h.dtype))
+        h = h + moe_out
+    oh_cat = jnp.concatenate(all_oh, axis=0)       # (L*N, K, E)
+    probs_cat = jnp.concatenate(all_probs, axis=0)  # (L*N, E)
+    hf_aux = E * jnp.sum(
+        jnp.mean(oh_cat, axis=0) * jnp.mean(probs_cat, axis=0)[None, :]
+    )
+    expect = 0.02 * float(hf_aux)
+    np.testing.assert_allclose(with_aux - base, expect, rtol=1e-4)

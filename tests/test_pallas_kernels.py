@@ -126,39 +126,3 @@ def test_rmsnorm_forward_and_grads():
                                atol=1e-5, rtol=1e-5)
     np.testing.assert_allclose(np.asarray(gw_k), np.asarray(gw_r),
                                atol=1e-5, rtol=1e-5)
-
-
-def test_fused_adamw_matches_optax():
-    from avenir_tpu.ops.pallas.adamw import fused_adamw
-
-    params = {
-        "w": jnp.asarray(np.random.default_rng(0).normal(size=(33, 17)),
-                         jnp.float32),
-        "b": jnp.asarray(np.random.default_rng(1).normal(size=(7,)),
-                         jnp.float32),
-    }
-    mask = {"w": True, "b": False}
-    import optax
-
-    sched = optax.linear_schedule(1e-2, 1e-3, 10)
-    ours = fused_adamw(sched, b1=0.9, b2=0.95, eps=1e-8, weight_decay=0.1,
-                       mask=mask, interpret=True)
-    ref = optax.adamw(sched, b1=0.9, b2=0.95, eps=1e-8, weight_decay=0.1,
-                      mask=mask)
-
-    s_ours, s_ref = ours.init(params), ref.init(params)
-    p_ours = p_ref = params
-    for i in range(4):
-        g = jax.tree.map(
-            lambda p: jnp.asarray(
-                np.random.default_rng(10 + i).normal(size=p.shape), jnp.float32
-            ),
-            params,
-        )
-        u_o, s_ours = ours.update(g, s_ours, p_ours)
-        p_ours = optax.apply_updates(p_ours, u_o)
-        u_r, s_ref = ref.update(g, s_ref, p_ref)
-        p_ref = optax.apply_updates(p_ref, u_r)
-    for k in params:
-        np.testing.assert_allclose(np.asarray(p_ours[k]), np.asarray(p_ref[k]),
-                                   atol=1e-6, rtol=1e-5, err_msg=k)

@@ -1,0 +1,228 @@
+"""scan_layers: lax.scan over stacked homogeneous blocks (SURVEY.md §3.3
+"nnx.scan over the L blocks"; the three deep ladder configs set it True).
+
+Covers: trajectory equivalence scan vs python-loop (same weights via the
+checkpoint bridge — which doubles as a bridge test for the stacked layout),
+partition-rule coverage with the leading layer axis, and a full .pt
+checkpoint round trip scanned-save → unscanned-restore.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import nnx
+
+from avenir_tpu.checkpoint.bridge import (
+    export_torch_state_dict,
+    load_torch_state_dict,
+    restack_scanned_paths,
+    unstack_scanned_paths,
+)
+from avenir_tpu.models.gpt import GPT, GPTConfig
+from avenir_tpu.train.optimizer import make_optimizer
+from avenir_tpu.train.step import jit_train_step, make_step_fns
+
+TINY = GPTConfig(block_size=16, vocab_size=64, n_layer=3, n_head=2,
+                 n_embd=32, dropout=0.0, bias=True, attn_impl="xla")
+
+
+def _copy_weights(src_model, dst_model, family="gpt", tied=True):
+    sd = export_torch_state_dict(src_model, model_family=family,
+                                 tied_lm_head=tied)
+    load_torch_state_dict(dst_model, sd, tied_lm_head=tied)
+
+
+def test_unstack_restack_roundtrip():
+    flat = {
+        ("h_scan", "attn", "kernel"): np.arange(24.0).reshape(3, 2, 4),
+        ("ln_f", "scale"): np.ones(4),
+    }
+    un = unstack_scanned_paths(flat)
+    assert ("h", 0, "attn", "kernel") in un and ("h", 2, "attn", "kernel") in un
+    assert un[("ln_f", "scale")].shape == (4,)
+    re = restack_scanned_paths(un, flat.keys())
+    np.testing.assert_array_equal(re[("h_scan", "attn", "kernel")],
+                                  flat[("h_scan", "attn", "kernel")])
+
+
+def test_gpt_scan_logits_match_loop():
+    loop_model = GPT(TINY, rngs=nnx.Rngs(0))
+    scan_model = GPT(dataclasses.replace(TINY, scan_layers=True),
+                     rngs=nnx.Rngs(1))
+    _copy_weights(loop_model, scan_model)
+    idx = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 16)))
+    tgt = jnp.asarray(np.random.default_rng(1).integers(0, 64, (2, 16)))
+    logits_a, loss_a = loop_model(idx, tgt)
+    logits_b, loss_b = scan_model(idx, tgt)
+    np.testing.assert_allclose(np.asarray(logits_a), np.asarray(logits_b),
+                               atol=1e-5)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), atol=1e-6)
+
+
+def test_gpt_scan_remat_matches():
+    scan_model = GPT(dataclasses.replace(TINY, scan_layers=True),
+                     rngs=nnx.Rngs(0))
+    remat_model = GPT(dataclasses.replace(TINY, scan_layers=True, remat=True),
+                      rngs=nnx.Rngs(0))
+    idx = jnp.asarray(np.random.default_rng(2).integers(0, 64, (2, 16)))
+    tgt = jnp.asarray(np.random.default_rng(3).integers(0, 64, (2, 16)))
+
+    def loss_of(model):
+        graphdef, params = nnx.split(model, nnx.Param)
+
+        def f(p):
+            m = nnx.merge(graphdef, p)
+            return m(idx, tgt)[1]
+
+        loss, grads = jax.value_and_grad(f)(params)
+        return loss, grads
+
+    la, ga = loss_of(scan_model)
+    lb, gb = loss_of(remat_model)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_gpt_scan_training_trajectory_matches_loop():
+    rng = np.random.default_rng(0)
+    batches = [
+        (jnp.asarray(rng.integers(0, 64, (1, 2, 16)).astype(np.int32)),
+         jnp.asarray(rng.integers(0, 64, (1, 2, 16)).astype(np.int32)))
+        for _ in range(4)
+    ]
+
+    def train(scan):
+        cfg = dataclasses.replace(TINY, scan_layers=scan)
+        model = GPT(cfg, rngs=nnx.Rngs(0))
+        if scan:
+            ref = GPT(TINY, rngs=nnx.Rngs(0))
+            _copy_weights(ref, model)
+        graphdef, params = nnx.split(model, nnx.Param)
+        tx, _ = make_optimizer(params, learning_rate=1e-3, weight_decay=0.1,
+                               beta1=0.9, beta2=0.95, grad_clip=1.0,
+                               warmup_iters=0, lr_decay_iters=100,
+                               min_lr=1e-4)
+        opt_state = tx.init(params)
+        step_fn, _ = make_step_fns(graphdef, dropout=0.0)
+        step = jit_train_step(step_fn, tx)
+        key = jax.random.key(0)
+        losses = []
+        for x, y in batches:
+            params, opt_state, m = step(params, opt_state, key, x, y)
+            losses.append(float(m["loss"]))
+        return losses
+
+    np.testing.assert_allclose(train(False), train(True), rtol=2e-5)
+
+
+def test_scan_partition_rules_have_leading_layer_axis():
+    from avenir_tpu.parallel.partition import (
+        match_partition_rules, rules_for_model,
+    )
+
+    cfg = dataclasses.replace(TINY, scan_layers=True)
+    model = nnx.eval_shape(lambda: GPT(cfg, rngs=nnx.Rngs(0)))
+    paths = [p for p, _ in nnx.state(model, nnx.Param).flat_state()]
+    specs = match_partition_rules(rules_for_model("gpt"), paths)
+    scanned = [p for p in paths if any(str(s).endswith("_scan") for s in p)]
+    assert scanned, "scan model should have h_scan params"
+    for p in scanned:
+        spec = tuple(specs[p])
+        assert spec[0] is None, (p, spec)  # layer axis never sharded
+        # the underlying rule still applies to the trailing dims
+    # kernel under scan is (L, in, out): spec must not shard dim0
+    k = next(p for p in scanned if p[-1] == "kernel" and "c_attn" in p)
+    flat = dict(nnx.state(model, nnx.Param).flat_state())
+    assert len(flat[k].get_value().shape) == 3
+
+
+def test_scan_checkpoint_roundtrip(tmp_path):
+    """Save a scanned model's full training state as ckpt.pt, restore into
+    an UNSCANNED model: params and adam moments must match layer-for-layer."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from avenir_tpu.checkpoint.io import (
+        load_checkpoint, restore_opt_state, restore_params, save_checkpoint,
+    )
+
+    cfg = dataclasses.replace(TINY, scan_layers=True)
+    model = GPT(cfg, rngs=nnx.Rngs(0))
+    graphdef, params = nnx.split(model, nnx.Param)
+    tx, _ = make_optimizer(params, learning_rate=1e-3, weight_decay=0.1,
+                           beta1=0.9, beta2=0.95, grad_clip=1.0,
+                           warmup_iters=0, lr_decay_iters=100, min_lr=1e-4)
+    opt_state = tx.init(params)
+    step_fn, _ = make_step_fns(graphdef, dropout=0.0)
+    step = jit_train_step(step_fn, tx)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 64, (1, 2, 16)).astype(np.int32))
+    y = jnp.asarray(rng.integers(0, 64, (1, 2, 16)).astype(np.int32))
+    params, opt_state, _ = step(params, opt_state, jax.random.key(0), x, y)
+
+    model_args = dict(n_layer=3, n_head=2, n_embd=32, block_size=16,
+                      bias=True, vocab_size=64, dropout=0.0)
+    save_checkpoint(str(tmp_path), params=params, opt_state=opt_state,
+                    hyper={"lr": 1e-3, "betas": (0.9, 0.95), "eps": 1e-8,
+                           "weight_decay": 0.1},
+                    model_args=model_args, iter_num=1, best_val_loss=9.9,
+                    config={}, model_family="gpt")
+
+    # restore into the unscanned layout
+    ckpt = load_checkpoint(str(tmp_path))
+    loop_model = nnx.eval_shape(lambda: GPT(TINY, rngs=nnx.Rngs(0)))
+    _, abs_state = nnx.split(loop_model, nnx.Param)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
+    shardings = {p: NamedSharding(mesh, P())
+                 for p, _ in abs_state.flat_state()}
+    restored = restore_params(ckpt, abs_state, shardings)
+
+    scan_flat = unstack_scanned_paths(
+        {p: np.asarray(v.get_value()) for p, v in params.flat_state()}
+    )
+    for p, v in restored.flat_state():
+        np.testing.assert_allclose(np.asarray(v.get_value()), scan_flat[p],
+                                   atol=1e-7, err_msg=str(p))
+
+    # moments restore through the torch param-index schema
+    tx2, _ = make_optimizer(restored, learning_rate=1e-3, weight_decay=0.1,
+                            beta1=0.9, beta2=0.95, grad_clip=1.0,
+                            warmup_iters=0, lr_decay_iters=100, min_lr=1e-4)
+    opt2 = tx2.init(restored)
+    opt2 = restore_opt_state(ckpt, opt2, restored, shardings)
+    from avenir_tpu.checkpoint.io import _find_adam_state
+
+    mu_scan = unstack_scanned_paths(
+        {p: np.asarray(v.get_value())
+         for p, v in _find_adam_state(opt_state).mu.flat_state()}
+    )
+    for p, v in _find_adam_state(opt2).mu.flat_state():
+        np.testing.assert_allclose(np.asarray(v.get_value()), mu_scan[p],
+                                   atol=1e-7, err_msg=str(p))
+
+
+@pytest.mark.parametrize("family", ["llama", "mixtral"])
+def test_llama_family_scan_matches_loop(family):
+    from avenir_tpu.models.llama import Llama, LlamaConfig
+    from avenir_tpu.models.mixtral import Mixtral, MixtralConfig
+
+    kw = dict(block_size=16, vocab_size=64, n_layer=2, n_head=2, n_kv_head=1,
+              n_embd=32, ffn_hidden=64, dropout=0.0, attn_impl="xla")
+    if family == "llama":
+        cfg, ctor = LlamaConfig(**kw), Llama
+    else:
+        cfg = MixtralConfig(**kw, n_experts=4, n_experts_per_tok=2)
+        ctor = Mixtral
+    loop_model = ctor(cfg, rngs=nnx.Rngs(0))
+    scan_model = ctor(dataclasses.replace(cfg, scan_layers=True),
+                      rngs=nnx.Rngs(1))
+    _copy_weights(loop_model, scan_model, family="llama", tied=False)
+    idx = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 16)))
+    tgt = jnp.asarray(np.random.default_rng(1).integers(0, 64, (2, 16)))
+    la, lossa = loop_model(idx, tgt)
+    lb, lossb = scan_model(idx, tgt)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=2e-5)
+    np.testing.assert_allclose(float(lossa), float(lossb), atol=1e-6)

@@ -1,0 +1,174 @@
+"""Streaming (sharded-read/write) checkpoint I/O (SURVEY.md §5 "each host
+materializes only its FSDP shard"; VERDICT r1 item 4): peak host memory
+during save/restore must be far below the full fp32 tree, while the on-disk
+.pt stays byte-compatible with torch in both directions."""
+
+import dataclasses
+import os
+import tracemalloc
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import nnx
+
+from avenir_tpu.checkpoint.io import (
+    _find_adam_state,
+    load_checkpoint,
+    restore_opt_state,
+    restore_params,
+    save_checkpoint,
+)
+from avenir_tpu.checkpoint.torch_pt import LazyArray, lazy_unstack, load_pt, save_pt
+from avenir_tpu.models.gpt import GPT, GPTConfig
+from avenir_tpu.train.optimizer import make_optimizer
+from avenir_tpu.train.step import jit_train_step, make_step_fns
+
+# ~8M params (~32MB fp32); big enough that per-tensor streaming is clearly
+# distinguishable from whole-tree gathers under tracemalloc
+BIGGISH = GPTConfig(block_size=64, vocab_size=2048, n_layer=6, n_head=4,
+                    n_embd=256, dropout=0.0, bias=True, attn_impl="xla")
+
+MODEL_ARGS = dict(n_layer=6, n_head=4, n_embd=256, block_size=64, bias=True,
+                  vocab_size=2048, dropout=0.0)
+HYPER = {"lr": 1e-3, "betas": (0.9, 0.95), "eps": 1e-8, "weight_decay": 0.1}
+
+
+def _trained_state(cfg=BIGGISH):
+    model = GPT(cfg, rngs=nnx.Rngs(0))
+    graphdef, params = nnx.split(model, nnx.Param)
+    tx, _ = make_optimizer(params, learning_rate=1e-3, weight_decay=0.1,
+                           beta1=0.9, beta2=0.95, grad_clip=1.0,
+                           warmup_iters=0, lr_decay_iters=100, min_lr=1e-4)
+    opt_state = tx.init(params)
+    step_fn, _ = make_step_fns(graphdef, dropout=0.0)
+    step = jit_train_step(step_fn, tx)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 2048, (1, 2, 64)).astype(np.int32))
+    y = jnp.asarray(rng.integers(0, 2048, (1, 2, 64)).astype(np.int32))
+    params, opt_state, _ = step(params, opt_state, jax.random.key(0), x, y)
+    jax.block_until_ready(jax.tree.leaves(params)[0])
+    return graphdef, params, opt_state, tx
+
+
+def _tree_bytes(params):
+    return sum(v.get_value().size * 4 for _, v in params.flat_state())
+
+
+def test_streaming_save_peak_memory(tmp_path):
+    graphdef, params, opt_state, _ = _trained_state()
+    total = _tree_bytes(params) * 3  # params + mu + nu
+    tracemalloc.start()
+    save_checkpoint(str(tmp_path), params=params, opt_state=opt_state,
+                    hyper=HYPER, model_args=MODEL_ARGS, iter_num=1,
+                    best_val_loss=9.9, config={}, model_family="gpt")
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    # full-tree gather would hold >= total (~96MB); streaming holds one
+    # tensor (largest: wte 2048x256 fp32 = 2MB) + zip buffers
+    assert peak < total / 4, (peak, total)
+    assert os.path.exists(tmp_path / "ckpt.pt")
+
+
+def test_streaming_restore_peak_memory(tmp_path):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    graphdef, params, opt_state, tx = _trained_state()
+    total = _tree_bytes(params) * 3
+    save_checkpoint(str(tmp_path), params=params, opt_state=opt_state,
+                    hyper=HYPER, model_args=MODEL_ARGS, iter_num=1,
+                    best_val_loss=9.9, config={}, model_family="gpt")
+
+    abs_model = nnx.eval_shape(lambda: GPT(BIGGISH, rngs=nnx.Rngs(0)))
+    _, abs_state = nnx.split(abs_model, nnx.Param)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
+    shardings = {p: NamedSharding(mesh, P())
+                 for p, _ in abs_state.flat_state()}
+
+    # contrast against the eager path in the SAME process so jit-compile
+    # and allocator noise from earlier tests cancels out
+    tracemalloc.start()
+    ckpt_eager = load_checkpoint(str(tmp_path))
+    restore_params(ckpt_eager, abs_state, shardings)
+    _, peak_eager = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    del ckpt_eager
+
+    tracemalloc.start()
+    ckpt = load_checkpoint(str(tmp_path), lazy=True)
+    restored = restore_params(ckpt, abs_state, shardings)
+    opt2 = restore_opt_state(ckpt, tx.init(restored), restored, shardings)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    # eager load alone holds >= the full model tree; lazy restore of
+    # params AND moments must stay well under the eager params-only peak
+    assert peak < peak_eager / 2, (peak, peak_eager, total)
+
+    # and the values are right
+    want = {p: np.asarray(v.get_value()) for p, v in params.flat_state()}
+    for p, v in restored.flat_state():
+        np.testing.assert_allclose(np.asarray(v.get_value()), want[p],
+                                   atol=1e-7, err_msg=str(p))
+    mu_want = {p: np.asarray(v.get_value())
+               for p, v in _find_adam_state(opt_state).mu.flat_state()}
+    for p, v in _find_adam_state(opt2).mu.flat_state():
+        np.testing.assert_allclose(np.asarray(v.get_value()), mu_want[p],
+                                   atol=1e-7, err_msg=str(p))
+
+
+def test_streamed_pt_matches_eager_pt_and_torch_reads_it(tmp_path):
+    """A lazily-streamed .pt must decode identically to the eager one, and
+    real torch.load must accept it (cross-backend contract intact)."""
+    import torch
+
+    arr = np.arange(24, dtype=np.float32).reshape(4, 6)
+    lazy = LazyArray(arr.shape, arr.dtype, lambda: arr)
+    obj_lazy = {"model": {"w": lazy, "tied": lazy}, "iter_num": 3}
+    obj_eager = {"model": {"w": arr, "tied": arr}, "iter_num": 3}
+    save_pt(obj_lazy, str(tmp_path / "lazy.pt"))
+    save_pt(obj_eager, str(tmp_path / "eager.pt"))
+
+    a = load_pt(str(tmp_path / "lazy.pt"))
+    b = load_pt(str(tmp_path / "eager.pt"))
+    np.testing.assert_array_equal(a["model"]["w"], b["model"]["w"])
+
+    t = torch.load(str(tmp_path / "lazy.pt"), weights_only=False)
+    np.testing.assert_array_equal(t["model"]["w"].numpy(), arr)
+    # tied entries share one storage in the streamed file too
+    assert t["model"]["w"].data_ptr() == t["model"]["tied"].data_ptr()
+
+
+def test_lazy_load_roundtrip_matches_eager(tmp_path):
+    graphdef, params, opt_state, _ = _trained_state(
+        dataclasses.replace(BIGGISH, n_layer=2, n_embd=64, vocab_size=256)
+    )
+    save_checkpoint(str(tmp_path), params=params, opt_state=opt_state,
+                    hyper=HYPER,
+                    model_args={**MODEL_ARGS, "n_layer": 2, "n_embd": 64,
+                                "vocab_size": 256},
+                    iter_num=1, best_val_loss=9.9, config={},
+                    model_family="gpt")
+    eager = load_checkpoint(str(tmp_path))
+    lazy = load_checkpoint(str(tmp_path), lazy=True)
+    assert eager["iter_num"] == lazy["iter_num"] == 1
+    for k, v in eager["model"].items():
+        got = lazy["model"][k]
+        assert isinstance(got, LazyArray), k
+        np.testing.assert_array_equal(np.asarray(got), v)
+
+
+def test_lazy_unstack_materializes_base_once():
+    calls = []
+
+    def provider():
+        calls.append(1)
+        return np.arange(12.0).reshape(3, 2, 2)
+
+    base = LazyArray((3, 2, 2), np.float64, provider)
+    slices = lazy_unstack(base, 3)
+    for i, s in enumerate(slices):
+        np.testing.assert_array_equal(
+            np.asarray(s), np.arange(12.0).reshape(3, 2, 2)[i]
+        )
+    assert len(calls) == 1

@@ -252,7 +252,8 @@ def test_cross_backend_checkpoint_resume(char_dataset, tmp_path):
         return r.stdout
 
     run(["--max_iters=10"])  # torch from scratch
-    out2 = run(["--max_iters=20", "--backend=tpu", "--init_from=resume"])
+    out2 = run(["--max_iters=20", "--backend=tpu", "--init_from=resume",
+                "--mesh_shape=data:1"])
     assert "resuming" in out2
     out3 = run(["--max_iters=30", "--init_from=resume"])
     # torch resumed from the jax-written ckpt at iter 20

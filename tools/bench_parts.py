@@ -7,8 +7,11 @@ measured numbers, not guesses. Dev tool; not part of the test suite.
 Usage: python tools/bench_parts.py [--batch=16] [--block=1024]
 """
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

@@ -23,6 +23,11 @@ from contextlib import nullcontext
 
 import numpy as np
 
+# Snapshot XLA_FLAGS before any jax machinery runs: some PJRT plugin
+# environments consume the var during import, which would silently drop
+# e.g. --xla_force_host_platform_device_count for CPU multi-device smokes.
+_XLA_FLAGS_AT_START = os.environ.get("XLA_FLAGS")
+
 # -----------------------------------------------------------------------------
 # defaults — every key here is overridable via config file or --key=value
 # I/O
@@ -56,6 +61,7 @@ rope_theta = 10000.0
 n_experts = 8
 n_experts_per_tok = 2
 capacity_factor = 1.25
+router_aux_loss_coef = 0.02  # mixtral load-balancing aux loss (0 disables)
 # adamw
 learning_rate = 6e-4
 max_iters = 600000
@@ -72,14 +78,15 @@ min_lr = 6e-5
 backend = "cuda"  # 'cuda' (torch ref incl. CPU) | 'tpu' (jax)
 device = "cuda"  # torch device string for the cuda backend; 'cpu' works
 dtype = "bfloat16"  # 'float32' | 'bfloat16' | 'float16'
-compile = True  # torch.compile / (tpu path is always jit-compiled)
+compile = True  # torch.compile on the cuda backend; documented no-op on tpu (always jit)
 seed = 1337
+debug_nans = False  # tpu: raise at the first NaN-producing op (jax_debug_nans)
 # tpu-backend parallelism (ignored by cuda backend)
 mesh_shape = ""  # e.g. "data:4,fsdp:2"; "" → all devices on 'data'
 remat = False  # rematerialize blocks (activation checkpointing)
 scan_layers = False  # lax.scan over blocks (fast compiles for deep models)
 use_pallas = True  # pallas flash attention on TPU (auto-falls back off-TPU)
-fused_adamw = False  # pallas fused-AdamW (XLA-fused optax is faster on v5e; kept for pods)
+fused_adamw = False  # accepted+ignored: XLA-fused optax IS the hot path (BASELINE.md)
 profile = False  # capture a jax.profiler trace window
 # -----------------------------------------------------------------------------
 from configurator import configure
@@ -324,6 +331,12 @@ def train_tpu():
     """TPU-native trainer (T5 + friends): delegates to avenir_tpu with the
     same config namespace. jax is imported lazily here so the cuda path never
     needs it (and vice versa)."""
+    if _XLA_FLAGS_AT_START and os.environ.get("XLA_FLAGS") != _XLA_FLAGS_AT_START:
+        os.environ["XLA_FLAGS"] = _XLA_FLAGS_AT_START
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     from avenir_tpu.train.loop import run_training
 
     run_training(config)
