@@ -22,14 +22,15 @@ def _fwd_kernel(x_ref, w_ref, y_ref, inv_ref, *, eps):
     inv = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
     w = w_ref[...].astype(jnp.float32)
     y_ref[...] = (x * inv * w).astype(y_ref.dtype)
-    inv_ref[...] = inv[:, 0]
+    inv_ref[...] = inv  # (R, 1): 2-D so XLA/Mosaic agree on the tiling
+    # (a 1-D (N,) side output trips a layout mismatch at N >= 4096)
 
 
 def _dx_kernel(x_ref, w_ref, dy_ref, inv_ref, dx_ref):
     x = x_ref[...].astype(jnp.float32)
     w = w_ref[...].astype(jnp.float32)
     dy = dy_ref[...].astype(jnp.float32)
-    inv = inv_ref[...][:, None]  # (R, 1)
+    inv = inv_ref[...]  # (R, 1)
     wdy = w * dy
     mean_term = jnp.mean(wdy * x, axis=-1, keepdims=True)
     dx = inv * wdy - x * (inv ** 3) * mean_term
@@ -55,11 +56,11 @@ def _fwd_call(x2, w, eps, interpret):
         ],
         out_specs=[
             pl.BlockSpec((R, D), lambda i: (i, 0)),
-            pl.BlockSpec((R,), lambda i: (i,)),
+            pl.BlockSpec((R, 1), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((N, D), x2.dtype),
-            jax.ShapeDtypeStruct((N,), jnp.float32),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
         ],
         interpret=interpret,
     )(x2, w[None, :])
@@ -88,7 +89,7 @@ def _build(eps, interpret):
                 pl.BlockSpec((R, D), lambda i: (i, 0)),
                 pl.BlockSpec((1, D), lambda i: (0, 0)),
                 pl.BlockSpec((R, D), lambda i: (i, 0)),
-                pl.BlockSpec((R,), lambda i: (i,)),
+                pl.BlockSpec((R, 1), lambda i: (i, 0)),
             ],
             out_specs=pl.BlockSpec((R, D), lambda i: (i, 0)),
             out_shape=jax.ShapeDtypeStruct((N, D), x2.dtype),
@@ -97,7 +98,7 @@ def _build(eps, interpret):
         # dw: cross-row reduction — one fused XLA contraction
         dw = jnp.einsum(
             "nd,nd,n->d",
-            dy.astype(jnp.float32), x2.astype(jnp.float32), inv,
+            dy.astype(jnp.float32), x2.astype(jnp.float32), inv[:, 0],
         ).astype(w.dtype)
         return dx, dw
 

@@ -1,0 +1,150 @@
+"""Ladder rungs 3-5 on the real chip (BASELINE.json:9-11; VERDICT r1 item 6).
+
+The full configs cannot fit one 16GB v5e: training state alone is
+~12 bytes/param fp32 (params + adam mu/nu) plus fp32 grads during the
+step (~16-20 B/param) — 1.5B needs ~25GB, Llama-8B ~130GB, Mixtral-8x7B
+~750GB. Those run multi-chip via FSDP/EP (dryrun_multichip validates the
+shardings). This tool measures the largest SAME-SHAPE variants that fit a
+single chip (matmul widths, head layout, expert count preserved; depth /
+vocab reduced — each deviation printed), producing real tok/s + MFU rows
+for BASELINE.md.
+
+Usage: python tools/bench_ladder.py [--rung=1p5b|llama8b|mixtral] [--steps=8]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def run_rung(name, family, cfg_kwargs, batch, steps, flops_per_token):
+    from flax import nnx
+
+    from avenir_tpu.train.optimizer import make_optimizer
+    from avenir_tpu.train.step import jit_train_step, make_step_fns
+
+    if family == "gpt":
+        from avenir_tpu.models.gpt import GPT, GPTConfig
+
+        cfg = GPTConfig(**cfg_kwargs)
+        ctor = GPT
+    elif family == "llama":
+        from avenir_tpu.models.llama import Llama, LlamaConfig
+
+        cfg = LlamaConfig(**cfg_kwargs)
+        ctor = Llama
+    else:
+        from avenir_tpu.models.mixtral import Mixtral, MixtralConfig
+
+        cfg = MixtralConfig(**cfg_kwargs)
+        ctor = Mixtral
+
+    model = ctor(cfg, rngs=nnx.Rngs(0))
+    graphdef, params = nnx.split(model, nnx.Param)
+    n_params = sum(int(np.prod(v.get_value().shape))
+                   for _, v in params.flat_state())
+    tx, _ = make_optimizer(params, learning_rate=3e-4, weight_decay=0.1,
+                           beta1=0.9, beta2=0.95, grad_clip=1.0,
+                           warmup_iters=10, lr_decay_iters=1000, min_lr=3e-5)
+    opt_state = jax.jit(tx.init)(params)
+    step_fn, _ = make_step_fns(graphdef, dropout=0.0)
+    step = jit_train_step(step_fn, tx)
+
+    T = cfg.block_size
+    rng = np.random.default_rng(0)
+    V = cfg.vocab_size
+    x = jax.numpy.asarray(rng.integers(0, V, (1, batch, T)).astype(np.int32))
+    y = jax.numpy.asarray(rng.integers(0, V, (1, batch, T)).astype(np.int32))
+    key = jax.random.key(0)
+
+    p, o = params, opt_state
+    for _ in range(2):
+        p, o, m = step(p, o, key, x, y)
+    float(m["loss"])  # fence (axon: D2H readback, not block_until_ready)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        p, o, m = step(p, o, key, x, y)
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+    toks = batch * T * steps / dt
+
+    from avenir_tpu.models.common import tpu_peak_flops
+
+    mfu = toks * flops_per_token / tpu_peak_flops()
+    print(f"{name}: params={n_params/1e9:.3f}B batch={batch} T={T} "
+          f"tok/s/chip={toks:,.0f} mfu={mfu*100:.1f}%")
+    return toks, mfu
+
+
+def main():
+    args = {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
+            for a in sys.argv[1:]}
+    steps = int(args.get("steps", 8))
+    which = args.get("rung", "all")
+
+    from avenir_tpu.models.common import transformer_flops_per_token
+
+    if which in ("all", "1p5b"):
+        # GPT-2 1.5B shape: d=1600, 25 heads (BASELINE.json:9). Full 48
+        # layers = 1.56B params = ~25GB state; 16 layers (0.57B) fits.
+        L, d, h, T = 16, 1600, 25, 1024
+        n = 80_000_000 + L * 12 * d * d  # embed + blocks (approx, logged exact)
+        run_rung(
+            "gpt2-1.5b-shape (L=48->16, d/heads/T full)", "gpt",
+            dict(block_size=T, vocab_size=50304, n_layer=L, n_head=h,
+                 n_embd=d, dropout=0.0, bias=True, compute_dtype="bfloat16",
+                 attn_impl="pallas", scan_layers=True, remat=True),
+            batch=4, steps=steps,
+            flops_per_token=transformer_flops_per_token(n, L, h, d // h, T),
+        )
+
+    if which in ("all", "llama8b"):
+        # Llama-3 8B shape: d=4096 ffn=14336 GQA 32/8 (BASELINE.json:10).
+        # Full: 32 layers vocab 128256 = 8B params (~130GB state). Fits:
+        # 2 layers + vocab 16384 (0.57B). T=4096 exercises the blocked
+        # (long-context) flash attention path.
+        L, d, hq, hkv, ffn, T, V = 2, 4096, 32, 8, 14336, 4096, 16384
+        per_layer = 2 * d * d + 2 * d * (d // (hq // hkv)) + 3 * d * ffn
+        n = 2 * V * d + L * per_layer
+        run_rung(
+            "llama3-8b-shape (L=32->2, vocab->16k, d/ffn/GQA/long-T full)",
+            "llama",
+            dict(block_size=T, vocab_size=V, n_layer=L, n_head=hq,
+                 n_kv_head=hkv, n_embd=d, ffn_hidden=ffn,
+                 rope_theta=500000.0, compute_dtype="bfloat16",
+                 attn_impl="pallas", scan_layers=True, remat=True),
+            batch=1, steps=steps,
+            flops_per_token=transformer_flops_per_token(n, L, hq, d // hq, T),
+        )
+
+    if which in ("all", "mixtral"):
+        # Mixtral-8x7B shape: d=4096 ffn=14336 E=8 K=2 (BASELINE.json:11).
+        # Full: 47B params. Fits: d=2048 ffn=7168 keeps the E=8/K=2 routed
+        # structure and expert einsum shape family at 1 layer (0.44B).
+        L, d, hq, hkv, ffn, E, K, T, V = 1, 2048, 16, 4, 7168, 8, 2, 1024, 16384
+        per_layer = 2 * d * d + 2 * d * (d // (hq // hkv)) + 3 * d * ffn * E
+        n = 2 * V * d + L * per_layer
+        n_active = 2 * V * d + L * (2 * d * d + 2 * d * (d // (hq // hkv))
+                                    + 3 * d * ffn * K)
+        run_rung(
+            f"mixtral-shape (E=8 K=2 kept; d->2048 ffn->7168 L=1 vocab->16k)",
+            "mixtral",
+            dict(block_size=T, vocab_size=V, n_layer=L, n_head=hq,
+                 n_kv_head=hkv, n_embd=d, ffn_hidden=ffn, n_experts=E,
+                 n_experts_per_tok=K, capacity_factor=1.25,
+                 rope_theta=10000.0, compute_dtype="bfloat16",
+                 attn_impl="pallas", scan_layers=False, remat=True),
+            batch=4, steps=steps,
+            # MFU on ACTIVE params (dense-equivalent work actually done)
+            flops_per_token=transformer_flops_per_token(
+                n_active, L, hq, d // hq, T),
+        )
+
+
+if __name__ == "__main__":
+    main()
