@@ -22,7 +22,8 @@ import jax
 import numpy as np
 
 
-def run_rung(name, family, cfg_kwargs, batch, steps, flops_per_token):
+def run_rung(name, family, cfg_kwargs, batch, steps, flops_per_token=None,
+             active_params=None):
     from flax import nnx
 
     from avenir_tpu.train.optimizer import make_optimizer
@@ -48,6 +49,16 @@ def run_rung(name, family, cfg_kwargs, batch, steps, flops_per_token):
     graphdef, params = nnx.split(model, nnx.Param)
     n_params = sum(int(np.prod(v.get_value().shape))
                    for _, v in params.flat_state())
+    if flops_per_token is None:
+        from avenir_tpu.models.common import transformer_flops_per_token
+
+        # exact instantiated param count (active_params adjusts for MoE:
+        # dense-equivalent FLOPs only count the K routed experts)
+        n_eff = active_params(n_params) if active_params else n_params
+        flops_per_token = transformer_flops_per_token(
+            n_eff, cfg.n_layer, cfg.n_head,
+            cfg.n_embd // cfg.n_head, cfg.block_size,
+        )
     tx, _ = make_optimizer(params, learning_rate=3e-4, weight_decay=0.1,
                            beta1=0.9, beta2=0.95, grad_clip=1.0,
                            warmup_iters=10, lr_decay_iters=1000, min_lr=3e-5)
@@ -87,20 +98,16 @@ def main():
     steps = int(args.get("steps", 8))
     which = args.get("rung", "all")
 
-    from avenir_tpu.models.common import transformer_flops_per_token
-
     if which in ("all", "1p5b"):
         # GPT-2 1.5B shape: d=1600, 25 heads (BASELINE.json:9). Full 48
         # layers = 1.56B params = ~25GB state; 16 layers (0.57B) fits.
         L, d, h, T = 16, 1600, 25, 1024
-        n = 80_000_000 + L * 12 * d * d  # embed + blocks (approx, logged exact)
         run_rung(
             "gpt2-1.5b-shape (L=48->16, d/heads/T full)", "gpt",
             dict(block_size=T, vocab_size=50304, n_layer=L, n_head=h,
                  n_embd=d, dropout=0.0, bias=True, compute_dtype="bfloat16",
                  attn_impl="pallas", scan_layers=True, remat=True),
             batch=4, steps=steps,
-            flops_per_token=transformer_flops_per_token(n, L, h, d // h, T),
         )
 
     if which in ("all", "llama8b"):
@@ -109,8 +116,6 @@ def main():
         # 2 layers + vocab 16384 (0.57B). T=4096 exercises the blocked
         # (long-context) flash attention path.
         L, d, hq, hkv, ffn, T, V = 2, 4096, 32, 8, 14336, 4096, 16384
-        per_layer = 2 * d * d + 2 * d * (d // (hq // hkv)) + 3 * d * ffn
-        n = 2 * V * d + L * per_layer
         run_rung(
             "llama3-8b-shape (L=32->2, vocab->16k, d/ffn/GQA/long-T full)",
             "llama",
@@ -119,7 +124,6 @@ def main():
                  rope_theta=500000.0, compute_dtype="bfloat16",
                  attn_impl="pallas", scan_layers=True, remat=True),
             batch=1, steps=steps,
-            flops_per_token=transformer_flops_per_token(n, L, hq, d // hq, T),
         )
 
     if which in ("all", "mixtral"):
@@ -127,12 +131,8 @@ def main():
         # Full: 47B params. Fits: d=2048 ffn=7168 keeps the E=8/K=2 routed
         # structure and expert einsum shape family at 1 layer (0.44B).
         L, d, hq, hkv, ffn, E, K, T, V = 1, 2048, 16, 4, 7168, 8, 2, 1024, 16384
-        per_layer = 2 * d * d + 2 * d * (d // (hq // hkv)) + 3 * d * ffn * E
-        n = 2 * V * d + L * per_layer
-        n_active = 2 * V * d + L * (2 * d * d + 2 * d * (d // (hq // hkv))
-                                    + 3 * d * ffn * K)
         run_rung(
-            f"mixtral-shape (E=8 K=2 kept; d->2048 ffn->7168 L=1 vocab->16k)",
+            "mixtral-shape (E=8 K=2 kept; d->2048 ffn->7168 L=1 vocab->16k)",
             "mixtral",
             dict(block_size=T, vocab_size=V, n_layer=L, n_head=hq,
                  n_kv_head=hkv, n_embd=d, ffn_hidden=ffn, n_experts=E,
@@ -140,9 +140,8 @@ def main():
                  rope_theta=10000.0, compute_dtype="bfloat16",
                  attn_impl="pallas", scan_layers=False, remat=True),
             batch=4, steps=steps,
-            # MFU on ACTIVE params (dense-equivalent work actually done)
-            flops_per_token=transformer_flops_per_token(
-                n_active, L, hq, d // hq, T),
+            # MFU on ACTIVE params: subtract the (E-K) unrouted experts
+            active_params=lambda n: n - L * 3 * d * ffn * (E - K),
         )
 
 

@@ -31,6 +31,21 @@ def timeit(fn, *args, warmup=3, iters=10):
     return (time.perf_counter() - t0) / iters
 
 
+def timeit_step_chain(step, opt_state, params, key, xb, yb,
+                      warmup=3, iters=10):
+    """Time a donated-state train step by chaining it (re-initializing the
+    donated buffers each call would skew); scalar loss readback fences."""
+    p, o = params, opt_state
+    for _ in range(warmup):
+        p, o, m = step(p, o, key, xb, yb)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        p, o, m = step(p, o, key, xb, yb)
+    float(m["loss"])
+    return (time.perf_counter() - t0) / iters
+
+
 def main():
     args = {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
             for a in sys.argv[1:]}
@@ -73,20 +88,9 @@ def main():
         key = jax.random.key(0)
         xb, yb = x_tok[None], y_tok[None]
 
-        def run(p, o):
-            p2, o2, m = step(p, o, key, xb, yb)
-            return m["loss"]
-
-        # donation: re-init state each call would skew; time the chain instead
-        for _ in range(3):
-            params, opt_state, m = step(params, opt_state, key, xb, yb)
-        float(m["loss"])
-        t0 = time.perf_counter()
-        for _ in range(10):
-            params, opt_state, m = step(params, opt_state, key, xb, yb)
-        float(m["loss"])
-        dt = (time.perf_counter() - t0) / 10
-        results[f"full_step_{attn}"] = dt
+        results[f"full_step_{attn}"] = timeit_step_chain(
+            step, opt_state, params, key, xb, yb
+        )
         del params, opt_state
 
     # ---- trunk only: fwd+bwd through blocks, NO lm_head/CE ----
@@ -135,5 +139,72 @@ def main():
         print(f"{name:32s} {dt * 1e3:8.2f} ms")
 
 
+def ablations():
+    """Step-cost decomposition by ablation (one jit each, real chip)."""
+    from flax import nnx
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+    from avenir_tpu.train.optimizer import make_optimizer
+    from avenir_tpu.train.step import jit_train_step, make_step_fns
+
+    B, T, C, H, V, L = 16, 1024, 768, 12, 50304, 12
+    rng = np.random.default_rng(0)
+    x_tok = jnp.asarray(rng.integers(0, V, (B, T)).astype(np.int32))
+    y_tok = jnp.asarray(rng.integers(0, V, (B, T)).astype(np.int32))
+
+    cfg = GPTConfig(block_size=T, vocab_size=V, n_layer=L, n_head=H,
+                    n_embd=C, dropout=0.0, bias=True,
+                    compute_dtype="bfloat16", attn_impl="pallas")
+    model = GPT(cfg, rngs=nnx.Rngs(0))
+    graphdef, params = nnx.split(model, nnx.Param)
+
+    def timed_grad(loss_fn, name):
+        g = jax.jit(jax.grad(loss_fn))
+        dt = timeit(lambda: g(params))
+        print(f"{name:44s} {dt * 1e3:8.2f} ms")
+
+    def full_loss(p):
+        m = nnx.merge(graphdef, p)
+        _, loss = m(x_tok, y_tok)
+        return loss
+
+    def mean_logit_loss(p):  # lm_head matmul kept, CE dropped
+        m = nnx.merge(graphdef, p)
+        pos = jnp.arange(T, dtype=jnp.int32)
+        h = m.wte(x_tok) + m.wpe(pos)[None]
+        for blk in m.h:
+            h = blk(h)
+        h = m.ln_f(h).astype(jnp.bfloat16)
+        lg = m.wte.attend(h)
+        return lg.astype(jnp.float32).mean()
+
+    def trunk_loss(p):  # no lm_head at all
+        m = nnx.merge(graphdef, p)
+        pos = jnp.arange(T, dtype=jnp.int32)
+        h = m.wte(x_tok) + m.wpe(pos)[None]
+        for blk in m.h:
+            h = blk(h)
+        return m.ln_f(h).astype(jnp.float32).mean()
+
+    timed_grad(full_loss, "grad: full (trunk+lm_head+CE)")
+    timed_grad(mean_logit_loss, "grad: trunk+lm_head, mean loss (no CE)")
+    timed_grad(trunk_loss, "grad: trunk only")
+
+    # optimizer cost: full step minus grad-only
+    tx, _ = make_optimizer(params, learning_rate=6e-4, weight_decay=0.1,
+                           beta1=0.9, beta2=0.95, grad_clip=1.0,
+                           warmup_iters=10, lr_decay_iters=1000, min_lr=6e-5)
+    opt_state = jax.jit(tx.init)(params)
+    step_fn, _ = make_step_fns(graphdef, dropout=0.0)
+    step = jit_train_step(step_fn, tx)
+    key = jax.random.key(0)
+    xb, yb = x_tok[None], y_tok[None]
+    dt = timeit_step_chain(step, opt_state, params, key, xb, yb)
+    print(f"{'full train step (grad+clip+adamw)':44s} {dt * 1e3:8.2f} ms")
+
+
 if __name__ == "__main__":
-    main()
+    if "--ablate" in sys.argv:
+        ablations()
+    else:
+        main()
