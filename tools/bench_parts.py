@@ -147,7 +147,11 @@ def ablations():
     from avenir_tpu.train.optimizer import make_optimizer
     from avenir_tpu.train.step import jit_train_step, make_step_fns
 
-    B, T, C, H, V, L = 16, 1024, 768, 12, 50304, 12
+    args = {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
+            for a in sys.argv[1:]}
+    B = int(args.get("batch", 16))
+    T = int(args.get("block", 1024))
+    C, H, V, L = 768, 12, 50304, 12
     rng = np.random.default_rng(0)
     x_tok = jnp.asarray(rng.integers(0, V, (B, T)).astype(np.int32))
     y_tok = jnp.asarray(rng.integers(0, V, (B, T)).astype(np.int32))
