@@ -312,7 +312,8 @@ def run_training(cfg):
         # printing/logging is coordinator-only. All processes compute the
         # same losses (same global arrays), so the save decision agrees.
         if iter_num % cfg["eval_interval"] == 0:
-            losses = estimate_loss(params)
+            with jax.profiler.TraceAnnotation("eval"):
+                losses = estimate_loss(params)
             if master:
                 print(f"step {iter_num}: train loss {losses['train']:.4f}, "
                       f"val loss {losses['val']:.4f}")
@@ -329,26 +330,37 @@ def run_training(cfg):
                 if iter_num > 0:
                     if master:
                         print(f"saving checkpoint to {cfg['out_dir']}")
-                    save_checkpoint(
-                        cfg["out_dir"], params=params, opt_state=opt_state,
-                        hyper={"lr": lr, "betas": (cfg["beta1"], cfg["beta2"]),
-                               "eps": 1e-8, "weight_decay": cfg["weight_decay"]},
-                        model_args=model_args, iter_num=iter_num,
-                        best_val_loss=best_val_loss, config=cfg,
-                        model_family=st["model_type"],
-                    )
+                    with jax.profiler.TraceAnnotation("checkpoint"):
+                        save_checkpoint(
+                            cfg["out_dir"], params=params, opt_state=opt_state,
+                            hyper={"lr": lr,
+                                   "betas": (cfg["beta1"], cfg["beta2"]),
+                                   "eps": 1e-8,
+                                   "weight_decay": cfg["weight_decay"]},
+                            model_args=model_args, iter_num=iter_num,
+                            best_val_loss=best_val_loss, config=cfg,
+                            model_family=st["model_type"],
+                        )
         if iter_num == 0 and cfg["eval_only"]:
             break
 
+        # profile window: iters [10, 20) traced on the coordinator only
+        # (start and stop both keyed on `profile_started`, which only the
+        # coordinator ever sets — the gating is symmetric by construction)
         if cfg["profile"] and iter_num == 10 and master and not profile_started:
             jax.profiler.start_trace(os.path.join(cfg["out_dir"], "profile"))
             profile_started = True
 
         step_rng = jax.random.fold_in(base_rng, iter_num)
-        params, opt_state, metrics = train_step(params, opt_state, step_rng, x, y)
-        x, y = train_loader.get_batch("train")  # overlap host sampling w/ device step
+        # StepTraceAnnotation groups device activity per train step in
+        # XProf/TensorBoard (SURVEY.md §5 "annotate phases")
+        with jax.profiler.StepTraceAnnotation("train", step_num=iter_num):
+            params, opt_state, metrics = train_step(params, opt_state,
+                                                    step_rng, x, y)
+        with jax.profiler.TraceAnnotation("host_batch"):
+            x, y = train_loader.get_batch("train")  # overlap host sampling w/ device step
 
-        if cfg["profile"] and iter_num == 20 and profile_started:
+        if cfg["profile"] and iter_num >= 20 and profile_started:
             jax.block_until_ready(metrics["loss"])
             jax.profiler.stop_trace()
             profile_started = False

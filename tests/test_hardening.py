@@ -190,3 +190,24 @@ def test_loop_raises_on_nonfinite_loss(char_dataset, tmp_path, monkeypatch):
                    warmup_iters=0, mesh_shape="data:1")
     with pytest.raises(FloatingPointError):
         run_training(cfg)
+
+
+def test_profile_trace_window(char_dataset, tmp_path):
+    """--profile captures a real xplane trace over iters 10-20 and the run
+    completes (SURVEY.md §5 tracing; VERDICT r1 weak item 8: the start/stop
+    gating must work, not just exist)."""
+    import glob
+
+    from tests.test_train_tpu import make_cfg
+
+    from avenir_tpu.train.loop import run_training
+
+    cfg = make_cfg(char_dataset["dir"], tmp_path / "out", max_iters=22,
+                   profile=True, eval_interval=50, mesh_shape="data:1")
+    res = run_training(cfg)
+    assert res["iter_num"] >= 22
+    traces = glob.glob(
+        str(tmp_path / "out" / "profile" / "**" / "*.xplane.pb"),
+        recursive=True,
+    )
+    assert traces, "profile window produced no xplane trace"
