@@ -48,11 +48,15 @@ NEG_INF = -1e30
 # the loads/stores layout-friendly.
 _LANES = 128
 # Sequences up to this padded length take the single-KV-block fast path:
-# softmax computed directly (no online-softmax scratch carry). Measured on
-# v5e the scratch carry costs ~2x on the fwd kernel (BASELINE.md attention
-# table); the fast path's VMEM working set is the (block_q, T) fp32 score
-# block, which at 2048 and block_q=512 is 4MB.
-_FAST_PATH_MAX_T = 2048
+# softmax computed directly (no online-softmax scratch carry) and the
+# backward fully fused. Measured on v5e the scratch carry costs ~2x on the
+# fwd kernel (BASELINE.md attention table). Ceiling set by the fused
+# backward's VMEM live set — ~3 concurrent (block_q, T) fp32 blocks
+# (p/dp/ds) + (T, D) fp32 dk/dv scratch ≈ 26MB at 4096, verified compiling
+# and running on chip under the 64MB scoped limit; 8192 would brush the
+# limit and is unmeasured, so longer sequences stream KV through the
+# blocked online-softmax path.
+_FAST_PATH_MAX_T = 4096
 
 
 def _branch(pred, then_fn, else_fn):
@@ -77,12 +81,16 @@ def _mask_scores(s, q_off, k_off, causal, seq_len):
 
 def _compiler_params(n_parallel):
     """dimension_semantics hint: all grid dims except the innermost
-    (the streamed/accumulated one) are parallel."""
+    (the streamed/accumulated one) are parallel. The scoped-vmem limit is
+    raised from the 16MB default: the fast path's fp32 score block plus
+    the fused-bwd dk/dv scratch legitimately use more at long T (v5e has
+    128MB of VMEM; 64MB leaves ample headroom for double buffering)."""
     sem = ("parallel",) * n_parallel + ("arbitrary",)
+    kw = dict(dimension_semantics=sem, vmem_limit_bytes=64 * 1024 * 1024)
     try:
-        return pltpu.CompilerParams(dimension_semantics=sem)
+        return pltpu.CompilerParams(**kw)
     except (AttributeError, TypeError):  # older jax spelling
-        return pltpu.TPUCompilerParams(dimension_semantics=sem)
+        return pltpu.TPUCompilerParams(**kw)
 
 
 # ---------------------------------------------------------------------------

@@ -37,16 +37,20 @@ def _dx_kernel(x_ref, w_ref, dy_ref, inv_ref, dx_ref):
     dx_ref[...] = dx.astype(dx_ref.dtype)
 
 
-def _choose_rows(n_rows):
+def _choose_rows(n_rows, d):
+    """Largest row block that divides n_rows AND keeps the kernel's ~6
+    live (R, D) fp32 buffers within the 16MB scoped-VMEM budget (at
+    d=4096, R=256 was 18MB — the long-T Llama ladder OOM)."""
+    cap = max(8, (1 << 19) // max(d, 1))  # R*d*4B*6bufs <= ~12MB
     for r in (256, 128, 64, 32, 16, 8, 4, 2, 1):
-        if n_rows % r == 0:
+        if r <= cap and n_rows % r == 0:
             return r
     return 1
 
 
 def _fwd_call(x2, w, eps, interpret):
     N, D = x2.shape
-    R = _choose_rows(N)
+    R = _choose_rows(N, D)
     y, inv = pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
         grid=(N // R,),
@@ -81,7 +85,7 @@ def _build(eps, interpret):
     def f_bwd(res, dy):
         x2, w, inv = res
         N, D = x2.shape
-        R = _choose_rows(N)
+        R = _choose_rows(N, D)
         dx = pl.pallas_call(
             _dx_kernel,
             grid=(N // R,),

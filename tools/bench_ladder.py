@@ -9,7 +9,8 @@ single chip (matmul widths, head layout, expert count preserved; depth /
 vocab reduced — each deviation printed), producing real tok/s + MFU rows
 for BASELINE.md.
 
-Usage: python tools/bench_ladder.py [--rung=1p5b|llama8b|mixtral] [--steps=8]
+Usage: python tools/bench_ladder.py [--steps=8]
+         [--rung=1p5b|llama8b|llama8b-longT|mixtral]
 """
 
 import os
@@ -110,19 +111,29 @@ def main():
             batch=4, steps=steps,
         )
 
+    # Llama-3 8B shape: d=4096 ffn=14336 GQA 32/8 (BASELINE.json:10).
+    # Full: 32 layers vocab 128256 = 8B params (~130GB state). Fits:
+    # 2 layers + vocab 16384 (0.57B). One shared shape dict so the two
+    # T variants stay same-shape comparable.
+    llama_shape = dict(vocab_size=16384, n_layer=2, n_head=32, n_kv_head=8,
+                       n_embd=4096, ffn_hidden=14336, rope_theta=500000.0,
+                       compute_dtype="bfloat16", attn_impl="pallas",
+                       scan_layers=True, remat=True)
+
     if which in ("all", "llama8b"):
-        # Llama-3 8B shape: d=4096 ffn=14336 GQA 32/8 (BASELINE.json:10).
-        # Full: 32 layers vocab 128256 = 8B params (~130GB state). Fits:
-        # 2 layers + vocab 16384 (0.57B). T=4096 exercises the blocked
-        # (long-context) flash attention path.
-        L, d, hq, hkv, ffn, T, V = 2, 4096, 32, 8, 14336, 4096, 16384
+        # T=4096: single-KV-block fast path (fused bwd)
         run_rung(
             "llama3-8b-shape (L=32->2, vocab->16k, d/ffn/GQA/long-T full)",
-            "llama",
-            dict(block_size=T, vocab_size=V, n_layer=L, n_head=hq,
-                 n_kv_head=hkv, n_embd=d, ffn_hidden=ffn,
-                 rope_theta=500000.0, compute_dtype="bfloat16",
-                 attn_impl="pallas", scan_layers=True, remat=True),
+            "llama", dict(block_size=4096, **llama_shape),
+            batch=1, steps=steps,
+        )
+
+    if which in ("all", "llama8b-longT"):
+        # Llama-3's NATIVE 8192 context: exercises the blocked
+        # (grid-streamed online-softmax) attention path on chip
+        run_rung(
+            "llama3-8b-shape LONG-T blocked path (T=8192, L=2, vocab 16k)",
+            "llama", dict(block_size=8192, **llama_shape),
             batch=1, steps=steps,
         )
 
