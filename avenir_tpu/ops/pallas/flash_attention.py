@@ -23,9 +23,12 @@ largest in-repo kernel, SURVEY.md §7 "hard parts"):
     block × full KV) tile computes s/p/dp/ds once, emits dq per q block
     and accumulates dk/dv in fp32 VMEM scratch flushed on the last q step
     (no atomics; measured ~9ms/step FASTER than the split dq/dkv pair at
-    GPT-2 shapes — BASELINE.md). Blocked path (long T): two kernels, dq gridded
-    (B, H, nq, nk), dk/dv gridded (B, H, nk, nq), each recomputing p from
-    the saved logsumexp
+    GPT-2 shapes — BASELINE.md). Softmax stats (m, l) and delta are
+    recomputed/derived in-kernel, so no (T, 1) side arrays ever hit HBM
+    (they are tile-padded 128× there; A/B-measured +1.2%). Blocked path
+    (long T): two kernels, dq gridded (B, H, nq, nk), dk/dv gridded
+    (B, H, nk, nq), each recomputing p from the saved logsumexp (which
+    the blocked fwd still emits)
   - padding: sequences are padded to the block size; padded kv columns are
     masked with -1e30 (finite, so fully-padded q rows stay NaN-free and
     are sliced away by the wrapper)
@@ -101,7 +104,7 @@ def _compiler_params(n_parallel):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel_fast(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
+def _fwd_kernel_fast(q_ref, k_ref, v_ref, o_ref, *, block_q,
                      causal, sm_scale, seq_len):
     i = pl.program_id(1)
     nq = pl.num_programs(1)
@@ -125,7 +128,6 @@ def _fwd_kernel_fast(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
             preferred_element_type=jnp.float32,
         )
         o_ref[0] = (o / l).astype(o_ref.dtype)
-        lse_ref[0] = m + jnp.log(l)
 
     # causal halving: q blocks in the first half of the sequence only see
     # the first half of KV — a static-slice branch, so the MXU/VPU work for
@@ -137,7 +139,7 @@ def _fwd_kernel_fast(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
         _attend(tp)
 
 
-def _dqkv_kernel_fast(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dqkv_kernel_fast(q_ref, k_ref, v_ref, o_ref, do_ref,
                       dq_ref, dk_ref, dv_ref, dk_acc, dv_acc,
                       *, block_q, causal, sm_scale, seq_len):
     """Fused single-pass backward for the fast path: one (q block × full
@@ -145,7 +147,11 @@ def _dqkv_kernel_fast(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk/dv (accumulated in fp32 VMEM scratch across the q grid dim,
     flushed on the last step). The split dq/dkv pair recomputed s and dp
     in each kernel — fusing saves ~2 of 7 matmuls and one exp pass per
-    tile, and halves the kernel dispatches and input DMA traffic."""
+    tile, and halves the kernel dispatches and input DMA traffic.
+    The softmax statistics (m, l) are RECOMPUTED from the in-VMEM score
+    block and delta = rowsum(do·o) from the o block — neither lse nor
+    delta ever touches HBM (a (T, 1) fp32 side array is tile-padded 128x
+    there: real write/read bandwidth, ~6ms/step at GPT-2 shapes)."""
     i = pl.program_id(1)
     nq = pl.num_programs(1)
     tp = k_ref.shape[1]
@@ -156,8 +162,10 @@ def _dqkv_kernel_fast(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     q = q_ref[0]
-    lse = lse_ref[0]  # (BQ, 1)
-    delta = delta_ref[0]
+    delta = jnp.sum(
+        do_ref[0].astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )  # (BQ, 1)
 
     def _grad(kv_len):
         k = k_ref[0, :kv_len, :]
@@ -167,7 +175,10 @@ def _dqkv_kernel_fast(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         ) * sm_scale  # (BQ, kv_len)
         s = _mask_scores(s, i * block_q, 0, causal, seq_len)
-        p = jnp.exp(s - lse)
+        # same math as the forward softmax: p == exp(s - lse)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
         dob = do_ref[0].astype(v.dtype)
         dp = jax.lax.dot_general(
             dob, v, (((1,), (1,)), ((), ())),
@@ -203,7 +214,7 @@ def _make_fwd_fast(seq_len):
     def fwd(q, k, v, causal, sm_scale, block_q, interpret):
         BH, Tp, D = q.shape
         nq = Tp // block_q
-        o, lse = pl.pallas_call(
+        o = pl.pallas_call(
             functools.partial(
                 _fwd_kernel_fast, block_q=block_q, causal=causal,
                 sm_scale=sm_scale, seq_len=seq_len,
@@ -214,31 +225,21 @@ def _make_fwd_fast(seq_len):
                 pl.BlockSpec((1, Tp, D), lambda g, i: (g, 0, 0)),
                 pl.BlockSpec((1, Tp, D), lambda g, i: (g, 0, 0)),
             ],
-            out_specs=[
-                pl.BlockSpec((1, block_q, D), lambda g, i: (g, i, 0)),
-                pl.BlockSpec((1, block_q, 1), lambda g, i: (g, i, 0)),
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct((BH, Tp, D), q.dtype),
-                jax.ShapeDtypeStruct((BH, Tp, 1), jnp.float32),
-            ],
+            out_specs=pl.BlockSpec((1, block_q, D), lambda g, i: (g, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((BH, Tp, D), q.dtype),
             compiler_params=_compiler_params(1),
             interpret=interpret,
         )(q, k, v)
-        return o, lse
+        return o
 
     return fwd
 
 
 def _make_bwd_fast(seq_len):
-    def bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
+    def bwd(q, k, v, o, do, causal, sm_scale, block_q, block_k,
             interpret):
         BH, Tp, D = q.shape
         nq = Tp // block_q
-        delta = jnp.sum(
-            do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
-            keepdims=True,
-        )  # (BH, Tp, 1)
 
         dq, dk, dv = pl.pallas_call(
             functools.partial(
@@ -251,8 +252,7 @@ def _make_bwd_fast(seq_len):
                 pl.BlockSpec((1, Tp, D), lambda g, i: (g, 0, 0)),
                 pl.BlockSpec((1, Tp, D), lambda g, i: (g, 0, 0)),
                 pl.BlockSpec((1, block_q, D), lambda g, i: (g, i, 0)),
-                pl.BlockSpec((1, block_q, 1), lambda g, i: (g, i, 0)),
-                pl.BlockSpec((1, block_q, 1), lambda g, i: (g, i, 0)),
+                pl.BlockSpec((1, block_q, D), lambda g, i: (g, i, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_q, D), lambda g, i: (g, i, 0)),
@@ -270,7 +270,7 @@ def _make_bwd_fast(seq_len):
             ],
             compiler_params=_compiler_params(1),
             interpret=interpret,
-        )(q, k, v, do, lse, delta)
+        )(q, k, v, o, do)
         return dq, dk, dv
 
     return bwd
@@ -544,16 +544,15 @@ def _build_flash_fast(seq_len, causal, sm_scale, block_q, block_k,
 
     @jax.custom_vjp
     def f(q, k, v):
-        o, _ = fwd_impl(q, k, v, causal, sm_scale, block_q, interpret)
-        return o
+        return fwd_impl(q, k, v, causal, sm_scale, block_q, interpret)
 
     def f_fwd(q, k, v):
-        o, lse = fwd_impl(q, k, v, causal, sm_scale, block_q, interpret)
-        return o, (q, k, v, o, lse)
+        o = fwd_impl(q, k, v, causal, sm_scale, block_q, interpret)
+        return o, (q, k, v, o)
 
     def f_bwd(res, do):
-        q, k, v, o, lse = res
-        return bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q,
+        q, k, v, o = res
+        return bwd_impl(q, k, v, o, do, causal, sm_scale, block_q,
                         block_k, interpret)
 
     f.defvjp(f_fwd, f_bwd)
