@@ -8,7 +8,7 @@ import pytest
 
 import jax
 from flax import nnx
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from avenir_tpu.parallel.mesh import AXES, make_mesh, parse_mesh_shape
 from avenir_tpu.parallel.partition import (
@@ -90,7 +90,11 @@ def test_constrain_noop_without_mesh_live_with_mesh():
     # swallowed (VERDICT r1 weak item 4)
     mesh = make_mesh("data:2")
     with jax.set_mesh(mesh):  # jax.set_mesh is a context manager too
-        y = jax.jit(lambda a: constrain(a, P("data", None)))(x)
-        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
-        with pytest.raises(Exception):
-            jax.jit(lambda a: constrain(a, P("nonexistent_axis", None)))(x)
+        # place the input on the mesh (an array committed to one device
+        # before the context would fail jit's device-compatibility check)
+        xs = jax.device_put(np.ones((8, 4), np.float32),
+                            NamedSharding(mesh, P()))
+        y = jax.jit(lambda a: constrain(a, P("data", None)))(xs)
+        np.testing.assert_array_equal(np.asarray(y), np.ones((8, 4)))
+        with pytest.raises(Exception, match="nonexistent_axis"):
+            jax.jit(lambda a: constrain(a, P("nonexistent_axis", None)))(xs)
