@@ -183,6 +183,13 @@ def run_training(cfg):
         hf_init = hf_sd_to_torch_layout(_load_hf_numpy_sd(cfg["init_from"]))
         model_args.update(HF_CONFIGS[cfg["init_from"]])
         model_args.update(vocab_size=50257, block_size=1024, bias=True)
+        if cfg["block_size"] < 1024:
+            # crop the position table like the torch path's
+            # crop_block_size (train.py:203-205 / model.py:199-207)
+            hf_init["transformer.wpe.weight"] = (
+                hf_init["transformer.wpe.weight"][:cfg["block_size"]]
+            )
+            model_args["block_size"] = cfg["block_size"]
         if master:
             print(f"initializing from HF weights: {cfg['init_from']}")
     else:

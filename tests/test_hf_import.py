@@ -94,3 +94,64 @@ def test_gpt2_from_hf_reaches_weight_load_or_skips():
     except RuntimeError as e:
         assert "local HF cache" in str(e)
         pytest.skip("HF cache not populated (expected in sandbox)")
+
+
+def test_train_loop_init_from_gpt2(char_dataset, tmp_path, monkeypatch):
+    """run_training(init_from=gpt2*) must load HF weights through the
+    bridge and then train (the loop branch, not just sample.py). Uses a
+    monkeypatched tiny 'gpt2' so no HF cache is needed."""
+    import avenir_tpu.tools.hf_import as hfi
+    from tests.test_train_tpu import make_cfg
+
+    from avenir_tpu.train.loop import run_training
+
+    tiny = dict(n_layer=2, n_head=2, n_embd=16)
+    monkeypatch.setitem(hfi.HF_CONFIGS, "gpt2", tiny)
+
+    cfg_t = torch_model.GPTConfig(block_size=1024, vocab_size=50257,
+                                  dropout=0.0, bias=True, **tiny)
+    tm = torch_model.GPT(cfg_t)
+    fake_sd = _fake_hf_sd(tm)
+    monkeypatch.setattr(hfi, "_load_hf_numpy_sd", lambda name: fake_sd)
+
+    # block_size=1024 (== the HF table): exercises the NO-crop branch;
+    # the crop branch is pinned by the next test
+    cfg = make_cfg(char_dataset["dir"], tmp_path / "out", max_iters=3,
+                   init_from="gpt2", block_size=1024, batch_size=2,
+                   gradient_accumulation_steps=1, mesh_shape="data:1",
+                   eval_iters=1, eval_interval=50, **tiny)
+    res = run_training(cfg)
+    assert res["iter_num"] >= 3
+    assert res["loss_history"], "no losses logged"
+
+
+def test_train_loop_gpt2_init_crops_block_size(char_dataset, tmp_path,
+                                               monkeypatch):
+    """cfg block_size < the HF 1024 must crop the position table (parity
+    with the torch path's crop_block_size)."""
+    import avenir_tpu.tools.hf_import as hfi
+    from tests.test_train_tpu import make_cfg
+
+    from avenir_tpu.train import loop as loop_mod
+
+    tiny = dict(n_layer=1, n_head=2, n_embd=16)
+    monkeypatch.setitem(hfi.HF_CONFIGS, "gpt2", tiny)
+    cfg_t = torch_model.GPTConfig(block_size=1024, vocab_size=50257,
+                                  dropout=0.0, bias=True, **tiny)
+    fake_sd = _fake_hf_sd(torch_model.GPT(cfg_t))
+    monkeypatch.setattr(hfi, "_load_hf_numpy_sd", lambda name: fake_sd)
+
+    seen = {}
+    orig = loop_mod.setup_state
+
+    def spy(cfg, mesh, model_args, **kw):
+        seen.update(model_args)
+        return orig(cfg, mesh, model_args, **kw)
+
+    monkeypatch.setattr(loop_mod, "setup_state", spy)
+    cfg = make_cfg(char_dataset["dir"], tmp_path / "out", max_iters=2,
+                   init_from="gpt2", block_size=32, mesh_shape="data:1",
+                   eval_iters=1, eval_interval=50, **tiny)
+    res = loop_mod.run_training(cfg)
+    assert seen["block_size"] == 32
+    assert res["iter_num"] >= 2
