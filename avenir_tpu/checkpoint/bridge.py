@@ -130,6 +130,20 @@ def _scan_seg_index(path):
     return None
 
 
+def _fill_stack(arrs):
+    """Stack lazy slices incrementally: preallocate the (L, ...) result and
+    materialize one slice at a time, so host peak on a scanned/expert-stack
+    restore is the stacked container + ONE slice — not the container plus
+    every slice at once (ADVICE r2: the np.stack-of-list form held all L)."""
+    first = np.asarray(arrs[0])
+    out = np.empty((len(arrs),) + first.shape, first.dtype)
+    out[0] = first
+    del first
+    for j in range(1, len(arrs)):
+        out[j] = np.asarray(arrs[j])
+    return out
+
+
 def unstack_scanned_paths(flat):
     """{nnx path: array} → same dict with every `<base>_scan` entry split
     into per-layer `(<base>, l, ...)` entries along its leading axis.
@@ -177,7 +191,7 @@ def restack_scanned_paths(flat, target_paths):
             first = layers[0]
             out[tp] = LazyArray(
                 (len(layers),) + tuple(first.shape), first.dtype,
-                lambda ls=layers: np.stack([np.asarray(a) for a in ls]),
+                lambda ls=layers: _fill_stack(ls),
             )
         else:
             out[tp] = np.stack([np.asarray(a) for a in layers])
@@ -210,7 +224,7 @@ def _stack_expert_keys(sd):
             first = arrs[0]
             stacked[path] = LazyArray(
                 (len(arrs),) + tuple(first.shape), first.dtype,
-                lambda ls=arrs: np.stack([np.asarray(a) for a in ls]),
+                lambda ls=arrs: _fill_stack(ls),
             )
         else:
             stacked[path] = np.stack(arrs)

@@ -228,8 +228,10 @@ def restore_params(ckpt, abs_state, shardings, model_family="gpt"):
         assert path in flat, f"checkpoint path {path} not in model"
         var = flat[path]
         # materialize ONE tensor at a time (lazy checkpoints) and free the
-        # host copy as soon as device_put returns
-        a = np.ascontiguousarray(np.asarray(a)).astype(var.get_value().dtype)
+        # host copy as soon as device_put returns; astype(copy=False) keeps
+        # peak at one tensor when the dtype already matches
+        a = np.ascontiguousarray(np.asarray(a))
+        a = a.astype(var.get_value().dtype, copy=False)
         out[path] = var.replace(jax.device_put(a, shardings[path]))
         del a
     missing = set(flat) - set(out)

@@ -117,15 +117,18 @@ def lazy_unstack(a, n):
                       lambda i=i: np.asarray(gather(src[i])))
             for i in range(n)
         ]
-    state = {"v": None, "left": n}
+    # consumed indices tracked as a SET, not a counter: a slice that is
+    # materialized twice must not over-decrement (which would free the base
+    # early and re-materialize it per access for every later slice)
+    state = {"v": None, "consumed": set()}
 
     def make(i):
         def fn():
             if state["v"] is None:
                 state["v"] = np.asarray(a)
             out = np.ascontiguousarray(state["v"][i])
-            state["left"] -= 1
-            if state["left"] <= 0:
+            state["consumed"].add(i)
+            if len(state["consumed"]) >= n:
                 state["v"] = None
             return out
 

@@ -123,9 +123,11 @@ def _llama_layer_step(lyr, x, kc, vc, pos, q_pos, cos, sin):
 def _run_layers(model, x, cache, pos, q_pos, layer_step):
     """Apply layer_step across the model's layers, handling both the
     python-loop and the scan-stacked layouts. Returns (x, new_cache)."""
-    scanned = getattr(model, "h_scan", None) or getattr(
-        model, "layers_scan", None
-    )
+    # explicit `is None` checks: nnx.Module truthiness is not a reliable
+    # presence test (a falsy module would silently fall into the loop path)
+    scanned = getattr(model, "h_scan", None)
+    if scanned is None:
+        scanned = getattr(model, "layers_scan", None)
     if scanned is not None:
         @nnx.scan(in_axes=(nnx.Carry, 0, 0, 0), out_axes=(nnx.Carry, 0, 0))
         def body(h, layer, kc, vc):
@@ -134,7 +136,9 @@ def _run_layers(model, x, cache, pos, q_pos, layer_step):
 
         x, k_new, v_new = body(x, scanned, cache.k, cache.v)
         return x, KVCache(k_new, v_new)
-    layers = getattr(model, "h", None) or model.layers
+    layers = getattr(model, "h", None)
+    if layers is None:
+        layers = model.layers
     ks, vs = [], []
     for l, layer in enumerate(layers):
         x, kc, vc = layer_step(layer, x, cache.k[l], cache.v[l], pos, q_pos)

@@ -78,23 +78,24 @@ def resolve_attention_impl(impl, *, use_dropout=False, segment_ids=None):
 
 def causal_attention(q, k, v, *, dropout_rate=0.0, deterministic=True,
                      dropout_rng=None, impl="auto", segment_ids=None):
-    """Causal multi-head attention. q, k, v: (B, T, H, D).
+    """Causal multi-head attention. q: (B, T, H, D); k, v: (B, T, H_kv, D)
+    with H_kv | H (GQA).
 
-    K/V may have fewer heads than Q (GQA): H_kv must divide H; K/V heads are
-    repeated to match (the xla path repeats explicitly; the pallas kernel
-    indexes the shared head).
-    """
-    if q.shape[2] != k.shape[2]:
-        assert q.shape[2] % k.shape[2] == 0, (
-            f"GQA requires n_head % n_kv_head == 0, got {q.shape[2]} % {k.shape[2]}"
-        )
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    GQA head sharing is impl-specific: the pallas kernels index the shared
+    kv head in their BlockSpec index maps (K/V never repeated — no 4x
+    HBM/VMEM tax at Llama-3's 32:8); the xla and ring paths repeat
+    explicitly (XLA fuses the broadcast into the einsum)."""
+    assert q.shape[2] % k.shape[2] == 0, (
+        f"GQA requires n_head % n_kv_head == 0, got {q.shape[2]} % {k.shape[2]}"
+    )
 
     use_dropout = dropout_rate > 0.0 and not deterministic
     impl = resolve_attention_impl(impl, use_dropout=use_dropout,
                                   segment_ids=segment_ids)
+    if impl != "pallas" and q.shape[2] != k.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     if impl == "ring":
         # context parallelism: sequence sharded over the 'context' mesh
         # axis, kv rotating via ppermute (parallel/ring_attention.py)

@@ -82,13 +82,13 @@ def _mask_scores(s, q_off, k_off, causal, seq_len):
 
 
 
-def _compiler_params(n_parallel):
-    """dimension_semantics hint: all grid dims except the innermost
-    (the streamed/accumulated one) are parallel. The scoped-vmem limit is
-    raised from the 16MB default: the fast path's fp32 score block plus
+def _compiler_params(n_parallel, n_arbitrary=1):
+    """dimension_semantics hint: the leading grid dims are parallel, the
+    trailing (streamed/accumulated) ones arbitrary. The scoped-vmem limit
+    is raised from the 16MB default: the fast path's fp32 score block plus
     the fused-bwd dk/dv scratch legitimately use more at long T (v5e has
     128MB of VMEM; 64MB leaves ample headroom for double buffering)."""
-    sem = ("parallel",) * n_parallel + ("arbitrary",)
+    sem = ("parallel",) * n_parallel + ("arbitrary",) * n_arbitrary
     kw = dict(dimension_semantics=sem, vmem_limit_bytes=64 * 1024 * 1024)
     try:
         return pltpu.CompilerParams(**kw)
@@ -144,20 +144,25 @@ def _dqkv_kernel_fast(q_ref, k_ref, v_ref, o_ref, do_ref,
                       *, block_q, causal, sm_scale, seq_len):
     """Fused single-pass backward for the fast path: one (q block × full
     KV) tile computes s/p/dp/ds ONCE and emits dq (per q block) plus
-    dk/dv (accumulated in fp32 VMEM scratch across the q grid dim,
-    flushed on the last step). The split dq/dkv pair recomputed s and dp
-    in each kernel — fusing saves ~2 of 7 matmuls and one exp pass per
-    tile, and halves the kernel dispatches and input DMA traffic.
+    dk/dv (accumulated in fp32 VMEM scratch, flushed on the last step).
+    The split dq/dkv pair recomputed s and dp in each kernel — fusing
+    saves ~2 of 7 matmuls and one exp pass per tile, and halves the
+    kernel dispatches and input DMA traffic.
     The softmax statistics (m, l) are RECOMPUTED from the in-VMEM score
     block and delta = rowsum(do·o) from the o block — neither lse nor
     delta ever touches HBM (a (T, 1) fp32 side array is tile-padded 128x
     there: real write/read bandwidth; A/B-measured +1.2% ≈ 1.5ms/step at
-    GPT-2 shapes, BASELINE.md)."""
-    i = pl.program_id(1)
-    nq = pl.num_programs(1)
+    GPT-2 shapes, BASELINE.md).
+
+    Grid is (B*H_kv, G, nq), G = n_head // n_kv_head: the G q-heads
+    sharing a kv head run consecutively, so dk/dv sum over the whole
+    group in scratch before ONE flush — GQA needs no KV repetition and
+    no post-kernel reduction (MHA is the G=1 special case)."""
+    j, i = pl.program_id(1), pl.program_id(2)
+    ng, nq = pl.num_programs(1), pl.num_programs(2)
     tp = k_ref.shape[1]
 
-    @pl.when(i == 0)
+    @pl.when(jnp.logical_and(i == 0, j == 0))
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
@@ -205,13 +210,24 @@ def _dqkv_kernel_fast(q_ref, k_ref, v_ref, o_ref, do_ref,
     else:
         _grad(tp)
 
-    @pl.when(i == nq - 1)
+    @pl.when(jnp.logical_and(i == nq - 1, j == ng - 1))
     def _flush():
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _make_fwd_fast(seq_len):
+def _make_fwd_fast(seq_len, n_head, n_kv_head):
+    """Fast-path forward. GQA (n_kv_head < n_head): K/V stay at their
+    H_kv head count — each q-head grid step maps to its shared kv head in
+    the BlockSpec index fn, so repeated KV never exists in HBM or VMEM
+    (VERDICT r2 item 2: the old jnp.repeat cost 4x KV traffic at
+    Llama-3's 32:8)."""
+    group = n_head // n_kv_head
+
+    def kv_index(g, i):
+        # flat q index g = b*H + h  →  flat kv index b*H_kv + h//group
+        return ((g // n_head) * n_kv_head + (g % n_head) // group, 0, 0)
+
     def fwd(q, k, v, causal, sm_scale, block_q, interpret):
         BH, Tp, D = q.shape
         nq = Tp // block_q
@@ -223,8 +239,8 @@ def _make_fwd_fast(seq_len):
             grid=(BH, nq),
             in_specs=[
                 pl.BlockSpec((1, block_q, D), lambda g, i: (g, i, 0)),
-                pl.BlockSpec((1, Tp, D), lambda g, i: (g, 0, 0)),
-                pl.BlockSpec((1, Tp, D), lambda g, i: (g, 0, 0)),
+                pl.BlockSpec((1, Tp, D), kv_index),
+                pl.BlockSpec((1, Tp, D), kv_index),
             ],
             out_specs=pl.BlockSpec((1, block_q, D), lambda g, i: (g, i, 0)),
             out_shape=jax.ShapeDtypeStruct((BH, Tp, D), q.dtype),
@@ -236,10 +252,24 @@ def _make_fwd_fast(seq_len):
     return fwd
 
 
-def _make_bwd_fast(seq_len):
+def _make_bwd_fast(seq_len, n_head, n_kv_head):
+    """Fused fast-path backward, grid (B*H_kv, G, nq). For GQA the dk/dv
+    of a kv head accumulate across its G query heads in VMEM scratch (the
+    G dim is 'arbitrary', so the revisited output block stays resident)."""
+    group = n_head // n_kv_head
+
+    def q_index(g, j, i):
+        # kv-flat g = b*H_kv + kvh → q-flat b*H + kvh*group + j
+        b, kvh = g // n_kv_head, g % n_kv_head
+        return (b * n_head + kvh * group + j, i, 0)
+
+    def kv_index(g, j, i):
+        return (g, 0, 0)
+
     def bwd(q, k, v, o, do, causal, sm_scale, block_q, block_k,
             interpret):
         BH, Tp, D = q.shape
+        BHkv = k.shape[0]
         nq = Tp // block_q
 
         dq, dk, dv = pl.pallas_call(
@@ -247,29 +277,29 @@ def _make_bwd_fast(seq_len):
                 _dqkv_kernel_fast, block_q=block_q, causal=causal,
                 sm_scale=sm_scale, seq_len=seq_len,
             ),
-            grid=(BH, nq),
+            grid=(BHkv, group, nq),
             in_specs=[
-                pl.BlockSpec((1, block_q, D), lambda g, i: (g, i, 0)),
-                pl.BlockSpec((1, Tp, D), lambda g, i: (g, 0, 0)),
-                pl.BlockSpec((1, Tp, D), lambda g, i: (g, 0, 0)),
-                pl.BlockSpec((1, block_q, D), lambda g, i: (g, i, 0)),
-                pl.BlockSpec((1, block_q, D), lambda g, i: (g, i, 0)),
+                pl.BlockSpec((1, block_q, D), q_index),
+                pl.BlockSpec((1, Tp, D), kv_index),
+                pl.BlockSpec((1, Tp, D), kv_index),
+                pl.BlockSpec((1, block_q, D), q_index),
+                pl.BlockSpec((1, block_q, D), q_index),
             ],
             out_specs=[
-                pl.BlockSpec((1, block_q, D), lambda g, i: (g, i, 0)),
-                pl.BlockSpec((1, Tp, D), lambda g, i: (g, 0, 0)),
-                pl.BlockSpec((1, Tp, D), lambda g, i: (g, 0, 0)),
+                pl.BlockSpec((1, block_q, D), q_index),
+                pl.BlockSpec((1, Tp, D), kv_index),
+                pl.BlockSpec((1, Tp, D), kv_index),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((BH, Tp, D), q.dtype),
-                jax.ShapeDtypeStruct((BH, Tp, D), k.dtype),
-                jax.ShapeDtypeStruct((BH, Tp, D), v.dtype),
+                jax.ShapeDtypeStruct((BHkv, Tp, D), k.dtype),
+                jax.ShapeDtypeStruct((BHkv, Tp, D), v.dtype),
             ],
             scratch_shapes=[
                 pltpu.VMEM((Tp, D), jnp.float32),
                 pltpu.VMEM((Tp, D), jnp.float32),
             ],
-            compiler_params=_compiler_params(1),
+            compiler_params=_compiler_params(1, 2),
             interpret=interpret,
         )(q, k, v, o, do)
         return dq, dk, dv
@@ -376,10 +406,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, block_q, block_k,
                 causal, sm_scale, seq_len):
-    j, i = pl.program_id(2), pl.program_id(3)  # kv outer, q inner
-    nq = pl.num_programs(3)
+    # grid (B, H_kv, nk, G, nq): kv block outer, then the G query heads
+    # sharing this kv head, then q blocks — dk/dv accumulate over (G, nq)
+    j, jj, i = pl.program_id(2), pl.program_id(3), pl.program_id(4)
+    ng, nq = pl.num_programs(3), pl.num_programs(4)
 
-    @pl.when(i == 0)
+    @pl.when(jnp.logical_and(jj == 0, i == 0))
     def _init():
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
@@ -417,7 +449,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         _step()
 
-    @pl.when(i == nq - 1)
+    @pl.when(jnp.logical_and(jj == ng - 1, i == nq - 1))
     def _finish():
         dk_ref[0, 0] = dk_acc_ref[...].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc_ref[...].astype(dv_ref.dtype)
@@ -432,7 +464,9 @@ def _pad_to(x, t_target, axis=2):
     return jnp.pad(x, widths)
 
 
-def _make_fwd(seq_len):
+def _make_fwd(seq_len, n_head, n_kv_head):
+    group = n_head // n_kv_head
+
     def fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         B, H, Tp, D = q.shape
         nq, nk = Tp // block_q, Tp // block_k
@@ -445,8 +479,10 @@ def _make_fwd(seq_len):
             grid=(B, H, nq, nk),
             in_specs=[
                 pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
-                pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D),
+                             lambda b, h, i, j: (b, h // group, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D),
+                             lambda b, h, i, j: (b, h // group, j, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
@@ -469,10 +505,13 @@ def _make_fwd(seq_len):
     return fwd
 
 
-def _make_bwd(seq_len):
+def _make_bwd(seq_len, n_head, n_kv_head):
+    group = n_head // n_kv_head
+
     def bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
             interpret):
         B, H, Tp, D = q.shape
+        H_kv = k.shape[1]
         nq, nk = Tp // block_q, Tp // block_k
         delta = jnp.sum(
             do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
@@ -487,8 +526,10 @@ def _make_bwd(seq_len):
             grid=(B, H, nq, nk),
             in_specs=[
                 pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
-                pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D),
+                             lambda b, h, i, j: (b, h // group, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D),
+                             lambda b, h, i, j: (b, h // group, j, 0)),
                 pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
                 pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
                 pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
@@ -502,33 +543,37 @@ def _make_bwd(seq_len):
             interpret=interpret,
         )(q, k, v, do, lse, delta)
 
+        # grid (B, H_kv, nk, G, nq): dk/dv of one kv block accumulate over
+        # the G sharing query heads AND the q blocks before one flush
+        qh = lambda b, g, j, jj, i: (b, g * group + jj, i, 0)
+        kvh = lambda b, g, j, jj, i: (b, g, j, 0)
         dk, dv = pl.pallas_call(
             functools.partial(
                 _dkv_kernel, block_q=block_q, block_k=block_k, causal=causal,
                 sm_scale=sm_scale, seq_len=seq_len,
             ),
-            grid=(B, H, nk, nq),
+            grid=(B, H_kv, nk, group, nq),
             in_specs=[
-                pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
-                pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
-                pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, D), qh),
+                pl.BlockSpec((1, 1, block_k, D), kvh),
+                pl.BlockSpec((1, 1, block_k, D), kvh),
+                pl.BlockSpec((1, 1, block_q, D), qh),
+                pl.BlockSpec((1, 1, block_q, 1), qh),
+                pl.BlockSpec((1, 1, block_q, 1), qh),
             ],
             out_specs=[
-                pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
-                pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D), kvh),
+                pl.BlockSpec((1, 1, block_k, D), kvh),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((B, H, Tp, D), k.dtype),
-                jax.ShapeDtypeStruct((B, H, Tp, D), v.dtype),
+                jax.ShapeDtypeStruct((B, H_kv, Tp, D), k.dtype),
+                jax.ShapeDtypeStruct((B, H_kv, Tp, D), v.dtype),
             ],
             scratch_shapes=[
                 pltpu.VMEM((block_k, D), jnp.float32),
                 pltpu.VMEM((block_k, D), jnp.float32),
             ],
-            compiler_params=_compiler_params(3),
+            compiler_params=_compiler_params(2, 3),
             interpret=interpret,
         )(q, k, v, do, lse, delta)
         return dq, dk, dv
@@ -538,10 +583,11 @@ def _make_bwd(seq_len):
 
 @functools.lru_cache(maxsize=64)
 def _build_flash_fast(seq_len, causal, sm_scale, block_q, block_k,
-                      interpret):
-    """Fast-path custom_vjp, operating on a (B*H, Tp, D) view."""
-    fwd_impl = _make_fwd_fast(seq_len)
-    bwd_impl = _make_bwd_fast(seq_len)
+                      interpret, n_head=1, n_kv_head=1):
+    """Fast-path custom_vjp: q on a (B*H, Tp, D) view, k/v on
+    (B*H_kv, Tp, D) (GQA heads shared via index maps, never repeated)."""
+    fwd_impl = _make_fwd_fast(seq_len, n_head, n_kv_head)
+    bwd_impl = _make_bwd_fast(seq_len, n_head, n_kv_head)
 
     @jax.custom_vjp
     def f(q, k, v):
@@ -561,10 +607,11 @@ def _build_flash_fast(seq_len, causal, sm_scale, block_q, block_k,
 
 
 @functools.lru_cache(maxsize=64)
-def _build_flash(seq_len, causal, sm_scale, block_q, block_k, interpret):
+def _build_flash(seq_len, causal, sm_scale, block_q, block_k, interpret,
+                 n_head=1, n_kv_head=1):
     """One custom_vjp per static config (lru so jit retrace reuses it)."""
-    fwd_impl = _make_fwd(seq_len)
-    bwd_impl = _make_bwd(seq_len)
+    fwd_impl = _make_fwd(seq_len, n_head, n_kv_head)
+    bwd_impl = _make_bwd(seq_len, n_head, n_kv_head)
 
     @jax.custom_vjp
     def f(q, k, v):
@@ -588,8 +635,13 @@ def _build_flash(seq_len, causal, sm_scale, block_q, block_k, interpret):
 
 def flash_attention(q, k, v, *, causal=True, sm_scale=None, block_q=512,
                     block_k=1024, interpret=False):
-    """Flash attention, public layout (B, T, H, D). K/V must already be
-    repeated to Q's head count (ops.attention handles GQA).
+    """Flash attention, public layout q (B, T, H, D); k/v (B, T, H_kv, D)
+    with H_kv | H. GQA is handled INSIDE the kernels: each q-head grid
+    step maps to its shared kv head via the BlockSpec index fn (h //
+    (H/H_kv)), and the fused backward sums a kv head's dk/dv over its
+    query group in VMEM scratch — K/V are never repeated, so HBM traffic
+    and VMEM footprint stay at the H_kv size (4x smaller at Llama-3's
+    32:8; VERDICT r2 item 2).
 
     Sequences with padded length <= _FAST_PATH_MAX_T dispatch to the
     single-KV-block kernels; longer ones stream KV blocks through the grid
@@ -598,6 +650,8 @@ def flash_attention(q, k, v, *, causal=True, sm_scale=None, block_q=512,
     the padded sequence.
     """
     B, T, H, D = q.shape
+    H_kv = k.shape[2]
+    assert H % H_kv == 0, f"n_head {H} not divisible by n_kv_head {H_kv}"
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
     # Clamp oversized blocks to the next power of two >= T (never to the raw
@@ -619,12 +673,12 @@ def flash_attention(q, k, v, *, causal=True, sm_scale=None, block_q=512,
     vt = _pad_to(v.transpose(0, 2, 1, 3), Tp)
     if Tp <= _FAST_PATH_MAX_T:
         f = _build_flash_fast(T, causal, float(sm_scale), block_q, block_k,
-                              interpret)
-        o = f(qt.reshape(B * H, Tp, D), kt.reshape(B * H, Tp, D),
-              vt.reshape(B * H, Tp, D))
+                              interpret, H, H_kv)
+        o = f(qt.reshape(B * H, Tp, D), kt.reshape(B * H_kv, Tp, D),
+              vt.reshape(B * H_kv, Tp, D))
         o = o.reshape(B, H, Tp, D)
     else:
         f = _build_flash(T, causal, float(sm_scale), block_q, block_k,
-                         interpret)
+                         interpret, H, H_kv)
         o = f(qt, kt, vt)
     return o[:, :, :T, :].transpose(0, 2, 1, 3)

@@ -102,19 +102,74 @@ def parse_mesh_shape(spec: str, n_devices: int) -> dict:
     return sizes
 
 
-def make_mesh(spec: str = "", devices=None) -> Mesh:
+def _parse_dcn_sizes(spec: str) -> dict:
+    """Parse a DCN factor spec ("data:2") → {axis: factor, ...rest 1}."""
+    sizes = {a: 1 for a in AXES}
+    if not spec:
+        return sizes
+    for part in spec.split(","):
+        name, _, val = part.strip().partition(":")
+        if name not in AXES:
+            raise ValueError(f"unknown mesh axis {name!r}; valid: {AXES}")
+        v = int(val)
+        assert v >= 1, f"dcn factor for {name} must be >= 1"
+        sizes[name] = v
+    return sizes
+
+
+def make_mesh(spec: str = "", devices=None, dcn_spec: str = "") -> Mesh:
     """Build the global mesh. Axis order is AXES; the physical device
-    assignment is topology-aware on TPU (ICI-contiguous subcubes)."""
+    assignment is topology-aware on TPU (ICI-contiguous subcubes).
+
+    Multi-slice (SURVEY.md §5 "Distributed communication backend"): DCN is
+    an OUTER factor of a mesh axis. `dcn_spec` names the per-axis slice
+    counts (normally "data:<n_slices>" — gradient psum is the only
+    collective cheap enough for DCN bandwidth); `spec` stays the per-slice
+    ICI shape. Combined axis size = dcn_factor × ici_size, with the DCN
+    factor OUTERMOST in the device order, so any collective over a
+    combined axis decomposes into a cross-slice phase over groups that are
+    each ICI-contiguous. On real multi-slice metadata (devices carry
+    slice_index) the assignment comes from
+    `mesh_utils.create_hybrid_device_mesh`; elsewhere (CPU harness,
+    single slice) the same slice-major ordering is emulated so tests
+    exercise the identical layout."""
     devices = jax.devices() if devices is None else devices
-    sizes = parse_mesh_shape(spec, len(devices))
-    shape = tuple(sizes[a] for a in AXES)
-    n_used = int(np.prod(shape))
-    devices = list(devices)[:n_used]
-    try:
-        dev_array = mesh_utils.create_device_mesh(
-            shape, devices=np.asarray(devices)
+    if not dcn_spec:
+        sizes = parse_mesh_shape(spec, len(devices))
+        shape = tuple(sizes[a] for a in AXES)
+        n_used = int(np.prod(shape))
+        devices = list(devices)[:n_used]
+        try:
+            dev_array = mesh_utils.create_device_mesh(
+                shape, devices=np.asarray(devices)
+            )
+        except (ValueError, AssertionError, NotImplementedError):
+            # non-TPU platforms / odd shapes: plain row-major assignment
+            dev_array = np.asarray(devices).reshape(shape)
+        return Mesh(dev_array, AXES)
+
+    dcn = _parse_dcn_sizes(dcn_spec)
+    n_slices = int(np.prod(list(dcn.values())))
+    assert len(devices) % n_slices == 0, (
+        f"{len(devices)} devices not divisible into {n_slices} slices"
+    )
+    ici = parse_mesh_shape(spec, len(devices) // n_slices)
+    ici_shape = tuple(ici[a] for a in AXES)
+    dcn_shape = tuple(dcn[a] for a in AXES)
+    n_total = int(np.prod(ici_shape)) * n_slices
+    devices = list(devices)[:n_total]
+    slice_idx = {getattr(d, "slice_index", None) for d in devices}
+    if len(slice_idx) == n_slices and None not in slice_idx:
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=np.asarray(devices)
         )
-    except (ValueError, AssertionError, NotImplementedError):
-        # non-TPU platforms / odd shapes: plain row-major assignment
-        dev_array = np.asarray(devices).reshape(shape)
+    else:
+        # emulate multi-slice: contiguous device groups play the slices.
+        # reshape (dcn..., ici...) then interleave (dcn_a, ici_a) per axis →
+        # dcn factor outermost on every combined axis.
+        arr = np.asarray(devices).reshape(dcn_shape + ici_shape)
+        k = len(AXES)
+        arr = arr.transpose(*(x for i in range(k) for x in (i, k + i)))
+        arr = arr.reshape(tuple(d * s for d, s in zip(dcn_shape, ici_shape)))
+        dev_array = arr
     return Mesh(dev_array, AXES)

@@ -99,26 +99,53 @@ def match_partition_rules(rules, paths):
     return out
 
 
-def sanitize_specs(spec_by_path, shapes, mesh):
+def sanitize_specs(spec_by_path, shapes, mesh, *, strict=False, log=None):
     """Drop mesh axes from any spec dimension they don't divide evenly
     (e.g. an unpadded char-level vocab of 25 on tensor:2). GSPMD would
     otherwise refuse the layout; replication of that one dim is the honest
-    fallback. Real configs avoid this by padding (vocab 50304)."""
+    fallback. Real configs avoid this by padding (vocab 50304).
+
+    Replicating a dimension silently would contradict the fail-loud
+    philosophy `match_partition_rules` enforces (a replicated 1.5B wte is
+    real HBM and real all-gather traffic with no visible cause), so every
+    drop is reported: with `strict=True` (the training loop's default
+    unless the config sets `allow_unsharded_fallback=True`) a drop raises;
+    otherwise each (param, axis, dim) is announced via `log` (defaults to
+    print — call sites pass a coordinator-only logger on pods)."""
     import numpy as np
 
     out = {}
+    dropped = []
     for p, spec in spec_by_path.items():
         dims = shapes[p]
         entries = tuple(spec) + (None,) * (len(dims) - len(spec))
         new = []
-        for d, ax in zip(dims, entries):
+        for i, (d, ax) in enumerate(zip(dims, entries)):
             if ax is None:
                 new.append(None)
                 continue
             axes = ax if isinstance(ax, tuple) else (ax,)
             size = int(np.prod([mesh.shape[a] for a in axes]))
-            new.append(ax if d % size == 0 else None)
+            if d % size == 0:
+                new.append(ax)
+            else:
+                new.append(None)
+                dropped.append((path_str(p) if not isinstance(p, str) else p,
+                                i, ax, d, size))
         out[p] = P(*new)
+    if dropped:
+        lines = [
+            f"  {name}: dim {i} (size {d}) not divisible by {ax}={size}; "
+            "replicating"
+            for name, i, ax, d, size in dropped
+        ]
+        msg = "sanitize_specs dropped sharding axes:\n" + "\n".join(lines)
+        if strict:
+            raise ValueError(
+                msg + "\nPad the dimension, change the rule, or set "
+                "allow_unsharded_fallback=True to accept replication."
+            )
+        (log or print)(msg)
     return out
 
 

@@ -1,0 +1,18 @@
+"""Platform selection shared by the CLI entrypoints.
+
+The axon sandbox's sitecustomize imports jax and pins the tunneled TPU
+platform BEFORE an entrypoint's environment is consulted, so setting
+JAX_PLATFORMS=cpu in the env alone is not enough — the live jax config
+must be updated too. Every entrypoint that may run on the tunneled host
+(train.py, sample.py, bench.py) calls this before its first jax op."""
+
+import os
+
+
+def honor_jax_platforms_env():
+    """If the environment explicitly requests CPU, pin it through the live
+    jax config as well. No-op otherwise (the real chip stays default)."""
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

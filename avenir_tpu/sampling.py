@@ -2,7 +2,10 @@
 
 Loads a ckpt.pt (written by EITHER backend — the container is shared,
 §3.4) and generates with temperature + top-k, mirroring sample_cuda's
-behavior (sample.py:53-78)."""
+behavior (sample.py:53-78). Family-aware: the checkpoint's
+`model_family` field (checkpoint/io.py save path) selects GPT, Llama or
+Mixtral; all three decode through the same KV-cache path
+(infer/decode.py)."""
 
 import jax
 import jax.numpy as jnp
@@ -13,18 +16,45 @@ from avenir_tpu.checkpoint.io import _strip_compile_prefix, load_checkpoint
 from avenir_tpu.models.gpt import GPT, GPTConfig
 
 
+def model_from_checkpoint(ckpt, *, seed=0):
+    """Build the right model family from a loaded checkpoint dict and load
+    its weights. Returns (model, family)."""
+    family = str(ckpt.get("model_family", "gpt"))
+    cfg = dict(ckpt.get("config", {}))
+    margs = ckpt["model_args"]
+    if family == "gpt":
+        args = {
+            k: margs[k]
+            for k in ("n_layer", "n_head", "n_embd", "block_size", "bias",
+                      "vocab_size")
+        }
+        model = GPT(GPTConfig(**args), rngs=nnx.Rngs(seed))
+    elif family in ("llama", "mixtral"):
+        if family == "llama":
+            from avenir_tpu.models.llama import Llama, LlamaConfig
+
+            model = Llama(LlamaConfig.from_train_config(cfg, margs),
+                          rngs=nnx.Rngs(seed))
+        else:
+            from avenir_tpu.models.mixtral import Mixtral, MixtralConfig
+
+            model = Mixtral(MixtralConfig.from_train_config(cfg, margs),
+                            rngs=nnx.Rngs(seed))
+    else:
+        raise ValueError(f"unknown model_family {family!r} in checkpoint")
+    load_torch_state_dict(
+        model, _strip_compile_prefix(dict(ckpt["model"])),
+        tied_lm_head=(family == "gpt"),
+    )
+    return model, family
+
+
 def run_sampling(*, out_dir, init_from, start, num_samples, max_new_tokens,
                  temperature, top_k, seed, set_ckpt_config, load_codec):
     if init_from == "resume":
         ckpt = load_checkpoint(out_dir)
         set_ckpt_config(ckpt.get("config", {}))
-        args = {
-            k: ckpt["model_args"][k]
-            for k in ("n_layer", "n_head", "n_embd", "block_size", "bias",
-                      "vocab_size")
-        }
-        model = GPT(GPTConfig(**args), rngs=nnx.Rngs(seed))
-        load_torch_state_dict(model, _strip_compile_prefix(dict(ckpt["model"])))
+        model, _family = model_from_checkpoint(ckpt, seed=seed)
     elif init_from.startswith("gpt2"):
         from avenir_tpu.tools.hf_import import gpt2_from_hf
 

@@ -97,7 +97,13 @@ def setup_state(cfg, mesh, model_args, *, verbose=True):
     paths = [p for p, _ in abs_state.flat_state()]
     specs = match_partition_rules(rules_for_model(mt), paths)
     shapes = {p: tuple(v.get_value().shape) for p, v in abs_state.flat_state()}
-    specs = sanitize_specs(specs, shapes, mesh)
+    # fail loud on non-divisible shardings unless the config explicitly
+    # accepts replication (tiny char-vocab runs); drops print coordinator-only
+    specs = sanitize_specs(
+        specs, shapes, mesh,
+        strict=not cfg.get("allow_unsharded_fallback", False),
+        log=(print if is_coordinator() else (lambda _msg: None)),
+    )
     shardings = {p: NamedSharding(mesh, specs[p]) for p in paths}
     shard_tree = nnx.State.from_flat_path(
         {p: v.replace(shardings[p]) for p, v in abs_state.flat_state()}
@@ -122,7 +128,7 @@ def run_training(cfg):
         # re-runs the offending dispatch op-by-op and raises at the first
         # NaN-producing primitive (SURVEY.md §5 "Race/NaN detection")
         jax.config.update("jax_debug_nans", True)
-    mesh = make_mesh(cfg["mesh_shape"])
+    mesh = make_mesh(cfg["mesh_shape"], dcn_spec=cfg.get("dcn_mesh_shape", ""))
     # every batch-sharding axis counts as data parallelism (see batch_pspec)
     n_dp = mesh.shape["data"] * mesh.shape["fsdp"] * mesh.shape["expert"]
 
@@ -276,13 +282,17 @@ def run_training(cfg):
     eval_step = jax.jit(eval_step_fn)
 
     def estimate_loss(params):
+        """Mean eval loss per split. All eval_iters dispatches are enqueued
+        before any host readback (the per-batch float() of the old form
+        drained the device queue eval_iters×2 times per eval — a real stall
+        on a pod); one stacked D2H transfer fences the lot."""
         out = {}
         for split in ("train", "val"):
-            losses = np.zeros(cfg["eval_iters"])
+            losses = []
             for k in range(cfg["eval_iters"]):
                 x, y = eval_loader.get_batch(split)
-                losses[k] = float(eval_step(params, x, y))
-            out[split] = losses.mean()
+                losses.append(eval_step(params, x, y))
+            out[split] = float(jnp.mean(jnp.stack(losses)))
         return out
 
     if cfg["wandb_log"] and master:
@@ -310,94 +320,102 @@ def run_training(cfg):
     profile_started = False
     loss_history = []  # (iter, loss) at log cadence; returned for tests/tools
 
-    while True:
-        lr = float(lr_schedule(iter_num)) if cfg["decay_lr"] else cfg["learning_rate"]
+    try:
+        while True:
+            lr = float(lr_schedule(iter_num)) if cfg["decay_lr"] else cfg["learning_rate"]
 
-        # eval + checkpointing run on EVERY process: the global-batch
-        # construction and the save-time gathers are SPMD collectives, so
-        # gating them on the coordinator would deadlock a pod. Only the
-        # printing/logging is coordinator-only. All processes compute the
-        # same losses (same global arrays), so the save decision agrees.
-        if iter_num % cfg["eval_interval"] == 0:
-            with jax.profiler.TraceAnnotation("eval"):
-                losses = estimate_loss(params)
-            if master:
-                print(f"step {iter_num}: train loss {losses['train']:.4f}, "
-                      f"val loss {losses['val']:.4f}")
-            if cfg["wandb_log"] and master:
-                import wandb
+            # eval + checkpointing run on EVERY process: the global-batch
+            # construction and the save-time gathers are SPMD collectives, so
+            # gating them on the coordinator would deadlock a pod. Only the
+            # printing/logging is coordinator-only. All processes compute the
+            # same losses (same global arrays), so the save decision agrees.
+            if iter_num % cfg["eval_interval"] == 0:
+                with jax.profiler.TraceAnnotation("eval"):
+                    losses = estimate_loss(params)
+                if master:
+                    print(f"step {iter_num}: train loss {losses['train']:.4f}, "
+                          f"val loss {losses['val']:.4f}")
+                if cfg["wandb_log"] and master:
+                    import wandb
 
-                wandb.log({
-                    "iter": iter_num, "train/loss": losses["train"],
-                    "val/loss": losses["val"], "lr": lr,
-                    "mfu": running_mfu * 100,
-                })
-            if losses["val"] < best_val_loss or cfg["always_save_checkpoint"]:
-                best_val_loss = min(best_val_loss, losses["val"])
-                if iter_num > 0:
-                    if master:
-                        print(f"saving checkpoint to {cfg['out_dir']}")
-                    with jax.profiler.TraceAnnotation("checkpoint"):
-                        save_checkpoint(
-                            cfg["out_dir"], params=params, opt_state=opt_state,
-                            hyper={"lr": lr,
-                                   "betas": (cfg["beta1"], cfg["beta2"]),
-                                   "eps": 1e-8,
-                                   "weight_decay": cfg["weight_decay"]},
-                            model_args=model_args, iter_num=iter_num,
-                            best_val_loss=best_val_loss, config=cfg,
-                            model_family=st["model_type"],
-                        )
-        if iter_num == 0 and cfg["eval_only"]:
-            break
+                    wandb.log({
+                        "iter": iter_num, "train/loss": losses["train"],
+                        "val/loss": losses["val"], "lr": lr,
+                        "mfu": running_mfu * 100,
+                    })
+                if losses["val"] < best_val_loss or cfg["always_save_checkpoint"]:
+                    best_val_loss = min(best_val_loss, losses["val"])
+                    if iter_num > 0:
+                        if master:
+                            print(f"saving checkpoint to {cfg['out_dir']}")
+                        with jax.profiler.TraceAnnotation("checkpoint"):
+                            save_checkpoint(
+                                cfg["out_dir"], params=params, opt_state=opt_state,
+                                hyper={"lr": lr,
+                                       "betas": (cfg["beta1"], cfg["beta2"]),
+                                       "eps": 1e-8,
+                                       "weight_decay": cfg["weight_decay"]},
+                                model_args=model_args, iter_num=iter_num,
+                                best_val_loss=best_val_loss, config=cfg,
+                                model_family=st["model_type"],
+                            )
+            if iter_num == 0 and cfg["eval_only"]:
+                break
 
-        # profile window: iters [10, 20) traced on the coordinator only
-        # (start and stop both keyed on `profile_started`, which only the
-        # coordinator ever sets — the gating is symmetric by construction)
-        if cfg["profile"] and iter_num == 10 and master and not profile_started:
-            jax.profiler.start_trace(os.path.join(cfg["out_dir"], "profile"))
-            profile_started = True
+            # profile window: iters [10, 20) traced on the coordinator only
+            # (start and stop both keyed on `profile_started`, which only the
+            # coordinator ever sets — the gating is symmetric by construction)
+            if cfg["profile"] and iter_num == 10 and master and not profile_started:
+                jax.profiler.start_trace(os.path.join(cfg["out_dir"], "profile"))
+                profile_started = True
 
-        step_rng = jax.random.fold_in(base_rng, iter_num)
-        # StepTraceAnnotation groups device activity per train step in
-        # XProf/TensorBoard (SURVEY.md §5 "annotate phases")
-        with jax.profiler.StepTraceAnnotation("train", step_num=iter_num):
-            params, opt_state, metrics = train_step(params, opt_state,
-                                                    step_rng, x, y)
-        with jax.profiler.TraceAnnotation("host_batch"):
-            x, y = train_loader.get_batch("train")  # overlap host sampling w/ device step
+            step_rng = jax.random.fold_in(base_rng, iter_num)
+            # StepTraceAnnotation groups device activity per train step in
+            # XProf/TensorBoard (SURVEY.md §5 "annotate phases")
+            with jax.profiler.StepTraceAnnotation("train", step_num=iter_num):
+                params, opt_state, metrics = train_step(params, opt_state,
+                                                        step_rng, x, y)
+            with jax.profiler.TraceAnnotation("host_batch"):
+                x, y = train_loader.get_batch("train")  # overlap host sampling w/ device step
 
-        if cfg["profile"] and iter_num >= 20 and profile_started:
+            if cfg["profile"] and iter_num >= 20 and profile_started:
+                jax.block_until_ready(metrics["loss"])
+                jax.profiler.stop_trace()
+                profile_started = False
+
+            t1 = time.time()
+            dt = t1 - t0
+            t0 = t1
+            if iter_num % cfg["log_interval"] == 0:
+                lossf = float(metrics["loss"])  # sync point, log cadence only
+                # every process checks (loss is a global value, identical on
+                # all of them): a master-only raise would leave the other
+                # processes blocked in the next collective on a pod
+                if not np.isfinite(lossf):
+                    raise FloatingPointError(
+                        f"non-finite loss {lossf} at iter {iter_num}; rerun "
+                        "with --debug_nans=True to locate the producing op"
+                    )
+            if iter_num % cfg["log_interval"] == 0 and master:
+                loss_history.append((iter_num, lossf))
+                if local_iter_num >= 5:
+                    seqs_per_iter = cfg["batch_size"] * grad_accum_total
+                    flops_per_iter = flops_per_token * block_size * seqs_per_iter
+                    mfu = (flops_per_iter / dt) / (peak * jax.device_count())
+                    running_mfu = mfu if running_mfu == -1.0 else 0.9 * running_mfu + 0.1 * mfu
+                print(f"iter {iter_num}: loss {lossf:.4f}, time {dt * 1000:.2f}ms, "
+                      f"mfu {running_mfu * 100:.2f}%")
+            iter_num += 1
+            local_iter_num += 1
+            if iter_num > cfg["max_iters"]:
+                break
+    finally:
+        # a trace started at iter 10 must not dangle if the loop exits
+        # before the iter-20 stop (short runs, exceptions, eval_only)
+        if profile_started:
             jax.block_until_ready(metrics["loss"])
             jax.profiler.stop_trace()
             profile_started = False
-
-        t1 = time.time()
-        dt = t1 - t0
-        t0 = t1
-        if iter_num % cfg["log_interval"] == 0:
-            lossf = float(metrics["loss"])  # sync point, log cadence only
-            # every process checks (loss is a global value, identical on
-            # all of them): a master-only raise would leave the other
-            # processes blocked in the next collective on a pod
-            if not np.isfinite(lossf):
-                raise FloatingPointError(
-                    f"non-finite loss {lossf} at iter {iter_num}; rerun "
-                    "with --debug_nans=True to locate the producing op"
-                )
-        if iter_num % cfg["log_interval"] == 0 and master:
-            loss_history.append((iter_num, lossf))
-            if local_iter_num >= 5:
-                seqs_per_iter = cfg["batch_size"] * grad_accum_total
-                flops_per_iter = flops_per_token * block_size * seqs_per_iter
-                mfu = (flops_per_iter / dt) / (peak * jax.device_count())
-                running_mfu = mfu if running_mfu == -1.0 else 0.9 * running_mfu + 0.1 * mfu
-            print(f"iter {iter_num}: loss {lossf:.4f}, time {dt * 1000:.2f}ms, "
-                  f"mfu {running_mfu * 100:.2f}%")
-        iter_num += 1
-        local_iter_num += 1
-        if iter_num > cfg["max_iters"]:
-            break
 
     return {
         "iter_num": iter_num, "best_val_loss": float(best_val_loss),

