@@ -86,23 +86,39 @@ class CausalSelfAttention(nnx.Module):
 
     def __call__(self, x, *, deterministic=True, rngs=None):
         B, T, C = x.shape
-        qkv = self.c_attn(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        hd = C // self.n_head
-        q = q.reshape(B, T, self.n_head, hd)
-        k = k.reshape(B, T, self.n_head, hd)
-        v = v.reshape(B, T, self.n_head, hd)
+        H = self.n_head
+        hd = C // H
+        # Head-major projections: einsum 'btc,chd->bhtd' lands q/k/v in the
+        # flash kernels' native (B, H, T, D) layout with the transpose fused
+        # into the matmul epilogue — no standalone (B,T,H,D)<->(B,H,T,D)
+        # copies around the attention op (VERDICT r2 item 1; A/B-measured in
+        # tools/exp_layout2.py). Params stay in the c_attn/c_proj Linears so
+        # the checkpoint format is unchanged.
+        cdtype = x.dtype
+        w = self.c_attn.kernel.get_value().astype(cdtype)  # (C, 3C)
+        wq, wk, wv = (w[:, i * C:(i + 1) * C].reshape(C, H, hd)
+                      for i in range(3))
+        qkv_parts = []
+        for wi in (wq, wk, wv):
+            qkv_parts.append(jnp.einsum("btc,chd->bhtd", x, wi))
+        q, k, v = qkv_parts
+        if self.c_attn.bias is not None:
+            b = self.c_attn.bias.get_value().astype(cdtype)  # (3C,)
+            bq, bk, bv = (b[i * C:(i + 1) * C].reshape(1, H, 1, hd)
+                          for i in range(3))
+            q, k, v = q + bq, k + bk, v + bv
         use_dropout = self.dropout > 0.0 and not deterministic
         y = causal_attention(
             q, k, v,
             dropout_rate=self.dropout, deterministic=deterministic,
             dropout_rng=rngs.dropout() if use_dropout else None,
-            impl=self.attn_impl,
-        )
-        y = y.reshape(B, T, C)
-        return self.resid_dropout(
-            self.c_proj(y), deterministic=deterministic, rngs=rngs
-        )
+            impl=self.attn_impl, layout="bhtd",
+        )  # (B, H, T, hd)
+        wo = self.c_proj.kernel.get_value().astype(cdtype).reshape(H, hd, C)
+        out = jnp.einsum("bhtd,hdc->btc", y, wo)
+        if self.c_proj.bias is not None:
+            out = out + self.c_proj.bias.get_value().astype(cdtype)
+        return self.resid_dropout(out, deterministic=deterministic, rngs=rngs)
 
 
 class MLP(nnx.Module):

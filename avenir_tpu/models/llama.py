@@ -106,14 +106,23 @@ class LlamaAttention(nnx.Module):
 
     def __call__(self, x, positions=None):
         B, T, C = x.shape
-        q = self.q_proj(x).reshape(B, T, self.n_head, self.head_dim)
-        k = self.k_proj(x).reshape(B, T, self.n_kv_head, self.head_dim)
-        v = self.v_proj(x).reshape(B, T, self.n_kv_head, self.head_dim)
-        cos, sin = rope_frequencies(self.head_dim, self.max_t, self.rope_theta)
-        q = apply_rope(q, cos, sin, positions=positions)
-        k = apply_rope(k, cos, sin, positions=positions)
-        y = causal_attention(q, k, v, impl=self.attn_impl)
-        return self.o_proj(y.reshape(B, T, self.n_head * self.head_dim))
+        H, Hkv, hd = self.n_head, self.n_kv_head, self.head_dim
+        # Head-major projections (einsum fuses the transpose into the
+        # matmul epilogue — no standalone layout copies around the flash
+        # kernel; VERDICT r2 item 1, same move as gpt.py).
+        cdtype = x.dtype
+        wq = self.q_proj.kernel.get_value().astype(cdtype).reshape(C, H, hd)
+        wk = self.k_proj.kernel.get_value().astype(cdtype).reshape(C, Hkv, hd)
+        wv = self.v_proj.kernel.get_value().astype(cdtype).reshape(C, Hkv, hd)
+        q = jnp.einsum("btc,chd->bhtd", x, wq)
+        k = jnp.einsum("btc,chd->bhtd", x, wk)
+        v = jnp.einsum("btc,chd->bhtd", x, wv)
+        cos, sin = rope_frequencies(hd, self.max_t, self.rope_theta)
+        q = apply_rope(q, cos, sin, positions=positions, layout="bhtd")
+        k = apply_rope(k, cos, sin, positions=positions, layout="bhtd")
+        y = causal_attention(q, k, v, impl=self.attn_impl, layout="bhtd")
+        wo = self.o_proj.kernel.get_value().astype(cdtype).reshape(H, hd, C)
+        return jnp.einsum("bhtd,hdc->btc", y, wo)
 
 
 class LlamaMLP(nnx.Module):

@@ -39,6 +39,7 @@ by tests/test_pallas_kernels.py).
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -67,6 +68,36 @@ def _branch(pred, then_fn, else_fn):
     is the negation by construction — non-exclusive pairs unrepresentable)."""
     pl.when(pred)(then_fn)
     pl.when(jnp.logical_not(pred))(else_fn)
+
+
+# Causal staircase: the fast-path kernels see the whole (padded) KV as one
+# block, but a causal q block at row offset (i+1)*block_q never looks past
+# that row — so each q grid step statically slices KV to its own staircase
+# length and skips the dead MXU/VPU work above the diagonal. Unrolling one
+# pl.when branch per q step generalizes round-2's two-way halving (work
+# factor (n+1)/2n -> ~0.56x at n=8 vs 0.75x at n=2); branches beyond
+# _STAIRCASE_MAX_BRANCHES fall back to coarser half-granularity steps so
+# kernel code size stays bounded at long T.
+_STAIRCASE_MAX_BRANCHES = 8
+
+
+def _staircase(i, nq, block_q, tp, body):
+    """Run `body(kv_len)` with the static staircase length for q block `i`.
+    Every branch is guarded by pl.when on the *runtime* block index; lengths
+    are compile-time constants so all KV slices are static."""
+    if nq <= 1 or tp % block_q != 0:
+        body(tp)
+        return
+    n_branch = min(nq, _STAIRCASE_MAX_BRANCHES)
+    # partition the nq q-blocks into n_branch contiguous groups; a group's
+    # kv_len is the staircase length of its LAST member (safe overestimate)
+    bounds = [((g + 1) * nq + n_branch - 1) // n_branch for g in range(n_branch)]
+    for g, last_blk in enumerate(bounds):
+        lo = bounds[g - 1] if g > 0 else 0
+        kv_len = last_blk * block_q
+        pred = i < last_blk if g == 0 else jnp.logical_and(
+            i >= lo, i < last_blk)
+        pl.when(pred)(functools.partial(body, kv_len))
 
 
 def _mask_scores(s, q_off, k_off, causal, seq_len):
@@ -129,12 +160,10 @@ def _fwd_kernel_fast(q_ref, k_ref, v_ref, o_ref, *, block_q,
         )
         o_ref[0] = (o / l).astype(o_ref.dtype)
 
-    # causal halving: q blocks in the first half of the sequence only see
-    # the first half of KV — a static-slice branch, so the MXU/VPU work for
-    # those blocks is halved (pl.when picks the branch per grid step)
-    if causal and nq >= 2 and tp % 2 == 0:
-        _branch((i + 1) * block_q <= tp // 2,
-                lambda: _attend(tp // 2), lambda: _attend(tp))
+    # causal staircase: q block i only sees KV up to its own diagonal —
+    # static-slice pl.when branches per q step (see _staircase)
+    if causal:
+        _staircase(i, nq, block_q, tp, _attend)
     else:
         _attend(tp)
 
@@ -204,9 +233,8 @@ def _dqkv_kernel_fast(q_ref, k_ref, v_ref, o_ref, do_ref,
             preferred_element_type=jnp.float32,
         )
 
-    if causal and nq >= 2 and tp % 2 == 0:
-        _branch((i + 1) * block_q <= tp // 2,
-                lambda: _grad(tp // 2), lambda: _grad(tp))
+    if causal:
+        _staircase(i, nq, block_q, tp, _grad)
     else:
         _grad(tp)
 
@@ -583,11 +611,16 @@ def _make_bwd(seq_len, n_head, n_kv_head):
 
 @functools.lru_cache(maxsize=64)
 def _build_flash_fast(seq_len, causal, sm_scale, block_q, block_k,
-                      interpret, n_head=1, n_kv_head=1):
+                      interpret, n_head=1, n_kv_head=1, block_q_bwd=None):
     """Fast-path custom_vjp: q on a (B*H, Tp, D) view, k/v on
-    (B*H_kv, Tp, D) (GQA heads shared via index maps, never repeated)."""
+    (B*H_kv, Tp, D) (GQA heads shared via index maps, never repeated).
+    The fwd and fused-bwd kernels take independent q block sizes (the
+    bwd's working set per q step is ~3x the fwd's, so its sweep optimum
+    differs — BASELINE.md block table)."""
     fwd_impl = _make_fwd_fast(seq_len, n_head, n_kv_head)
     bwd_impl = _make_bwd_fast(seq_len, n_head, n_kv_head)
+    if block_q_bwd is None:
+        block_q_bwd = block_q
 
     @jax.custom_vjp
     def f(q, k, v):
@@ -599,7 +632,7 @@ def _build_flash_fast(seq_len, causal, sm_scale, block_q, block_k,
 
     def f_bwd(res, do):
         q, k, v, o = res
-        return bwd_impl(q, k, v, o, do, causal, sm_scale, block_q,
+        return bwd_impl(q, k, v, o, do, causal, sm_scale, block_q_bwd,
                         block_k, interpret)
 
     f.defvjp(f_fwd, f_bwd)
@@ -633,24 +666,55 @@ def _build_flash(seq_len, causal, sm_scale, block_q, block_k, interpret,
     return f
 
 
-def flash_attention(q, k, v, *, causal=True, sm_scale=None, block_q=512,
-                    block_k=1024, interpret=False):
-    """Flash attention, public layout q (B, T, H, D); k/v (B, T, H_kv, D)
-    with H_kv | H. GQA is handled INSIDE the kernels: each q-head grid
-    step maps to its shared kv head via the BlockSpec index fn (h //
-    (H/H_kv)), and the fused backward sums a kv head's dk/dv over its
-    query group in VMEM scratch — K/V are never repeated, so HBM traffic
-    and VMEM footprint stay at the H_kv size (4x smaller at Llama-3's
-    32:8; VERDICT r2 item 2).
+# Default (block_q, block_k, block_q_bwd); overridable via
+# AVENIR_FLASH_BLOCKS="bq,bk,bqb" for sweeps (tools/bench_sweep.py).
+# Values are the v5e real-train-step sweep winners (BASELINE.md).
+_DEFAULT_BLOCKS = tuple(
+    int(x) for x in os.environ.get("AVENIR_FLASH_BLOCKS", "512,1024,512").split(",")
+)
+assert len(_DEFAULT_BLOCKS) == 3, (
+    f"AVENIR_FLASH_BLOCKS must be 'block_q,block_k,block_q_bwd', got "
+    f"{os.environ.get('AVENIR_FLASH_BLOCKS')!r}"
+)
+
+
+def flash_attention(q, k, v, *, causal=True, sm_scale=None, block_q=None,
+                    block_k=None, block_q_bwd=None, interpret=False,
+                    layout="bthd"):
+    """Flash attention. layout='bthd' (default): q (B, T, H, D), k/v
+    (B, T, H_kv, D) — transposed to head-major around the kernels.
+    layout='bhtd': q (B, H, T, D), k/v (B, H_kv, T, D), output head-major
+    too — the kernels' native layout, no wrapper transposes (callers that
+    project directly into it skip the layout copies; VERDICT r2 item 1).
+    GQA is handled INSIDE the kernels: each q-head grid step maps to its
+    shared kv head via the BlockSpec index fn (h // (H/H_kv)), and the
+    fused backward sums a kv head's dk/dv over its query group in VMEM
+    scratch — K/V are never repeated, so HBM traffic and VMEM footprint
+    stay at the H_kv size (4x smaller at Llama-3's 32:8; VERDICT r2
+    item 2).
 
     Sequences with padded length <= _FAST_PATH_MAX_T dispatch to the
     single-KV-block kernels; longer ones stream KV blocks through the grid
     with the online-softmax carry. Default block sizes are the v5e sweep
     winner for GPT-2 shapes (BASELINE.md attention table); both clamp to
-    the padded sequence.
+    the padded sequence. `block_q_bwd` sizes the fused backward's q blocks
+    independently (fast path only; the blocked path shares block_q).
     """
-    B, T, H, D = q.shape
-    H_kv = k.shape[2]
+    if block_q_bwd is None:
+        # an explicit block_q governs the backward too (the old contract);
+        # only the all-defaults call takes the swept bwd size
+        block_q_bwd = _DEFAULT_BLOCKS[2] if block_q is None else block_q
+    if block_q is None:
+        block_q = _DEFAULT_BLOCKS[0]
+    if block_k is None:
+        block_k = _DEFAULT_BLOCKS[1]
+    assert layout in ("bthd", "bhtd"), f"unknown layout {layout!r}"
+    if layout == "bhtd":
+        B, H, T, D = q.shape
+        H_kv = k.shape[1]
+    else:
+        B, T, H, D = q.shape
+        H_kv = k.shape[2]
     assert H % H_kv == 0, f"n_head {H} not divisible by n_kv_head {H_kv}"
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
@@ -662,18 +726,23 @@ def flash_attention(q, k, v, *, causal=True, sm_scale=None, block_q=512,
     t_pow2 = 1 << max(T - 1, 1).bit_length()
     block_q = min(block_q, t_pow2)
     block_k = min(block_k, t_pow2)
-    step = math.lcm(block_q, block_k)
+    block_q_bwd = min(block_q_bwd, t_pow2)
+    step = math.lcm(block_q, block_k, block_q_bwd)
     Tp = -(-T // step) * step
-    assert Tp % block_q == 0 and Tp % block_k == 0, (
-        f"block_q={block_q}, block_k={block_k} cannot tile padded seq {Tp}"
+    assert Tp % block_q == 0 and Tp % block_k == 0 and Tp % block_q_bwd == 0, (
+        f"block_q={block_q}, block_k={block_k}, block_q_bwd={block_q_bwd} "
+        f"cannot tile padded seq {Tp}"
     )
 
-    qt = _pad_to(q.transpose(0, 2, 1, 3), Tp)
-    kt = _pad_to(k.transpose(0, 2, 1, 3), Tp)
-    vt = _pad_to(v.transpose(0, 2, 1, 3), Tp)
+    if layout == "bhtd":
+        qt, kt, vt = _pad_to(q, Tp), _pad_to(k, Tp), _pad_to(v, Tp)
+    else:
+        qt = _pad_to(q.transpose(0, 2, 1, 3), Tp)
+        kt = _pad_to(k.transpose(0, 2, 1, 3), Tp)
+        vt = _pad_to(v.transpose(0, 2, 1, 3), Tp)
     if Tp <= _FAST_PATH_MAX_T:
         f = _build_flash_fast(T, causal, float(sm_scale), block_q, block_k,
-                              interpret, H, H_kv)
+                              interpret, H, H_kv, block_q_bwd)
         o = f(qt.reshape(B * H, Tp, D), kt.reshape(B * H_kv, Tp, D),
               vt.reshape(B * H_kv, Tp, D))
         o = o.reshape(B, H, Tp, D)
@@ -681,4 +750,5 @@ def flash_attention(q, k, v, *, causal=True, sm_scale=None, block_q=512,
         f = _build_flash(T, causal, float(sm_scale), block_q, block_k,
                          interpret, H, H_kv)
         o = f(qt, kt, vt)
-    return o[:, :, :T, :].transpose(0, 2, 1, 3)
+    o = o[:, :, :T, :]
+    return o if layout == "bhtd" else o.transpose(0, 2, 1, 3)

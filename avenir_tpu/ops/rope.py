@@ -18,15 +18,25 @@ def rope_frequencies(head_dim: int, max_t: int, theta: float = 10000.0,
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
 
 
-def apply_rope_reference(x, cos, sin, positions=None):
-    """x: (B, T, H, D); cos/sin: (max_t, D/2). Rotates in fp32."""
-    B, T, H, D = x.shape
-    if positions is None:
-        c = cos[:T][None, :, None, :]  # (1, T, 1, D/2)
-        s = sin[:T][None, :, None, :]
+def apply_rope_reference(x, cos, sin, positions=None, layout="bthd"):
+    """x: (B, T, H, D) for layout='bthd', (B, H, T, D) for 'bhtd';
+    cos/sin: (max_t, D/2). Rotates in fp32."""
+    if layout == "bhtd":
+        T = x.shape[2]
+        if positions is None:
+            c = cos[:T][None, None, :, :]  # (1, 1, T, D/2)
+            s = sin[:T][None, None, :, :]
+        else:
+            c = cos[positions][:, None, :, :]  # positions: (B, T)
+            s = sin[positions][:, None, :, :]
     else:
-        c = cos[positions][:, :, None, :]  # positions: (B, T)
-        s = sin[positions][:, :, None, :]
+        T = x.shape[1]
+        if positions is None:
+            c = cos[:T][None, :, None, :]  # (1, T, 1, D/2)
+            s = sin[:T][None, :, None, :]
+        else:
+            c = cos[positions][:, :, None, :]
+            s = sin[positions][:, :, None, :]
     orig = x.dtype
     x = x.astype(jnp.float32)
     x1, x2 = jnp.split(x, 2, axis=-1)
@@ -34,9 +44,11 @@ def apply_rope_reference(x, cos, sin, positions=None):
     return out.astype(orig)
 
 
-def apply_rope(x, cos, sin, positions=None):
+def apply_rope(x, cos, sin, positions=None, layout="bthd"):
     """Apply rotary embeddings. The op is elementwise and XLA fuses it into
-    the surrounding matmuls on its own; a dedicated pallas kernel would only
-    pay off fused INSIDE the attention kernel (measured rationale in
-    BASELINE.md), so there is deliberately no impl switch here."""
-    return apply_rope_reference(x, cos, sin, positions=positions)
+    the surrounding matmuls on its own (VPU microbench in BASELINE.md
+    "silu/RoPE" table); a dedicated pallas kernel would only pay off fused
+    INSIDE the attention kernel, so there is deliberately no impl switch
+    here."""
+    return apply_rope_reference(x, cos, sin, positions=positions,
+                                layout=layout)

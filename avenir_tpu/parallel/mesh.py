@@ -159,6 +159,15 @@ def make_mesh(spec: str = "", devices=None, dcn_spec: str = "") -> Mesh:
     n_total = int(np.prod(ici_shape)) * n_slices
     devices = list(devices)[:n_total]
     slice_idx = {getattr(d, "slice_index", None) for d in devices}
+    if None not in slice_idx and len(slice_idx) != n_slices:
+        # real multi-slice metadata that contradicts dcn_spec: emulating
+        # here would lay ICI axes across DCN links — a silent order-of-
+        # magnitude collective slowdown. Fail loud instead.
+        raise ValueError(
+            f"dcn_spec {dcn_spec!r} asks for {n_slices} slices but devices "
+            f"report {len(slice_idx)} distinct slice_index values "
+            f"({sorted(slice_idx)}); fix dcn_spec to match the real topology"
+        )
     if len(slice_idx) == n_slices and None not in slice_idx:
         dev_array = mesh_utils.create_hybrid_device_mesh(
             ici_shape, dcn_shape, devices=np.asarray(devices)

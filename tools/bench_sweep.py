@@ -1,0 +1,57 @@
+"""Sweep flash-attention block sizes through the REAL bench.py train step.
+
+Microbenchmarks on the axon-tunneled chip are dominated by per-dispatch
+and D2H-fetch overheads (exp_layout.py postmortem) — the only trustworthy
+A/B is the full train step. Each config runs bench.py in a subprocess
+with AVENIR_FLASH_BLOCKS set and reports the JSON line's tokens/sec.
+
+Usage: python tools/bench_sweep.py [bq,bk,bqb ...]
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_GRID = [
+    "512,1024,512",   # round-2 default
+    "512,1024,256",
+    "1024,1024,1024",
+    "1024,1024,512",
+    "1024,1024,256",
+    "256,1024,256",
+]
+
+
+def run_one(blocks, extra=()):
+    env = dict(os.environ, AVENIR_FLASH_BLOCKS=blocks)
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), *extra],
+            capture_output=True, text=True, env=env, timeout=1200,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"{blocks}: bench timed out (1200s)", file=sys.stderr)
+        return None
+    for line in out.stdout.splitlines():
+        if line.startswith("{"):
+            return json.loads(line)
+    print(out.stdout[-2000:], out.stderr[-2000:], sep="\n", file=sys.stderr)
+    return None
+
+
+def main():
+    grid = sys.argv[1:] or DEFAULT_GRID
+    for blocks in grid:
+        r = run_one(blocks)
+        if r is None:
+            print(f"{blocks:18s} FAILED")
+            continue
+        print(f"{blocks:18s} {r['value']:10.0f} tok/s  "
+              f"mfu={r['extra']['mfu']:.3f}  vs={r['vs_baseline']:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+"""Round-3 layout experiment #2: BH-major attention block end-to-end.
+
+Compares, in ONE jit call over 12 layers (so the per-dispatch tunnel
+overhead amortizes), the full attention sub-block (qkv proj -> attention
+-> out proj) in two formulations:
+
+  A. current model form: Linear(C,3C) -> reshape (B,T,H,D) ->
+     flash_attention (transposes to (B*H,T,D) inside) -> reshape ->
+     Linear(C,C)
+  B. BH-major: einsum('btc,chd->bhtd') projections produce the kernel's
+     native layout directly (XLA fuses the transpose into the matmul
+     epilogue / dot dimension numbers), kernel runs transpose-free, out
+     proj consumes (B,H,T,D) via einsum('bhtd,hdc->btc').
+
+Parameters are bitwise-identical between the two forms (B reshapes A's),
+so outputs must match and only layout handling differs.
+
+Usage: python tools/exp_layout2.py
+"""
+
+import functools
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from avenir_tpu.ops.pallas.flash_attention import (
+    _build_flash_fast,
+    flash_attention,
+)
+
+B, T, H, D = 16, 1024, 12, 64
+C = H * D
+L = 12
+
+
+def timeit(fn, *args, warmup=3, iters=10):
+    # block_until_ready returns early through the axon tunnel; a D2H fetch
+    # of one element is the only reliable fence (same as exp_layout.py).
+    for _ in range(warmup):
+        out = fn(*args)
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    return (time.perf_counter() - t0) / iters
+
+
+def make_params():
+    rng = np.random.default_rng(0)
+    mk = lambda *s: jnp.asarray(rng.standard_normal(s).astype(np.float32)
+                                * 0.02, jnp.bfloat16)
+    return [dict(w_qkv=mk(C, 3 * C), b_qkv=mk(3 * C),
+                 w_o=mk(C, C), b_o=mk(C)) for _ in range(L)]
+
+
+def block_a(p, x):
+    qkv = x @ p["w_qkv"] + p["b_qkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, D)
+    k = k.reshape(B, T, H, D)
+    v = v.reshape(B, T, H, D)
+    y = flash_attention(q, k, v, causal=True)
+    y = y.reshape(B, T, C)
+    return x + (y @ p["w_o"] + p["b_o"])
+
+
+def block_b(p, x):
+    wq, wk, wv = jnp.split(p["w_qkv"], 3, axis=1)
+    bq, bk, bv = jnp.split(p["b_qkv"], 3)
+    # (B,T,C) x (C,H,D) -> (B,H,T,D): transpose rides the matmul output
+    q = jnp.einsum("btc,chd->bhtd", x, wq.reshape(C, H, D),
+                   preferred_element_type=jnp.bfloat16) + bq.reshape(H, D)[None, :, None, :]
+    k = jnp.einsum("btc,chd->bhtd", x, wk.reshape(C, H, D),
+                   preferred_element_type=jnp.bfloat16) + bk.reshape(H, D)[None, :, None, :]
+    v = jnp.einsum("btc,chd->bhtd", x, wv.reshape(C, H, D),
+                   preferred_element_type=jnp.bfloat16) + bv.reshape(H, D)[None, :, None, :]
+    sm = 1.0 / math.sqrt(D)
+    f = _build_flash_fast(T, True, sm, 512, 1024, False, H, H)
+    o = f(q.reshape(B * H, T, D), k.reshape(B * H, T, D),
+          v.reshape(B * H, T, D)).reshape(B, H, T, D)
+    y = jnp.einsum("bhtd,hdc->btc", o, p["w_o"].reshape(H, D, C),
+                   preferred_element_type=jnp.bfloat16) + p["b_o"]
+    return x + y
+
+
+def trunk(block, params, x):
+    for p in params:
+        x = block(p, x)
+    return x
+
+
+def main():
+    params = make_params()
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((B, T, C)).astype(np.float32) * 0.3,
+                    jnp.bfloat16)
+
+    for name, blk in (("A current (Linear+reshape)", block_a),
+                      ("B BH-major einsum", block_b)):
+        def loss(params_, x_):
+            return trunk(blk, params_, x_).astype(jnp.float32).mean()
+
+        g = jax.jit(jax.grad(loss, argnums=(0, 1)))
+        t = timeit(lambda: g(params, x))
+        print(f"{name:32s} 12-layer fwd+bwd: {t*1e3:8.2f} ms")
+
+    # parity check
+    oa = jax.jit(lambda p_, x_: trunk(block_a, p_, x_))(params, x)
+    ob = jax.jit(lambda p_, x_: trunk(block_b, p_, x_))(params, x)
+    err = float(jnp.max(jnp.abs(oa.astype(jnp.float32) - ob.astype(jnp.float32))))
+    print(f"max |A-B| = {err:.3e}")
+
+
+if __name__ == "__main__":
+    main()
