@@ -53,6 +53,30 @@ def resolve_dtype(name):
     }[name]
 
 
+def head_major_project(x, kernel, bias, n_head, head_dim):
+    """(B, T, C) @ (C, n_head*head_dim) -> (B, n_head, T, head_dim) in one
+    einsum: the transpose into the flash kernels' native head-major layout
+    rides the matmul epilogue instead of being a standalone copy (VERDICT
+    r2 item 1; A/B in tools/exp_layout2.py). `kernel`/`bias` are plain
+    arrays already cast to the compute dtype."""
+    C = x.shape[-1]
+    out = jnp.einsum("btc,chd->bhtd", x,
+                     kernel.reshape(C, n_head, head_dim))
+    if bias is not None:
+        out = out + bias.reshape(1, n_head, 1, head_dim)
+    return out
+
+
+def head_major_merge(y, kernel, bias):
+    """(B, H, T, D) @ (H*D, C) -> (B, T, C), consuming head-major directly
+    (the inverse of head_major_project, same fused-transpose rationale)."""
+    H, D = y.shape[1], y.shape[3]
+    out = jnp.einsum("bhtd,hdc->btc", y, kernel.reshape(H, D, -1))
+    if bias is not None:
+        out = out + bias
+    return out
+
+
 def cross_entropy_loss(logits, targets, ignore_index=-1):
     """Mean token cross-entropy in fp32, skipping `ignore_index` positions —
     mirrors `F.cross_entropy(..., ignore_index=-1)` in model.py:190-192."""

@@ -31,6 +31,8 @@ from flax import nnx
 
 from avenir_tpu.models.common import (
     cross_entropy_loss,
+    head_major_merge,
+    head_major_project,
     resolve_dtype,
     scan_layer_stack,
     stacked_layers,
@@ -88,25 +90,21 @@ class CausalSelfAttention(nnx.Module):
         B, T, C = x.shape
         H = self.n_head
         hd = C // H
-        # Head-major projections: einsum 'btc,chd->bhtd' lands q/k/v in the
-        # flash kernels' native (B, H, T, D) layout with the transpose fused
-        # into the matmul epilogue — no standalone (B,T,H,D)<->(B,H,T,D)
-        # copies around the attention op (VERDICT r2 item 1; A/B-measured in
-        # tools/exp_layout2.py). Params stay in the c_attn/c_proj Linears so
-        # the checkpoint format is unchanged.
+        # Head-major projections (models/common.py helpers): q/k/v land in
+        # the flash kernels' native (B, H, T, D) layout with the transpose
+        # fused into the matmul epilogue. Params stay in the c_attn/c_proj
+        # Linears so the checkpoint format is unchanged.
         cdtype = x.dtype
         w = self.c_attn.kernel.get_value().astype(cdtype)  # (C, 3C)
-        wq, wk, wv = (w[:, i * C:(i + 1) * C].reshape(C, H, hd)
-                      for i in range(3))
-        qkv_parts = []
-        for wi in (wq, wk, wv):
-            qkv_parts.append(jnp.einsum("btc,chd->bhtd", x, wi))
-        q, k, v = qkv_parts
-        if self.c_attn.bias is not None:
-            b = self.c_attn.bias.get_value().astype(cdtype)  # (3C,)
-            bq, bk, bv = (b[i * C:(i + 1) * C].reshape(1, H, 1, hd)
-                          for i in range(3))
-            q, k, v = q + bq, k + bk, v + bv
+        b = (self.c_attn.bias.get_value().astype(cdtype)
+             if self.c_attn.bias is not None else None)
+        q, k, v = (
+            head_major_project(
+                x, w[:, i * C:(i + 1) * C],
+                None if b is None else b[i * C:(i + 1) * C], H, hd,
+            )
+            for i in range(3)
+        )
         use_dropout = self.dropout > 0.0 and not deterministic
         y = causal_attention(
             q, k, v,
@@ -114,10 +112,11 @@ class CausalSelfAttention(nnx.Module):
             dropout_rng=rngs.dropout() if use_dropout else None,
             impl=self.attn_impl, layout="bhtd",
         )  # (B, H, T, hd)
-        wo = self.c_proj.kernel.get_value().astype(cdtype).reshape(H, hd, C)
-        out = jnp.einsum("bhtd,hdc->btc", y, wo)
-        if self.c_proj.bias is not None:
-            out = out + self.c_proj.bias.get_value().astype(cdtype)
+        out = head_major_merge(
+            y, self.c_proj.kernel.get_value().astype(cdtype),
+            self.c_proj.bias.get_value().astype(cdtype)
+            if self.c_proj.bias is not None else None,
+        )
         return self.resid_dropout(out, deterministic=deterministic, rngs=rngs)
 
 

@@ -22,6 +22,8 @@ from flax import nnx
 
 from avenir_tpu.models.common import (
     cross_entropy_loss,
+    head_major_merge,
+    head_major_project,
     resolve_dtype,
     scan_layer_stack,
     stacked_layers,
@@ -107,22 +109,18 @@ class LlamaAttention(nnx.Module):
     def __call__(self, x, positions=None):
         B, T, C = x.shape
         H, Hkv, hd = self.n_head, self.n_kv_head, self.head_dim
-        # Head-major projections (einsum fuses the transpose into the
-        # matmul epilogue — no standalone layout copies around the flash
-        # kernel; VERDICT r2 item 1, same move as gpt.py).
+        # Head-major projections (models/common.py helpers; the transpose
+        # into the kernel-native layout rides the matmul epilogue).
         cdtype = x.dtype
-        wq = self.q_proj.kernel.get_value().astype(cdtype).reshape(C, H, hd)
-        wk = self.k_proj.kernel.get_value().astype(cdtype).reshape(C, Hkv, hd)
-        wv = self.v_proj.kernel.get_value().astype(cdtype).reshape(C, Hkv, hd)
-        q = jnp.einsum("btc,chd->bhtd", x, wq)
-        k = jnp.einsum("btc,chd->bhtd", x, wk)
-        v = jnp.einsum("btc,chd->bhtd", x, wv)
+        proj = lambda lin, nh: head_major_project(
+            x, lin.kernel.get_value().astype(cdtype), None, nh, hd)
+        q, k, v = proj(self.q_proj, H), proj(self.k_proj, Hkv), proj(self.v_proj, Hkv)
         cos, sin = rope_frequencies(hd, self.max_t, self.rope_theta)
         q = apply_rope(q, cos, sin, positions=positions, layout="bhtd")
         k = apply_rope(k, cos, sin, positions=positions, layout="bhtd")
         y = causal_attention(q, k, v, impl=self.attn_impl, layout="bhtd")
-        wo = self.o_proj.kernel.get_value().astype(cdtype).reshape(H, hd, C)
-        return jnp.einsum("bhtd,hdc->btc", y, wo)
+        return head_major_merge(
+            y, self.o_proj.kernel.get_value().astype(cdtype), None)
 
 
 class LlamaMLP(nnx.Module):

@@ -137,6 +137,21 @@ def causal_attention(q, k, v, *, dropout_rate=0.0, deterministic=True,
         from avenir_tpu.ops.pallas.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=True, layout=layout)
+    if impl == "jax_ref":
+        # upstream jax.experimental TPU flash kernel — calibration yardstick
+        # for ours (`python bench.py --attn=jax_ref`), not a product path
+        assert not use_dropout, "jax_ref flash attention does not support attn dropout"
+        assert segment_ids is None, "jax_ref path does not take segment_ids"
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as jax_flash,
+        )
+
+        sc = 1.0 / math.sqrt(q.shape[-1])
+        if layout == "bhtd":
+            return jax_flash(q, k, v, causal=True, sm_scale=sc)
+        out = jax_flash(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                        v.transpose(0, 2, 1, 3), causal=True, sm_scale=sc)
+        return out.transpose(0, 2, 1, 3)
     assert impl == "xla", f"unknown attention impl {impl!r}"
     if layout == "bhtd":
         return _causal_attention_reference_bhtd(

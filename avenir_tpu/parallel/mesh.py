@@ -159,10 +159,12 @@ def make_mesh(spec: str = "", devices=None, dcn_spec: str = "") -> Mesh:
     n_total = int(np.prod(ici_shape)) * n_slices
     devices = list(devices)[:n_total]
     slice_idx = {getattr(d, "slice_index", None) for d in devices}
-    if None not in slice_idx and len(slice_idx) != n_slices:
-        # real multi-slice metadata that contradicts dcn_spec: emulating
+    if None not in slice_idx and 1 < len(slice_idx) != n_slices:
+        # real MULTI-slice metadata that contradicts dcn_spec: emulating
         # here would lay ICI axes across DCN links — a silent order-of-
-        # magnitude collective slowdown. Fail loud instead.
+        # magnitude collective slowdown. Fail loud instead. (A single real
+        # slice emulating a multi-slice layout is fine — the "DCN" hops
+        # ride faster links, not slower — and is the documented dev path.)
         raise ValueError(
             f"dcn_spec {dcn_spec!r} asks for {n_slices} slices but devices "
             f"report {len(slice_idx)} distinct slice_index values "

@@ -28,6 +28,20 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "slow: multi-subprocess integration tests")
 
 
+_DEFAULT_MESH = jax.sharding.get_mesh()  # the empty mesh, captured pre-tests
+
+
+@pytest.fixture(autouse=True)
+def _reset_ambient_mesh():
+    """The training loop and some tests install a global context mesh via
+    jax.set_mesh and never unset it (there is no public unset); a leaked
+    1-device mesh makes any later jit over a different mesh fail with
+    'incompatible devices'. Restore the empty default around every test so
+    ordering never matters."""
+    yield
+    jax.set_mesh(_DEFAULT_MESH)
+
+
 @pytest.fixture(scope="session")
 def char_dataset(tmp_path_factory):
     """Tiny deterministic char-level dataset in the nanoGPT on-disk layout."""

@@ -43,9 +43,12 @@ def run_one(blocks, extra=()):
 
 
 def main():
-    grid = sys.argv[1:] or DEFAULT_GRID
+    # leading-dash args pass through to bench.py (e.g. --attn=jax_ref,
+    # --batch=8); bare args are block configs "bq,bk,bqb"
+    extra = tuple(a for a in sys.argv[1:] if a.startswith("-"))
+    grid = [a for a in sys.argv[1:] if not a.startswith("-")] or DEFAULT_GRID
     for blocks in grid:
-        r = run_one(blocks)
+        r = run_one(blocks, extra)
         if r is None:
             print(f"{blocks:18s} FAILED")
             continue
