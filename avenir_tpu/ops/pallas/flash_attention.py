@@ -73,12 +73,16 @@ def _branch(pred, then_fn, else_fn):
 # Causal staircase: the fast-path kernels see the whole (padded) KV as one
 # block, but a causal q block at row offset (i+1)*block_q never looks past
 # that row — so each q grid step statically slices KV to its own staircase
-# length and skips the dead MXU/VPU work above the diagonal. Unrolling one
-# pl.when branch per q step generalizes round-2's two-way halving (work
-# factor (n+1)/2n -> ~0.56x at n=8 vs 0.75x at n=2); branches beyond
-# _STAIRCASE_MAX_BRANCHES fall back to coarser half-granularity steps so
-# kernel code size stays bounded at long T.
-_STAIRCASE_MAX_BRANCHES = 8
+# length and skips the dead MXU/VPU work above the diagonal, generalizing
+# round-2's two-way halving. MEASURED on v5e (Llama-8B rung, T=4096,
+# nq=8): finer staircases LOSE despite the lower work factor — 2 branches
+# 27.6k tok/s (53.6% MFU), 4 branches 27.1k, 8 branches 24.4k; the
+# unrolled branch bodies defeat Mosaic's cross-grid-step pipelining. The
+# default therefore stays at the measured winner, halving (2); the env
+# knob exists for re-sweeping on other chips.
+_STAIRCASE_MAX_BRANCHES = max(
+    1, int(os.environ.get("AVENIR_STAIRCASE_BRANCHES", "2"))
+)  # <1 would emit no pl.when branch at all -> uninitialized output
 
 
 def _staircase(i, nq, block_q, tp, body):

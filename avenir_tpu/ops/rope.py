@@ -45,10 +45,11 @@ def apply_rope_reference(x, cos, sin, positions=None, layout="bthd"):
 
 
 def apply_rope(x, cos, sin, positions=None, layout="bthd"):
-    """Apply rotary embeddings. The op is elementwise and XLA fuses it into
-    the surrounding matmuls on its own (VPU microbench in BASELINE.md
-    "silu/RoPE" table); a dedicated pallas kernel would only pay off fused
-    INSIDE the attention kernel, so there is deliberately no impl switch
-    here."""
+    """Apply rotary embeddings. Measured (tools/bench_act.py, BASELINE.md
+    "silu / RoPE on the VPU" table): rope on q+k costs 1.1% of a 12-layer
+    Llama-8B attention chain fwd+bwd on v5e (1.6ms/139ms) — XLA fuses the
+    standalone form fine, and only fusing INTO the flash kernel's q/k load
+    path could recover that ~0.4%-of-step tax, so there is deliberately no
+    pallas variant here."""
     return apply_rope_reference(x, cos, sin, positions=positions,
                                 layout=layout)

@@ -1,8 +1,11 @@
 """SwiGLU activation (SURVEY.md §2b T6, for Llama-3 — BASELINE.json:10).
 
-swiglu(gate, up) = silu(gate) * up. Elementwise — XLA fuses it into the
-adjacent matmuls on its own; the explicit op exists so the model code names
-the semantic and the pallas fused-MLP variant can slot in behind it.
+swiglu(gate, up) = silu(gate) * up. Elementwise — measured on v5e
+(tools/bench_act.py; BASELINE.md "silu / RoPE on the VPU" table): silu
+costs the same as tanh-GELU (84.8% of peak at the Llama shape, 6% of
+the SwiGLU MLP chain vs identity);
+unlike erf-GELU it pipelines behind the MXU, so no pallas kernel is
+warranted. The explicit op exists so the model code names the semantic.
 """
 
 import jax

@@ -12,7 +12,7 @@ tokens/sec/GPU for the same model/optimizer in PyTorch.
 
 Usage:
   python bench.py [--steps=N] [--batch=N] [--block=N] [--scan=1]
-                  [--attn=pallas|xla] [--no_pallas]
+                  [--attn=pallas|xla|jax_ref] [--no_pallas]
 --no_pallas forces XLA attention; --attn overrides it explicitly. The
 optimizer is always XLA-fused optax (the measured winner — BASELINE.md
 "fused AdamW" section). (No pytest conftest here: this must see the REAL
@@ -45,7 +45,7 @@ def main():
     steps = int(args.get("steps", 40))
     block = int(args.get("block", 1024))
     use_pallas = "no_pallas" not in args
-    attn_impl_flag = args.get("attn", "")   # '', 'pallas', 'xla'
+    attn_impl_flag = args.get("attn", "")   # '', 'pallas', 'xla', 'jax_ref' (calibration)
     on_tpu = jax.default_backend() == "tpu"
 
     from avenir_tpu.models.gpt import GPT, GPTConfig

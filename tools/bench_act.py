@@ -1,0 +1,146 @@
+"""VPU activation/RoPE microbench (VERDICT r2 item 3; the tool-shape that
+found the erf-GELU +22% tax in round 2).
+
+Measures, in ONE jit per variant (12x chained blocks so per-dispatch
+overhead amortizes; a trailing 1-element D2H fetch is the only reliable
+fence on the tunneled platform):
+
+  act:  x -> fc(4d) -> ACT -> proj(d), 12 chained, fwd+bwd
+        for ACT in {silu, tanh-gelu, erf-gelu, relu, identity}
+        at the Llama-8B MLP shape (d=4096, ffn=14336, SwiGLU form for
+        silu: gate*up like llama.py) and the GPT shape (768->3072).
+  rope: Llama-8B attention projection chain (d=4096, 32:8 GQA heads,
+        T=4096) with and without apply_rope on q/k — the delta is what
+        RoPE actually costs inside a fused program.
+
+Usage: python tools/bench_act.py [--exp=act|rope|all]
+"""
+
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+L = 12
+
+
+def timeit(fn, *args, warmup=2, iters=8):
+    # fence = D2H of ONE element (sliced on device first — np.asarray on
+    # the full leaf would drag the whole gradient through the tunnel);
+    # block_until_ready alone returns early on this platform
+    fence = lambda out: np.asarray(jax.tree.leaves(out)[0].ravel()[:1])
+    for _ in range(warmup):
+        out = fn(*args)
+    fence(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    fence(out)
+    return (time.perf_counter() - t0) / iters
+
+
+ACTS = {
+    "silu": jax.nn.silu,
+    "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+    "gelu_erf": lambda x: jax.nn.gelu(x, approximate=False),
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+def bench_mlp_chain(B, T, d, ffn, swiglu_form):
+    rng = np.random.default_rng(0)
+    mk = lambda *s: jnp.asarray(
+        rng.standard_normal(s).astype(np.float32) * 0.02, jnp.bfloat16)
+    x = mk(B * T, d)
+    if swiglu_form:
+        params = [dict(wg=mk(d, ffn), wu=mk(d, ffn), wd=mk(ffn, d))
+                  for _ in range(L)]
+    else:
+        params = [dict(wu=mk(d, ffn), wd=mk(ffn, d)) for _ in range(L)]
+    for name, act in ACTS.items():
+        if swiglu_form:
+            def blockf(p, h, a=act):
+                return h + (a(h @ p["wg"]) * (h @ p["wu"])) @ p["wd"]
+            n_mm = 3
+        else:
+            def blockf(p, h, a=act):
+                return h + a(h @ p["wu"]) @ p["wd"]
+            n_mm = 2
+
+        def loss(ps, h):
+            for p in ps:
+                h = blockf(p, h)
+            return h.astype(jnp.float32).mean()
+
+        g = jax.jit(jax.grad(loss, argnums=0))
+        t = timeit(lambda: g(params, x))
+        flops = 3 * 2 * B * T * d * ffn * n_mm * L  # fwd+2bwd passes
+        print(f"  {name:10s} {t*1e3:8.2f} ms   {flops/t/1e12:6.1f} TF/s "
+              f"({100*flops/t/197e12:4.1f}% of v5e peak)")
+
+
+def bench_rope(B, T, d, n_head, n_kv_head):
+    from avenir_tpu.models.common import head_major_merge, head_major_project
+    from avenir_tpu.ops import apply_rope, rope_frequencies
+    from avenir_tpu.ops.pallas.flash_attention import flash_attention
+
+    hd = d // n_head
+    rng = np.random.default_rng(0)
+    mk = lambda *s: jnp.asarray(
+        rng.standard_normal(s).astype(np.float32) * 0.02, jnp.bfloat16)
+    x = mk(B, T, d)
+    params = [dict(wq=mk(d, n_head * hd), wk=mk(d, n_kv_head * hd),
+                   wv=mk(d, n_kv_head * hd), wo=mk(n_head * hd, d))
+              for _ in range(L)]
+    cos, sin = rope_frequencies(hd, T)
+
+    def make_loss(use_rope):
+        def block(p, h):
+            q = head_major_project(h, p["wq"], None, n_head, hd)
+            k = head_major_project(h, p["wk"], None, n_kv_head, hd)
+            v = head_major_project(h, p["wv"], None, n_kv_head, hd)
+            if use_rope:
+                q = apply_rope(q, cos, sin, layout="bhtd")
+                k = apply_rope(k, cos, sin, layout="bhtd")
+            o = flash_attention(q, k, v, causal=True, layout="bhtd")
+            return h + head_major_merge(o, p["wo"], None)
+
+        def loss(ps, h):
+            for p in ps:
+                h = block(p, h)
+            return h.astype(jnp.float32).mean()
+
+        return jax.jit(jax.grad(loss, argnums=0))
+
+    g0 = make_loss(False)
+    g1 = make_loss(True)
+    t0 = timeit(lambda: g0(params, x))
+    t1 = timeit(lambda: g1(params, x))
+    print(f"  attention chain without rope: {t0*1e3:8.2f} ms")
+    print(f"  attention chain with rope:    {t1*1e3:8.2f} ms")
+    print(f"  => rope tax over {L} layers (fwd+bwd, q+k): "
+          f"{(t1-t0)*1e3:6.2f} ms ({100*(t1-t0)/t1:4.1f}% of the chain)")
+
+
+def main():
+    arg = sys.argv[1] if len(sys.argv) > 1 else "--exp=all"
+    if "act" in arg or "all" in arg:
+        print("GPT MLP shape (B=16 T=1024, 768->3072, act(fc(x))@proj):")
+        bench_mlp_chain(16, 1024, 768, 3072, swiglu_form=False)
+        print("Llama MLP shape (B=1 T=4096, 4096->14336, SwiGLU "
+              "act(gate)*up form):")
+        bench_mlp_chain(1, 4096, 4096, 14336, swiglu_form=True)
+    if "rope" in arg or "all" in arg:
+        print("Llama-8B attention shape (B=1 T=4096, 32:8 GQA, D=128):")
+        bench_rope(1, 4096, 4096, 32, 8)
+
+
+if __name__ == "__main__":
+    main()
