@@ -128,18 +128,41 @@ class MixtralSparseMoeBlock(nnx.Module):
         pos_tok = jnp.sum(pos * oh, axis=-1)  # (N, K) position in chosen queue
         keep = pos_tok < C  # capacity mask
 
-        slot_oh = jax.nn.one_hot(pos_tok, C, dtype=jnp.float32) * keep[..., None]
-        # dispatch (N, E, C) / combine (N, E, C)
-        disp = jnp.einsum("nke,nkc->nec", oh.astype(jnp.float32), slot_oh)
-        comb = jnp.einsum("nke,nkc,nk->nec", oh.astype(jnp.float32), slot_oh,
-                          topk_probs)
-
-        expert_in = jnp.einsum("nec,nd->ecd", disp.astype(self._cdtype),
-                               xf.astype(self._cdtype))
+        # Gather/scatter dispatch (round 3, VERDICT r2 item 4): the round-2
+        # (N, E, C)-one-hot dispatch/combine einsums were O(N·E·C·d) dense
+        # FLOPs and materialized two (N, E, C) fp32 arrays (168 MB each at
+        # the bench rung) — xprof put them at ~12% of the step. Routing is
+        # a permutation, so build it as one: each kept (token, slot) pair
+        # owns expert queue cell `topk_idx·C + pos_tok`, dropped pairs park
+        # on an overflow cell, and dispatch/combine become O(N·K·d) row
+        # gathers (autodiff turns them into scatter-adds). Same semantics:
+        # unique cells, token-major queue order, dropped slots contribute 0.
+        slot = jnp.where(keep, topk_idx * C + pos_tok, E * C)  # (N, K)
+        tok_of_pair = jnp.broadcast_to(jnp.arange(N)[:, None], (N, K))
+        # inverse permutation: which token fills each expert queue cell
+        # (sentinel N = "empty cell" -> the appended zero row of xf). The
+        # scatter target is exactly (E*C,): dropped pairs' overflow index
+        # E*C falls out of bounds and mode="drop" discards them, so the
+        # remaining writes are genuinely unique (one owner per cell).
+        token_for_slot = jnp.full((E * C,), N, dtype=jnp.int32)
+        token_for_slot = token_for_slot.at[slot.reshape(-1)].set(
+            tok_of_pair.reshape(-1).astype(jnp.int32), mode="drop",
+            unique_indices=True,
+        )
+        xf_c = jnp.concatenate(
+            [xf.astype(self._cdtype), jnp.zeros((1, d), self._cdtype)], axis=0
+        )
+        expert_in = xf_c[token_for_slot].reshape(E, C, d)
         expert_in = constrain(expert_in, P("expert", None, None))
         expert_out = self.experts(expert_in)  # (E, C, d)
         expert_out = constrain(expert_out, P("expert", None, None))
-        out = jnp.einsum("nec,ecd->nd", comb.astype(self._cdtype), expert_out)
+        out_flat = jnp.concatenate(
+            [expert_out.reshape(E * C, d), jnp.zeros((1, d), expert_out.dtype)],
+            axis=0,
+        )
+        gathered = out_flat[slot]  # (N, K, d); dropped pairs hit the zero row
+        out = jnp.einsum("nk,nkd->nd",
+                         (topk_probs * keep).astype(self._cdtype), gathered)
         return out.reshape(B, T, d).astype(x.dtype), stats
 
 

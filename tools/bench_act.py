@@ -16,7 +16,6 @@ fence on the tunneled platform):
 Usage: python tools/bench_act.py [--exp=act|rope|all]
 """
 
-import math
 import os
 import sys
 import time

@@ -98,6 +98,7 @@ def main():
             for a in sys.argv[1:]}
     steps = int(args.get("steps", 8))
     which = args.get("rung", "all")
+    batch_override = int(args["batch"]) if "batch" in args else None
 
     if which in ("all", "1p5b"):
         # GPT-2 1.5B shape: d=1600, 25 heads (BASELINE.json:9). Full 48
@@ -108,7 +109,7 @@ def main():
             dict(block_size=T, vocab_size=50304, n_layer=L, n_head=h,
                  n_embd=d, dropout=0.0, bias=True, compute_dtype="bfloat16",
                  attn_impl="pallas", scan_layers=True, remat=True),
-            batch=4, steps=steps,
+            batch=batch_override or 4, steps=steps,
         )
 
     # Llama-3 8B shape: d=4096 ffn=14336 GQA 32/8 (BASELINE.json:10).
@@ -125,7 +126,7 @@ def main():
         run_rung(
             "llama3-8b-shape (L=32->2, vocab->16k, d/ffn/GQA/long-T full)",
             "llama", dict(block_size=4096, **llama_shape),
-            batch=1, steps=steps,
+            batch=batch_override or 1, steps=steps,
         )
 
     if which in ("all", "llama8b-longT"):
@@ -134,7 +135,7 @@ def main():
         run_rung(
             "llama3-8b-shape LONG-T blocked path (T=8192, L=2, vocab 16k)",
             "llama", dict(block_size=8192, **llama_shape),
-            batch=1, steps=steps,
+            batch=batch_override or 1, steps=steps,
         )
 
     if which in ("all", "mixtral"):
@@ -150,7 +151,7 @@ def main():
                  n_experts_per_tok=K, capacity_factor=1.25,
                  rope_theta=10000.0, compute_dtype="bfloat16",
                  attn_impl="pallas", scan_layers=False, remat=True),
-            batch=4, steps=steps,
+            batch=batch_override or 4, steps=steps,
             # MFU on ACTIVE params: subtract the (E-K) unrouted experts
             active_params=lambda n: n - L * 3 * d * ffn * (E - K),
         )
