@@ -19,4 +19,10 @@ max_iters = 300000
 lr_decay_iters = 300000
 weight_decay = 1e-1
 remat = True
-scan_layers = True
+# scan-vs-loop measured head-to-head at the 0.57B on-chip rung (L=16,
+# d=1600, B=4, v5e): loop 22.5k tok/s vs scan 21.1k (~6% — BASELINE.md
+# "scan_layers" section), consistent with the 13% loop win at 124M. Loop
+# costs one longer compile (one HLO copy per layer); for a 300k-iter run
+# the steady-state 6% dominates. Flip to True if compile time ever
+# matters more (e.g. rapid config iteration).
+scan_layers = False

@@ -1,0 +1,83 @@
+"""Cached-decode latency/throughput on the real chip (VERDICT r2 item 6 /
+r1 item 9 remainder: the KV-cache path had only ever run on the CPU test
+harness).
+
+The decode loop (infer/decode.py) is ONE fused dispatch (nnx.scan over
+tokens). Per-token latency is isolated from prefill and dispatch overhead
+by timing two compiled runs — N tokens and 1 token — and dividing the
+DELTA by N-1 (both runs pay the same prefill + round-trip; the difference
+is N-1 decode-scan iterations). Warmups compile both scan lengths first.
+
+Usage: python tools/bench_decode.py [--tokens=N] [--batch=N]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+from flax import nnx
+
+
+def _timed_run(model, rng, idx, n_tokens):
+    from avenir_tpu.infer.decode import generate_cached
+
+    t0 = time.perf_counter()
+    out = generate_cached(model, rng, idx, n_tokens, temperature=1.0,
+                          top_k=50)
+    np.asarray(out[0, -1:])  # fence
+    return time.perf_counter() - t0
+
+
+def bench_one(name, model, *, batch, prompt_len, new_tokens):
+    from avenir_tpu.infer.decode import generate_cached
+
+    rng = jax.random.key(0)
+    idx = jax.numpy.asarray(
+        np.random.default_rng(0).integers(0, 1000, (batch, prompt_len))
+        .astype(np.int32))
+    for n in (1, new_tokens):  # compile both scan lengths
+        out = generate_cached(model, rng, idx, n, temperature=1.0, top_k=50)
+        np.asarray(out[0, -1:])
+    t1 = _timed_run(model, rng, idx, 1)
+    tN = _timed_run(model, rng, idx, new_tokens)
+    per_tok_ms = (tN - t1) / (new_tokens - 1) * 1e3
+    print(f"{name}: batch={batch} prompt={prompt_len} new={new_tokens} "
+          f"-> {per_tok_ms:.2f} ms/token decode-only "
+          f"({batch * (new_tokens - 1) / (tN - t1):,.0f} tok/s aggregate); "
+          f"prefill+1tok+RTT overhead {t1*1e3:.1f} ms")
+
+
+def main():
+    args = {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
+            for a in sys.argv[1:]}
+    new_tokens = int(args.get("tokens", 128))
+    batch = int(args.get("batch", 1))
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    cdtype = "bfloat16" if on_tpu else "float32"
+    gpt = GPT(GPTConfig(block_size=1024, vocab_size=50304, n_layer=12,
+                        n_head=12, n_embd=768, dropout=0.0, bias=True,
+                        compute_dtype=cdtype, attn_impl="xla"),
+              rngs=nnx.Rngs(0))
+    bench_one("gpt2-124m decode", gpt, batch=batch, prompt_len=128,
+              new_tokens=new_tokens)
+
+    from avenir_tpu.models.llama import Llama, LlamaConfig
+
+    llama = Llama(LlamaConfig(block_size=4096, vocab_size=16384, n_layer=2,
+                              n_head=32, n_kv_head=8, n_embd=4096,
+                              ffn_hidden=14336, rope_theta=500000.0,
+                              compute_dtype=cdtype, attn_impl="xla"),
+                  rngs=nnx.Rngs(0))
+    bench_one("llama8b-shape (L=2) decode", llama, batch=batch,
+              prompt_len=128, new_tokens=new_tokens)
+
+
+if __name__ == "__main__":
+    main()
