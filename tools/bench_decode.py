@@ -55,6 +55,7 @@ def main():
     args = {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
             for a in sys.argv[1:]}
     new_tokens = int(args.get("tokens", 128))
+    assert new_tokens >= 2, "--tokens must be >= 2 (delta timing needs two lengths)"
     batch = int(args.get("batch", 1))
 
     from avenir_tpu.models.gpt import GPT, GPTConfig
