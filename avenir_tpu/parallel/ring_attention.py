@@ -176,6 +176,18 @@ def _build_ring_body(axis_name, seq_len, sm_scale):
     return f
 
 
+def context_shard_map(body, *, axis_name, mesh=None, n_in=3):
+    """Shared shard_map wrapper for sequence-parallel attention impls
+    (ring + ulysses): batch dims ride the data-like axes, the sequence
+    dim rides `axis_name`, heads/head_dim replicated. ONE home for the
+    spec so the two impls cannot drift."""
+    spec = P(("data", "fsdp", "expert"), axis_name, None, None)
+    kwargs = dict(in_specs=(spec,) * n_in, out_specs=spec, check_vma=False)
+    if mesh is not None:
+        kwargs["mesh"] = mesh
+    return jax.shard_map(body, **kwargs)
+
+
 def ring_causal_attention(q, k, v, *, axis_name="context", mesh=None,
                           sm_scale=None):
     """Causal attention with the sequence sharded over `axis_name`.
@@ -184,10 +196,5 @@ def ring_causal_attention(q, k, v, *, axis_name="context", mesh=None,
     B, T, H, D = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
-    spec = P(("data", "fsdp", "expert"), axis_name, None, None)
     body = _build_ring_body(axis_name, T, float(sm_scale))
-    kwargs = dict(in_specs=(spec, spec, spec), out_specs=spec,
-                  check_vma=False)
-    if mesh is not None:
-        kwargs["mesh"] = mesh
-    return jax.shard_map(body, **kwargs)(q, k, v)
+    return context_shard_map(body, axis_name=axis_name, mesh=mesh)(q, k, v)

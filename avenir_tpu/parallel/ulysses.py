@@ -1,0 +1,108 @@
+"""Ulysses-style sequence parallelism — all-to-all over the 'context' axis
+(SURVEY.md §2c SP/CP row; the build brief's "ring attention OR all-to-all
+sequence/context parallelism" — this is the all-to-all arm, complementing
+parallel/ring_attention.py).
+
+Mechanism (DeepSpeed-Ulysses shape): tokens arrive sequence-sharded
+(B, T/c, H, D) per device. One `lax.all_to_all` re-shards heads instead
+of sequence — (B, T, H/c, D): every device now sees the FULL sequence for
+its head subset, runs an ordinary causal attention locally (the Pallas
+flash kernel on TPU — no cross-device softmax algebra needed, unlike the
+ring), and a second all-to-all restores sequence sharding.
+
+GQA is NATIVE: when the context axis divides the KV head count, K/V go
+through the all-to-all UNREPEATED at (B, T/c, H_kv, D) — each device
+lands q heads [i·H/c, (i+1)·H/c) and kv heads [i·H_kv/c, (i+1)·H_kv/c),
+whose local group mapping j // (H/H_kv) is exactly the global one — and
+the local flash kernel resolves shared heads in its index maps. Only when
+c does not divide H_kv are KV heads repeated (by the smallest factor that
+restores divisibility, falling back to full H).
+
+Tradeoffs vs the ring (both ship; pick per workload with
+`--context_parallel_impl`):
+  - comm: Ulysses moves q+o at H heads and k+v at H_kv heads once each
+    ((2·H + 2·H_kv)·B·T·D/c per device, all-to-all); the ring moves
+    k+v (c-1) times (2·H_kv·B·T·D·(c-1)/c after its dispatch-side
+    repeat... the ring path repeats KV to H first, so 2·H·B·T·D·(c-1)/c).
+    For c >= 2 and GQA, Ulysses sends strictly less.
+  - compute: Ulysses runs the single-device flash kernel (fast path,
+    fused bwd) on full-T slices; the ring pays the online-softmax
+    combine and lockstep hops but never materializes full T per device.
+  - memory: Ulysses holds full-T activations for H/c heads per device
+    (T scaling bounded by heads); the ring holds only T/c stripes — the
+    ring is the only option when T/c is all that fits.
+  - constraint: c must divide H.
+
+Backward: both all-to-alls are linear — their autodiff transpose is the
+reverse all-to-all, emitted by shard_map/XLA; the local attention brings
+its own custom_vjp. No hand-written backward needed.
+
+Layout contract matches ops.causal_attention: global (B, T, H, D) q and
+(B, T, H_kv, D) k/v under jit, sequence sharded on `axis_name` of the
+ambient (or given) mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.parallel.ring_attention import context_shard_map
+
+
+def _build_body(axis_name):
+    def body(q, k, v):
+        # local stripes: q (B, T/c, H, D), k/v (B, T/c, H_kv, D)
+        c = jax.lax.axis_size(axis_name)
+        H, H_kv = q.shape[2], k.shape[2]
+        assert H % c == 0, (
+            f"ulysses needs context axis ({c}) to divide n_head ({H})"
+        )
+        assert H_kv % c == 0  # wrapper guarantees (repeats otherwise)
+
+        def seq_to_heads(x):
+            # (B, T/c, h, D) -> (B, T, h/c, D)
+            return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                      concat_axis=1, tiled=True)
+
+        def heads_to_seq(x):
+            return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                      concat_axis=2, tiled=True)
+
+        qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+        from avenir_tpu.ops.attention import causal_attention
+
+        # full-sequence causal attention on the local head subset; "auto"
+        # resolves to the Pallas flash kernel on TPU (GQA via its index
+        # maps), the jnp reference on the CPU harness — never back to a
+        # sequence-parallel impl
+        oh = causal_attention(qh, kh, vh, impl="auto")
+        return heads_to_seq(oh)
+
+    return body
+
+
+def ulysses_causal_attention(q, k, v, *, axis_name="context", mesh=None,
+                             sm_scale=None):
+    """Causal attention with the sequence sharded over `axis_name` via
+    head/sequence all-to-alls. q: GLOBAL (B, T, H, D); k/v may be GQA
+    (B, T, H_kv, D). T and H must divide by the axis size. Uses the
+    ambient mesh (jax.set_mesh) when `mesh` is None."""
+    assert sm_scale is None, (
+        "ulysses derives sm_scale from head_dim (the local kernel's "
+        "default); non-default scaling is not supported"
+    )
+    if mesh is not None:
+        c = dict(mesh.shape)[axis_name]
+    else:
+        # under jit only the abstract mesh is queryable
+        c = dict(jax.sharding.get_abstract_mesh().shape)[axis_name]
+    H, H_kv = q.shape[2], k.shape[2]
+    if H_kv % c != 0:
+        # smallest repeat factor restoring divisibility that still divides
+        # the GQA group count; else expand fully to H
+        group = H // H_kv
+        rep = next((r for r in range(2, group + 1)
+                    if group % r == 0 and (H_kv * r) % c == 0), group)
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    body = _build_body(axis_name)
+    return context_shard_map(body, axis_name=axis_name, mesh=mesh)(q, k, v)

@@ -47,15 +47,21 @@ from avenir_tpu.train.step import jit_train_step, make_step_fns
 
 def build_model_factory(cfg, model_args, mesh=None):
     """Return (model_type, config_obj, ctor) for the configured family.
-    A 'context' mesh axis > 1 switches attention to the ring impl
-    (sequence parallelism — parallel/ring_attention.py)."""
+    A 'context' mesh axis > 1 switches attention to a sequence-parallel
+    impl: cfg['context_parallel_impl'] picks 'ring' (default;
+    parallel/ring_attention.py) or 'ulysses' (all-to-all;
+    parallel/ulysses.py — tradeoffs in its docstring)."""
     import dataclasses
 
     mt = cfg["model_type"]
-    ring = mesh is not None and mesh.shape.get("context", 1) > 1
-    if ring:
+    cp = None
+    if mesh is not None and mesh.shape.get("context", 1) > 1:
+        cp = cfg.get("context_parallel_impl", "ring")
+        assert cp in ("ring", "ulysses"), (
+            f"context_parallel_impl must be 'ring' or 'ulysses', got {cp!r}"
+        )
         assert model_args["dropout"] == 0.0, (
-            "ring attention requires dropout=0"
+            f"{cp} attention requires dropout=0"
         )
     if mt == "gpt":
         gcfg = GPTConfig(
@@ -65,7 +71,7 @@ def build_model_factory(cfg, model_args, mesh=None):
             n_embd=model_args["n_embd"], dropout=model_args["dropout"],
             bias=model_args["bias"],
             compute_dtype=("float32" if cfg["dtype"] == "float16" else cfg["dtype"]),
-            attn_impl=("ring" if ring else ("auto" if cfg["use_pallas"] else "xla")),
+            attn_impl=(cp or ("auto" if cfg["use_pallas"] else "xla")),
             remat=cfg["remat"],
             scan_layers=cfg.get("scan_layers", False),
         )
@@ -74,15 +80,15 @@ def build_model_factory(cfg, model_args, mesh=None):
         from avenir_tpu.models.llama import Llama, LlamaConfig
 
         lcfg = LlamaConfig.from_train_config(cfg, model_args)
-        if ring:
-            lcfg = dataclasses.replace(lcfg, attn_impl="ring")
+        if cp:
+            lcfg = dataclasses.replace(lcfg, attn_impl=cp)
         return mt, lcfg, (lambda seed: Llama(lcfg, rngs=nnx.Rngs(seed)))
     if mt == "mixtral":
         from avenir_tpu.models.mixtral import Mixtral, MixtralConfig
 
         mcfg = MixtralConfig.from_train_config(cfg, model_args)
-        if ring:
-            mcfg = dataclasses.replace(mcfg, attn_impl="ring")
+        if cp:
+            mcfg = dataclasses.replace(mcfg, attn_impl=cp)
         return mt, mcfg, (lambda seed: Mixtral(mcfg, rngs=nnx.Rngs(seed)))
     raise ValueError(f"unknown model_type {mt!r}")
 

@@ -87,6 +87,11 @@ mesh_shape = ""  # e.g. "data:4,fsdp:2"; "" → all devices on 'data'
 # mesh_shape the PER-SLICE shape; DCN rides outermost (parallel/mesh.py)
 dcn_mesh_shape = ""
 remat = False  # rematerialize blocks (activation checkpointing)
+# sequence parallelism when mesh has a context axis: "ring" (ppermute KV
+# rotation; O(T/c) memory) or "ulysses" (head/sequence all-to-all; runs the
+# single-device flash kernel per head subset) — tradeoffs in
+# avenir_tpu/parallel/ulysses.py
+context_parallel_impl = "ring"
 scan_layers = False  # lax.scan over blocks (fast compiles for deep models)
 use_pallas = True  # pallas flash attention on TPU (auto-falls back off-TPU)
 fused_adamw = False  # accepted+ignored: XLA-fused optax IS the hot path (BASELINE.md)
