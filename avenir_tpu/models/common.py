@@ -27,17 +27,38 @@ def stacked_layers(n_layer, make_layer, rngs):
     return create(rngs)
 
 
-def scan_layer_stack(x, layers, *, call=None, remat=False):
+def resolve_remat_policy(name):
+    """Map a config string to a jax.checkpoint policy:
+      'nothing' (default) — save only block inputs; full recompute on bwd.
+      'dots'    — save weight-matmul outputs (dots with no batch dims:
+                  qkv/out/mlp projections), recompute elementwise + the
+                  attention custom-call only. ~2x the activation memory of
+                  'nothing' in exchange for skipping most of the remat
+                  forward (measured per-rung in BASELINE.md).
+    """
+    if name in (None, "", "nothing"):
+        return None
+    table = {
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    assert name in table, f"unknown remat_policy {name!r}; one of "\
+                          f"['nothing'] + {sorted(table)}"
+    return table[name]
+
+
+def scan_layer_stack(x, layers, *, call=None, remat=False, remat_policy=None):
     """Run `x` through a stacked layer module via nnx.scan. `call(layer, h)`
     applies one layer (default `layer(h)`); with `remat` the per-layer
     activations are rematerialized on the backward pass (jax.checkpoint per
-    scan step — memory O(1) in depth at the cost of one extra forward)."""
+    scan step — memory O(1) in depth at the cost of recompute governed by
+    `remat_policy`, see resolve_remat_policy)."""
     if call is None:
         call = lambda lyr, h: lyr(h)
 
     def body(h, layer):
         if remat:
-            return nnx.remat(call)(layer, h)
+            return nnx.remat(call, policy=resolve_remat_policy(remat_policy))(
+                layer, h)
         return call(layer, h)
 
     return nnx.scan(body, in_axes=(nnx.Carry, 0), out_axes=nnx.Carry)(

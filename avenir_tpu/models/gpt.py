@@ -34,6 +34,7 @@ from avenir_tpu.models.common import (
     head_major_merge,
     head_major_project,
     resolve_dtype,
+    resolve_remat_policy,
     scan_layer_stack,
     stacked_layers,
     transformer_flops_per_token,
@@ -54,6 +55,9 @@ class GPTConfig:
     compute_dtype: str = "float32"  # 'bfloat16' on TPU; params stay fp32
     attn_impl: str = "auto"  # 'auto' | 'pallas' | 'xla'
     remat: bool = False  # rematerialize each block on the backward pass
+    # what remat saves: 'nothing' (full recompute) or 'dots' (weight-matmul
+    # outputs saved — models/common.py resolve_remat_policy, BASELINE.md)
+    remat_policy: str = "nothing"
     # lax.scan over the L homogeneous blocks: one trace regardless of depth
     # (compile time for the 48-layer 1.5B config, SURVEY.md §3.3). Params
     # are stored stacked (L, ...) under `h_scan`; checkpoint format and
@@ -226,13 +230,17 @@ class GPT(nnx.Module):
                 x, self.h_scan,
                 call=lambda blk, h: blk(h, deterministic=deterministic),
                 remat=self.config.remat,
+                remat_policy=self.config.remat_policy,
             )
         else:
             if self.config.remat:
                 assert self.config.dropout == 0.0 or deterministic, (
                     "remat + dropout rng threading not supported; train with dropout=0"
                 )
-                block_fn = nnx.remat(lambda blk, h: blk(h, deterministic=deterministic))
+                block_fn = nnx.remat(
+                    lambda blk, h: blk(h, deterministic=deterministic),
+                    policy=resolve_remat_policy(self.config.remat_policy),
+                )
             else:
                 block_fn = lambda blk, h: blk(
                     h, deterministic=deterministic, rngs=rngs

@@ -25,6 +25,7 @@ from avenir_tpu.models.common import (
     head_major_merge,
     head_major_project,
     resolve_dtype,
+    resolve_remat_policy,
     scan_layer_stack,
     stacked_layers,
 )
@@ -53,6 +54,7 @@ class LlamaConfig:
     compute_dtype: str = "float32"
     attn_impl: str = "auto"
     remat: bool = False
+    remat_policy: str = "nothing"  # see models/common.py resolve_remat_policy
     scan_layers: bool = False  # lax.scan over stacked layers (see models/gpt.py)
 
     @classmethod
@@ -68,6 +70,7 @@ class LlamaConfig:
             compute_dtype=("float32" if cfg["dtype"] == "float16" else cfg["dtype"]),
             attn_impl=("auto" if cfg["use_pallas"] else "xla"),
             remat=cfg["remat"],
+            remat_policy=cfg.get("remat_policy", "nothing"),
             scan_layers=cfg.get("scan_layers", False),
         )
 
@@ -211,9 +214,13 @@ class Llama(nnx.Module):
             x, stats_sum = scan_layer_stack(
                 (x, stats_sum), self.layers_scan, call=scan_call,
                 remat=self.config.remat,
+                remat_policy=self.config.remat_policy,
             )
         else:
-            layer_fn = nnx.remat(apply) if self.config.remat else apply
+            layer_fn = (nnx.remat(apply,
+                                  policy=resolve_remat_policy(
+                                      self.config.remat_policy))
+                        if self.config.remat else apply)
             for layer in self.layers:
                 x, s = layer_fn(layer, x)
                 stats_sum = jax.tree.map(jnp.add, stats_sum, s)

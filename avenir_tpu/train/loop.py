@@ -73,6 +73,7 @@ def build_model_factory(cfg, model_args, mesh=None):
             compute_dtype=("float32" if cfg["dtype"] == "float16" else cfg["dtype"]),
             attn_impl=(cp or ("auto" if cfg["use_pallas"] else "xla")),
             remat=cfg["remat"],
+            remat_policy=cfg.get("remat_policy", "nothing"),
             scan_layers=cfg.get("scan_layers", False),
         )
         return mt, gcfg, (lambda seed: GPT(gcfg, rngs=nnx.Rngs(seed)))

@@ -19,6 +19,10 @@ max_iters = 300000
 lr_decay_iters = 300000
 weight_decay = 1e-1
 remat = True
+# measured on the 0.57B rung (BASELINE.md): 'dots' (save weight-matmul
+# outputs, recompute elementwise only) is +8% over full recompute and the
+# activations fit alongside the sharded state
+remat_policy = "dots"
 # scan-vs-loop measured head-to-head at the 0.57B on-chip rung (L=16,
 # d=1600, B=4, v5e): loop 22.5k tok/s vs scan 21.1k (~6% — BASELINE.md
 # "scan_layers" section), consistent with the 13% loop win at 124M. Loop

@@ -226,3 +226,25 @@ def test_llama_family_scan_matches_loop(family):
     lb, lossb = scan_model(idx, tgt)
     np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=2e-5)
     np.testing.assert_allclose(float(lossa), float(lossb), atol=1e-6)
+
+
+@pytest.mark.parametrize("scan", [False, True])
+def test_remat_policy_dots_matches_nothing(char_dataset, tmp_path, scan):
+    """remat_policy only changes WHAT the backward recomputes, never the
+    math: loss trajectories are identical across policies."""
+    from tests.test_train_tpu import make_cfg
+
+    from avenir_tpu.train.loop import run_training
+
+    common = dict(max_iters=4, remat=True, eval_interval=50,
+                  mesh_shape="data:1", scan_layers=scan)
+    ref = run_training(make_cfg(char_dataset["dir"], tmp_path / "o1",
+                                remat_policy="nothing", **common))
+    got = run_training(make_cfg(char_dataset["dir"], tmp_path / "o2",
+                                remat_policy="dots", **common))
+    # not bit-equal: saved-vs-recomputed values land in different XLA
+    # fusions whose accumulation order differs in the last ulp
+    np.testing.assert_allclose(
+        [l for _, l in ref["loss_history"]],
+        [l for _, l in got["loss_history"]], rtol=1e-5, atol=1e-5,
+    )

@@ -104,6 +104,7 @@ def main():
         scan_override = args["scan"] in ("1", "True", "true")
     # per-rung default unless --scan was passed
     scan = lambda default: default if scan_override is None else scan_override
+    remat_policy = args.get("remat_policy", "nothing")
 
     if which in ("all", "1p5b"):
         # GPT-2 1.5B shape: d=1600, 25 heads (BASELINE.json:9). Full 48
@@ -114,7 +115,8 @@ def main():
             dict(block_size=T, vocab_size=50304, n_layer=L, n_head=h,
                  n_embd=d, dropout=0.0, bias=True, compute_dtype="bfloat16",
                  attn_impl="pallas",
-                 scan_layers=scan(True), remat=True),
+                 scan_layers=scan(True), remat=True,
+                 remat_policy=remat_policy),
             batch=batch_override or 4, steps=steps,
         )
 
@@ -125,7 +127,8 @@ def main():
     llama_shape = dict(vocab_size=16384, n_layer=2, n_head=32, n_kv_head=8,
                        n_embd=4096, ffn_hidden=14336, rope_theta=500000.0,
                        compute_dtype="bfloat16", attn_impl="pallas",
-                       scan_layers=scan(True), remat=True)
+                       scan_layers=scan(True), remat=True,
+                       remat_policy=remat_policy)
 
     if which in ("all", "llama8b"):
         # T=4096: single-KV-block fast path (fused bwd)
@@ -157,7 +160,8 @@ def main():
                  n_experts_per_tok=K, capacity_factor=1.25,
                  rope_theta=10000.0, compute_dtype="bfloat16",
                  attn_impl="pallas",
-                 scan_layers=scan(False), remat=True),
+                 scan_layers=scan(False), remat=True,
+                 remat_policy=remat_policy),
             batch=batch_override or 4, steps=steps,
             # MFU on ACTIVE params: subtract the (E-K) unrouted experts
             active_params=lambda n: n - L * 3 * d * ffn * (E - K),

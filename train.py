@@ -87,6 +87,9 @@ mesh_shape = ""  # e.g. "data:4,fsdp:2"; "" → all devices on 'data'
 # mesh_shape the PER-SLICE shape; DCN rides outermost (parallel/mesh.py)
 dcn_mesh_shape = ""
 remat = False  # rematerialize blocks (activation checkpointing)
+# remat recompute granularity: 'nothing' (save block inputs only) or 'dots'
+# (save weight-matmul outputs; ~2x activation memory, skips most recompute)
+remat_policy = "nothing"
 # sequence parallelism when mesh has a context axis: "ring" (ppermute KV
 # rotation; O(T/c) memory) or "ulysses" (head/sequence all-to-all; runs the
 # single-device flash kernel per head subset) — tradeoffs in
