@@ -672,13 +672,18 @@ def _build_flash(seq_len, causal, sm_scale, block_q, block_k, interpret,
 
 # Default (block_q, block_k, block_q_bwd); overridable via
 # AVENIR_FLASH_BLOCKS="bq,bk,bqb" for sweeps (tools/bench_sweep.py).
-# Values are the v5e real-train-step sweep winners (BASELINE.md).
+# Values are the v5e real-train-step sweep winners at GPT shapes (D=64);
+# when the env is NOT set, fast-path shapes with D >= 128 get q blocks of
+# 256 instead — the Llama-rung sweep winner (D=128 tiles half as many q
+# rows per VMEM byte; 256,1024,256 measured 29.1k tok/s vs 28.1k at the
+# GPT defaults, BASELINE.md "Llama-shape block sweep").
+_ENV_BLOCKS = os.environ.get("AVENIR_FLASH_BLOCKS") or None
 _DEFAULT_BLOCKS = tuple(
-    int(x) for x in os.environ.get("AVENIR_FLASH_BLOCKS", "512,1024,512").split(",")
+    int(x) for x in (_ENV_BLOCKS or "512,1024,512").split(",")
 )
 assert len(_DEFAULT_BLOCKS) == 3, (
     f"AVENIR_FLASH_BLOCKS must be 'block_q,block_k,block_q_bwd', got "
-    f"{os.environ.get('AVENIR_FLASH_BLOCKS')!r}"
+    f"{_ENV_BLOCKS!r}"
 )
 
 
@@ -699,19 +704,14 @@ def flash_attention(q, k, v, *, causal=True, sm_scale=None, block_q=None,
 
     Sequences with padded length <= _FAST_PATH_MAX_T dispatch to the
     single-KV-block kernels; longer ones stream KV blocks through the grid
-    with the online-softmax carry. Default block sizes are the v5e sweep
-    winner for GPT-2 shapes (BASELINE.md attention table); both clamp to
-    the padded sequence. `block_q_bwd` sizes the fused backward's q blocks
-    independently (fast path only; the blocked path shares block_q).
+    with the online-softmax carry. Default block sizes are D-adaptive v5e
+    sweep winners: 512/1024/512 at GPT shapes (D=64), 256-row q blocks
+    (fwd + bwd) for fast-path shapes with D >= 128 (the Llama-rung
+    winner, BASELINE.md "Llama-shape block sweep"); explicit args or
+    AVENIR_FLASH_BLOCKS override. All clamp to the padded sequence.
+    `block_q_bwd` sizes the fused backward's q blocks independently
+    (fast path only; the blocked path shares block_q).
     """
-    if block_q_bwd is None:
-        # an explicit block_q governs the backward too (the old contract);
-        # only the all-defaults call takes the swept bwd size
-        block_q_bwd = _DEFAULT_BLOCKS[2] if block_q is None else block_q
-    if block_q is None:
-        block_q = _DEFAULT_BLOCKS[0]
-    if block_k is None:
-        block_k = _DEFAULT_BLOCKS[1]
     assert layout in ("bthd", "bhtd"), f"unknown layout {layout!r}"
     if layout == "bhtd":
         B, H, T, D = q.shape
@@ -719,6 +719,21 @@ def flash_attention(q, k, v, *, causal=True, sm_scale=None, block_q=None,
     else:
         B, T, H, D = q.shape
         H_kv = k.shape[2]
+    # D-adaptive q blocks on the fast path (see _DEFAULT_BLOCKS note); an
+    # explicit arg or the env override always wins
+    wide_fast = (_ENV_BLOCKS is None and D >= 128
+                 and T <= _FAST_PATH_MAX_T)
+    if block_q_bwd is None:
+        # an explicit block_q governs the backward too (the old contract);
+        # only the all-defaults call takes the swept bwd size
+        if block_q is not None:
+            block_q_bwd = block_q
+        else:
+            block_q_bwd = 256 if wide_fast else _DEFAULT_BLOCKS[2]
+    if block_q is None:
+        block_q = 256 if wide_fast else _DEFAULT_BLOCKS[0]
+    if block_k is None:
+        block_k = _DEFAULT_BLOCKS[1]
     assert H % H_kv == 0, f"n_head {H} not divisible by n_kv_head {H_kv}"
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)

@@ -121,12 +121,14 @@ def test_flash_attention_bf16_close_to_fp32_oracle():
     )
 
 
-def test_flash_attention_default_blocks_odd_seq(fa_path):
+@pytest.mark.parametrize("D", [64, 128])
+def test_flash_attention_default_blocks_odd_seq(fa_path, D):
     """Regression: with the production default block sizes and a sequence
     length in (block_q, block_k) — e.g. 600 — every q row must be written
     (round-2 bug: Tp was not padded to a multiple of both block sizes, so
-    rows past nq*block_q came back uninitialized/NaN)."""
-    q, k, v = _qkv(B=1, T=600, H=1)
+    rows past nq*block_q came back uninitialized/NaN). D=128 additionally
+    exercises the D-adaptive 256-row default branch."""
+    q, k, v = _qkv(B=1, T=600, H=1, D=D)
     out = flash_attention(q, k, v, causal=True, interpret=True)
     ref = causal_attention_reference(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
