@@ -121,7 +121,8 @@ def _tied(model_family):
 
 
 def save_checkpoint(out_dir, *, params, opt_state, hyper, model_args,
-                    iter_num, best_val_loss, config, model_family="gpt"):
+                    iter_num, best_val_loss, config, model_family="gpt",
+                    _filename="ckpt.pt"):
     """Write out_dir/ckpt.pt in the torch schema. `params` is the nnx Param
     State; `opt_state` the optax state; `hyper` carries the torch
     param_group hyperparams (lr, betas, eps, weight_decay).
@@ -195,10 +196,73 @@ def save_checkpoint(out_dir, *, params, opt_state, hyper, model_args,
     }
     # every process materializes (collective per-leaf gathers); only the
     # coordinator writes the file
+    # atomic: stream to .part, then rename — a crash or SIGKILL mid-write
+    # (preemption grace periods end in SIGKILL) never destroys the
+    # previous good checkpoint
     write = jax.process_index() == 0
+    path = os.path.join(out_dir, _filename)
     if write:
         os.makedirs(out_dir, exist_ok=True)
-    save_pt(ckpt, os.path.join(out_dir, "ckpt.pt"), write=write)
+    save_pt(ckpt, path + ".part", write=write)
+    if write:
+        os.replace(path + ".part", path)
+
+
+class AsyncCheckpoint:
+    """In-flight background save. `join()` re-raises any writer exception;
+    at most one should be in flight (the training loop joins the previous
+    before starting the next)."""
+
+    def __init__(self, thread):
+        self._thread = thread
+        self.error = None
+
+    def join(self):
+        self._thread.join()
+        if self.error is not None:
+            raise self.error
+
+    def done(self):
+        return not self._thread.is_alive()
+
+
+def save_checkpoint_async(out_dir, *, params, opt_state, **kw):
+    """save_checkpoint in a daemon thread, single-process only.
+
+    The params/opt trees are SNAPSHOT with device-side copies on the
+    calling thread first — the training step donates its state buffers,
+    and a donated buffer is deleted out from under any lingering Python
+    reference (holding the original tree is NOT a snapshot; learned the
+    hard way: "Buffer has been deleted or donated"). The copies cost one
+    transient params+moments footprint in HBM while the save is in
+    flight. Crash-safety comes from save_checkpoint's own
+    .part-then-rename atomicity.
+
+    Multi-process saves gather collectively on every process and CANNOT
+    run from a thread (the thread's collectives would race the training
+    step's); callers must use the synchronous save on pods."""
+    import threading
+
+    import jax.numpy as jnp
+
+    assert jax.process_count() == 1, (
+        "save_checkpoint_async is single-process only (multi-process saves "
+        "issue collective gathers that must run on the main thread)"
+    )
+    params = jax.tree.map(jnp.copy, params)
+    opt_state = jax.tree.map(jnp.copy, opt_state)
+
+    def run():
+        try:
+            save_checkpoint(out_dir, params=params, opt_state=opt_state,
+                            **kw)
+        except BaseException as e:  # noqa: BLE001 — surfaced via join()
+            handle.error = e
+
+    t = threading.Thread(target=run, name="avenir-async-ckpt", daemon=True)
+    handle = AsyncCheckpoint(t)
+    t.start()
+    return handle
 
 
 def load_checkpoint(out_dir, lazy=False):

@@ -327,6 +327,54 @@ def run_training(cfg):
     profile_started = False
     loss_history = []  # (iter, loss) at log cadence; returned for tests/tools
 
+    # async checkpointing (single-process only: multi-process saves gather
+    # collectively and must stay on the main thread — checkpoint/io.py).
+    # Training continues while a daemon thread streams the held snapshot
+    # to ckpt.pt.tmp and atomically renames; jax copies any donated buffer
+    # the snapshot still references, so consistency is automatic.
+    use_async_ckpt = bool(cfg.get("async_checkpoint", False)) \
+        and jax.process_count() == 1
+    pending_ckpt = [None]
+
+    def do_save(lr_now, it, sync=False):
+        from avenir_tpu.checkpoint.io import save_checkpoint_async
+
+        kw = dict(
+            params=params, opt_state=opt_state,
+            hyper={"lr": lr_now, "betas": (cfg["beta1"], cfg["beta2"]),
+                   "eps": 1e-8, "weight_decay": cfg["weight_decay"]},
+            model_args=model_args, iter_num=it,
+            best_val_loss=best_val_loss, config=cfg,
+            model_family=st["model_type"],
+        )
+        if pending_ckpt[0] is not None:
+            # one save in flight at a time — and a sync save must never
+            # race a background writer's rename of the same file
+            pending_ckpt[0].join()
+            pending_ckpt[0] = None
+        if use_async_ckpt and not sync:
+            pending_ckpt[0] = save_checkpoint_async(cfg["out_dir"], **kw)
+        else:
+            save_checkpoint(cfg["out_dir"], **kw)
+
+    # graceful preemption (SURVEY §5 failure/recovery): SIGTERM sets a
+    # flag; the loop finishes the in-flight iteration, saves, and exits
+    # cleanly so a relaunch resumes from the latest state. Registered on
+    # the main thread only; pods get the same behavior per-process (the
+    # save itself is collective and runs on the main thread).
+    import signal
+
+    preempted = [False]
+    _prev_handler = None
+
+    def _on_sigterm(signum, frame):
+        preempted[0] = True
+
+    try:
+        _prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:  # not on the main thread (embedded use): skip
+        _prev_handler = None
+
     try:
         while True:
             lr = float(lr_schedule(iter_num)) if cfg["decay_lr"] else cfg["learning_rate"]
@@ -354,18 +402,10 @@ def run_training(cfg):
                     best_val_loss = min(best_val_loss, losses["val"])
                     if iter_num > 0:
                         if master:
-                            print(f"saving checkpoint to {cfg['out_dir']}")
+                            print(f"saving checkpoint to {cfg['out_dir']}"
+                                  + (" (async)" if use_async_ckpt else ""))
                         with jax.profiler.TraceAnnotation("checkpoint"):
-                            save_checkpoint(
-                                cfg["out_dir"], params=params, opt_state=opt_state,
-                                hyper={"lr": lr,
-                                       "betas": (cfg["beta1"], cfg["beta2"]),
-                                       "eps": 1e-8,
-                                       "weight_decay": cfg["weight_decay"]},
-                                model_args=model_args, iter_num=iter_num,
-                                best_val_loss=best_val_loss, config=cfg,
-                                model_family=st["model_type"],
-                            )
+                            do_save(lr, iter_num)
             if iter_num == 0 and cfg["eval_only"]:
                 break
 
@@ -414,6 +454,22 @@ def run_training(cfg):
                       f"mfu {running_mfu * 100:.2f}%")
             iter_num += 1
             local_iter_num += 1
+            if preempted[0]:
+                # single-process: save before exiting. Multi-process: the
+                # signal lands at different iterations on different
+                # processes, so a collective save here would interleave
+                # with other processes' step collectives and deadlock —
+                # exit cleanly and rely on the eval-cadence checkpoint.
+                if jax.process_count() == 1:
+                    if master:
+                        print(f"SIGTERM: saving checkpoint at iter "
+                              f"{iter_num} and exiting cleanly")
+                    do_save(lr, iter_num, sync=True)
+                elif master:
+                    print(f"SIGTERM at iter {iter_num}: exiting cleanly "
+                          "(multi-process: resume from the last "
+                          "eval-cadence checkpoint)")
+                break
             if iter_num > cfg["max_iters"]:
                 break
     finally:
@@ -423,6 +479,12 @@ def run_training(cfg):
             jax.block_until_ready(metrics["loss"])
             jax.profiler.stop_trace()
             profile_started = False
+        # restore the handler FIRST: if the join re-raises a writer
+        # error, the process must not keep the no-op SIGTERM handler
+        if _prev_handler is not None:
+            signal.signal(signal.SIGTERM, _prev_handler)
+        if pending_ckpt[0] is not None:
+            pending_ckpt[0].join()  # never exit with a half-written file
 
     return {
         "iter_num": iter_num, "best_val_loss": float(best_val_loss),

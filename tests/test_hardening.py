@@ -238,3 +238,60 @@ def test_profile_trace_stopped_on_early_exit(char_dataset, tmp_path):
     cfg2 = make_cfg(char_dataset["dir"], tmp_path / "out2", max_iters=12,
                     profile=True, eval_interval=50, mesh_shape="data:1")
     run_training(cfg2)
+
+
+def test_sigterm_graceful_save_and_resume(char_dataset, tmp_path):
+    """Preemption handling: SIGTERM makes the loop finish the in-flight
+    iteration, save a checkpoint, and exit 0; the run then resumes."""
+    out = str(tmp_path / "out")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    proc = subprocess.Popen(
+        _tpu_cli(char_dataset, out, max_iters=500, eval_interval=1000),
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        deadline = time.time() + 300
+        for line in proc.stdout:
+            if "iter 3" in line:
+                break
+            assert time.time() < deadline, "trainer never reached iter 3"
+        proc.send_signal(signal.SIGTERM)
+        rest = proc.stdout.read()
+        proc.wait(timeout=120)
+        assert proc.returncode == 0, rest
+        assert "SIGTERM: saving checkpoint" in rest
+        assert os.path.exists(os.path.join(out, "ckpt.pt"))
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    r = subprocess.run(
+        _tpu_cli(char_dataset, out, max_iters=8, init_from="resume"),
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "resuming" in r.stdout
+
+
+def test_async_checkpoint_resumable(char_dataset, tmp_path):
+    """--async_checkpoint=True: saves land from the background thread
+    (atomic rename — no .tmp left behind), and the result resumes."""
+    out = str(tmp_path / "out")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    r = subprocess.run(
+        _tpu_cli(char_dataset, out, max_iters=7, async_checkpoint=True),
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "(async)" in r.stdout
+    assert os.path.exists(os.path.join(out, "ckpt.pt"))
+    assert not os.path.exists(os.path.join(out, "ckpt.pt.part"))
+
+    r2 = subprocess.run(
+        _tpu_cli(char_dataset, out, max_iters=10, init_from="resume",
+                 async_checkpoint=True),
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "iter 10" in r2.stdout

@@ -99,6 +99,9 @@ scan_layers = False  # lax.scan over blocks (fast compiles for deep models)
 use_pallas = True  # pallas flash attention on TPU (auto-falls back off-TPU)
 fused_adamw = False  # accepted+ignored: XLA-fused optax IS the hot path (BASELINE.md)
 profile = False  # capture a jax.profiler trace window
+# save checkpoints from a background thread (single-process only; training
+# continues while the snapshot streams to ckpt.pt.tmp, atomically renamed)
+async_checkpoint = False
 # accept silent replication of param dims the mesh doesn't divide (e.g. an
 # unpadded char vocab on tensor:2); default is a hard error (fail-loud)
 allow_unsharded_fallback = False
