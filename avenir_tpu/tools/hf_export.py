@@ -1,0 +1,150 @@
+"""HF-format export: an avenir model (or ckpt.pt) -> a directory that
+`transformers.*ForCausalLM.from_pretrained` loads directly
+(config.json + model.safetensors). The inverse of tools/hf_import.py —
+together they close the ecosystem round trip: import HF weights, train
+on TPU, export back for anyone downstream.
+
+Layout notes (mirror of the import path):
+  - Llama/Mixtral: HF stores torch-Linear (out, in) — exactly what
+    checkpoint/bridge.py's export_torch_state_dict emits. Keys match the
+    HF module tree by construction (the models were named for it).
+  - GPT-2: HF uses Conv1D ((in, out) storage) for the four projection
+    weights, the transpose of the torch reference layout — re-transposed
+    here (inverse of hf_import.hf_sd_to_torch_layout).
+
+CLI: python -m avenir_tpu.tools.hf_export --out_dir=<train out_dir> \
+        --dest=<hf dir>
+reads out_dir/ckpt.pt (either backend's) and writes the HF directory.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from avenir_tpu.checkpoint.bridge import export_torch_state_dict
+
+# inverse of hf_import._CONV1D_SUFFIXES (GPT-2 only)
+_CONV1D_SUFFIXES = (
+    "attn.c_attn.weight", "attn.c_proj.weight",
+    "mlp.c_fc.weight", "mlp.c_proj.weight",
+)
+
+
+def _gpt2_hf_config(ma):
+    return {
+        "architectures": ["GPT2LMHeadModel"],
+        "model_type": "gpt2",
+        "vocab_size": ma["vocab_size"],
+        "n_positions": ma["block_size"], "n_ctx": ma["block_size"],
+        "n_embd": ma["n_embd"], "n_layer": ma["n_layer"],
+        "n_head": ma["n_head"],
+        "activation_function": "gelu_new",
+        "layer_norm_epsilon": 1e-5,
+        "tie_word_embeddings": True,
+    }
+
+
+def _llama_hf_config(ma, family):
+    cfg = {
+        "architectures": ["LlamaForCausalLM" if family == "llama"
+                          else "MixtralForCausalLM"],
+        "model_type": family,
+        "vocab_size": ma["vocab_size"],
+        "max_position_embeddings": ma["block_size"],
+        "hidden_size": ma["n_embd"],
+        "intermediate_size": ma["ffn_hidden"],
+        "num_hidden_layers": ma["n_layer"],
+        "num_attention_heads": ma["n_head"],
+        "num_key_value_heads": ma["n_kv_head"],
+        "rope_theta": ma.get("rope_theta", 10000.0),
+        "rms_norm_eps": ma.get("norm_eps", 1e-5),
+        "hidden_act": "silu",
+        "tie_word_embeddings": False,
+        "attention_bias": False, "mlp_bias": False,
+    }
+    if family == "mixtral":
+        cfg.update(
+            num_local_experts=ma["n_experts"],
+            num_experts_per_tok=ma["n_experts_per_tok"],
+            router_aux_loss_coef=ma.get("router_aux_loss_coef", 0.02),
+            sliding_window=None,
+        )
+    return cfg
+
+
+def export_hf(dest, *, params_or_model, model_args, model_family="gpt"):
+    """Write `dest/config.json` + `dest/model.safetensors` from nnx params
+    (Module, Param State, or a host-numpy state dict in torch layout)."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(dest, exist_ok=True)
+    if isinstance(params_or_model, dict):
+        sd = dict(params_or_model)  # already torch-layout {key: np}
+    else:
+        sd = export_torch_state_dict(
+            params_or_model, model_family=model_family,
+            tied_lm_head=(model_family == "gpt"),
+        )
+    if model_family == "gpt":
+        hf_cfg = _gpt2_hf_config(model_args)
+        out = {}
+        for k, v in sd.items():
+            v = np.asarray(v)
+            if k == "lm_head.weight":
+                continue  # tied: HF re-derives the alias from wte
+            if k.startswith("transformer."):
+                k = k[len("transformer."):]
+            if any(k.endswith(s) for s in _CONV1D_SUFFIXES):
+                v = np.ascontiguousarray(v.T)  # torch Linear -> HF Conv1D
+            out["transformer." + k] = v
+        sd = out
+    else:
+        hf_cfg = _llama_hf_config(model_args, model_family)
+        sd = {k: np.ascontiguousarray(np.asarray(v)) for k, v in sd.items()}
+
+    with open(os.path.join(dest, "config.json"), "w") as f:
+        json.dump(hf_cfg, f, indent=2)
+    save_file(sd, os.path.join(dest, "model.safetensors"))
+    return dest
+
+
+def export_hf_from_ckpt(out_dir, dest):
+    """Convert out_dir/ckpt.pt (either backend's) to an HF directory."""
+    from avenir_tpu.checkpoint.io import load_checkpoint
+
+    ckpt = load_checkpoint(out_dir)
+    family = ckpt.get("model_family", "gpt")
+    ma = dict(ckpt["model_args"])
+    if family in ("llama", "mixtral"):
+        # the family extras live in the train config, not model_args
+        # (sampling.py reconstructs configs the same way); resolve exactly
+        # as LlamaConfig.from_train_config does
+        from avenir_tpu.models.llama import default_ffn_hidden
+
+        cfg = ckpt.get("config", {})
+        ma.setdefault("n_kv_head", cfg.get("n_kv_head", 0) or ma["n_head"])
+        ma.setdefault("ffn_hidden", cfg.get("ffn_hidden", 0)
+                      or default_ffn_hidden(ma["n_embd"]))
+        ma.setdefault("rope_theta", cfg.get("rope_theta", 10000.0))
+        if family == "mixtral":
+            ma.setdefault("n_experts", cfg.get("n_experts", 8))
+            ma.setdefault("n_experts_per_tok", cfg.get("n_experts_per_tok", 2))
+            ma.setdefault("router_aux_loss_coef",
+                          cfg.get("router_aux_loss_coef", 0.02))
+    sd = {k: np.asarray(v) for k, v in ckpt["model"].items()}
+    return export_hf(dest, params_or_model=sd, model_args=ma,
+                     model_family=family)
+
+
+if __name__ == "__main__":
+    import sys
+
+    args = {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
+            for a in sys.argv[1:]}
+    assert "out_dir" in args and "dest" in args, (
+        "usage: python -m avenir_tpu.tools.hf_export --out_dir=<dir> "
+        "--dest=<hf dir>"
+    )
+    export_hf_from_ckpt(args["out_dir"], args["dest"])
+    print(f"wrote {args['dest']}/config.json + model.safetensors")
