@@ -22,6 +22,14 @@ import jax.numpy as jnp
 
 _NEG_INF = float("-inf")
 
+# Mesh-axis conventions live in ONE place (parallel/partition.py): batch
+# shards over the data-like axes, attention heads over the TP axis
+# (c_attn is column-parallel, so heads land tensor-sharded).
+from avenir_tpu.parallel.partition import (  # noqa: E402
+    BATCH_AXES as _BATCH_AXES,
+    TP_AXIS as _HEAD_AXIS,
+)
+
 
 def _on_tpu() -> bool:
     """True when jit traces will lower to TPU. Safe to call at trace time
@@ -89,6 +97,52 @@ def resolve_attention_impl(impl, *, use_dropout=False, segment_ids=None):
     return "xla"
 
 
+def _flash_shard_specs(layout, q_shape, h, h_kv):
+    """PartitionSpec (shared by q/k/v/out — head entries name the same
+    axis for H and H_kv dims) for running the Pallas flash kernel under
+    SPMD, or None when no wrap is needed.
+
+    GSPMD has NO partitioning rule for the pallas_call custom call: on an
+    8-device data:2,fsdp:2,tensor:2 mesh the jitted kernel compiles with
+    33 all-gathers and returns a fully REPLICATED output (measured on the
+    CPU harness, VERDICT r3 item 1) — every operand is dragged to every
+    device. Flash attention is embarrassingly parallel over batch and
+    heads, so the dispatcher wraps the kernel in jax.shard_map over
+    whichever of those mesh axes exist, divide the dims, and are not
+    already Manual (i.e. we're not inside an enclosing shard_map body such
+    as ulysses's — there the local kernel must stay local)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return None
+    from jax.sharding import AxisType
+
+    sizes = dict(mesh.shape)
+    free = {
+        n: sizes[n]
+        for n, t in zip(mesh.axis_names, mesh.axis_types)
+        if sizes[n] > 1 and t != AxisType.Manual
+    }
+    if not free:
+        return None
+    b = q_shape[0]
+    batch_axes = [a for a in _BATCH_AXES if a in free]
+    while batch_axes and b % math.prod(free[a] for a in batch_axes):
+        batch_axes.pop()  # drop innermost-listed first (expert, then fsdp)
+    t = free.get(_HEAD_AXIS, 1)
+    # both H and H_kv must divide: shard i then holds q heads
+    # [i·H/t, (i+1)·H/t) and kv heads [i·H_kv/t, (i+1)·H_kv/t), and the
+    # kernels' local group map h // (H/H_kv) coincides with the global one
+    head = _HEAD_AXIS if t > 1 and h % t == 0 and h_kv % t == 0 else None
+    if not batch_axes and head is None:
+        return None
+    b_entry = tuple(batch_axes) if batch_axes else None
+    from jax.sharding import PartitionSpec as P
+
+    if layout == "bhtd":
+        return P(b_entry, head, None, None)
+    return P(b_entry, None, head, None)
+
+
 def causal_attention(q, k, v, *, dropout_rate=0.0, deterministic=True,
                      dropout_rng=None, impl="auto", segment_ids=None,
                      layout="bthd"):
@@ -147,7 +201,22 @@ def causal_attention(q, k, v, *, dropout_rate=0.0, deterministic=True,
         assert segment_ids is None, "pallas flash attention does not take segment_ids"
         from avenir_tpu.ops.pallas.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True, layout=layout)
+        # Mosaic only lowers on TPU; everywhere else (the 8-CPU test
+        # harness, the driver's virtual-device dryrun) the kernel runs in
+        # interpret mode — same trace, emulated execution.
+        interpret = not _on_tpu()
+        spec = _flash_shard_specs(layout, q.shape, q.shape[h_axis],
+                                  k.shape[h_axis])
+        if spec is not None:
+            body = lambda ql, kl, vl: flash_attention(
+                ql, kl, vl, causal=True, layout=layout, interpret=interpret
+            )
+            return jax.shard_map(
+                body, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False,
+            )(q, k, v)
+        return flash_attention(q, k, v, causal=True, layout=layout,
+                               interpret=interpret)
     if impl == "jax_ref":
         # upstream jax.experimental TPU flash kernel — calibration yardstick
         # for ours (`python bench.py --attn=jax_ref`), not a product path

@@ -149,6 +149,14 @@ def sanitize_specs(spec_by_path, shapes, mesh, *, strict=False, log=None):
     return out
 
 
+# THE one home for "which axes act as data parallelism on activations"
+# and "which axis shards attention heads" — batch_pspec, the sequence-
+# parallel shard_maps (ring_attention.context_shard_map), and the pallas
+# SPMD wrap (ops/attention._flash_shard_specs) all derive from these.
+BATCH_AXES = ("data", "fsdp", "expert")
+TP_AXIS = "tensor"
+
+
 def batch_pspec(with_accum: bool = True) -> P:
     """Global batch layout: batch dim sharded over every data-parallel-like
     axis — 'expert' is a data axis outside the MoE blocks (the standard EP
@@ -156,7 +164,7 @@ def batch_pspec(with_accum: bool = True) -> P:
     all-to-alls over ICI, BASELINE.json:11) — sequence dim over 'context'
     (ring attention). `with_accum`: leading unsharded grad-accumulation
     axis (train batches are (accum, B, T); eval batches are (B, T))."""
-    per_batch = (("data", "fsdp", "expert"), "context")
+    per_batch = (BATCH_AXES, "context")
     return P(None, *per_batch) if with_accum else P(*per_batch)
 
 

@@ -181,7 +181,9 @@ def context_shard_map(body, *, axis_name, mesh=None, n_in=3):
     (ring + ulysses): batch dims ride the data-like axes, the sequence
     dim rides `axis_name`, heads/head_dim replicated. ONE home for the
     spec so the two impls cannot drift."""
-    spec = P(("data", "fsdp", "expert"), axis_name, None, None)
+    from avenir_tpu.parallel.partition import BATCH_AXES
+
+    spec = P(BATCH_AXES, axis_name, None, None)
     kwargs = dict(in_specs=(spec,) * n_in, out_specs=spec, check_vma=False)
     if mesh is not None:
         kwargs["mesh"] = mesh

@@ -63,6 +63,14 @@ def build_model_factory(cfg, model_args, mesh=None):
         assert model_args["dropout"] == 0.0, (
             f"{cp} attention requires dropout=0"
         )
+        # the attn_impl hard override promises "never falls back silently"
+        # (train.py): a context>1 mesh replacing it with ring/ulysses would
+        # break that promise — make the conflict loud instead
+        assert not cfg.get("attn_impl") or cfg["attn_impl"] == cp, (
+            f"attn_impl={cfg['attn_impl']!r} conflicts with a context:"
+            f"{mesh.shape['context']} mesh (sequence-parallel attention "
+            f"{cp!r} is required there); drop --attn_impl or set it to {cp!r}"
+        )
     if mt == "gpt":
         gcfg = GPTConfig(
             block_size=model_args["block_size"],
@@ -71,7 +79,8 @@ def build_model_factory(cfg, model_args, mesh=None):
             n_embd=model_args["n_embd"], dropout=model_args["dropout"],
             bias=model_args["bias"],
             compute_dtype=("float32" if cfg["dtype"] == "float16" else cfg["dtype"]),
-            attn_impl=(cp or ("auto" if cfg["use_pallas"] else "xla")),
+            attn_impl=(cp or cfg.get("attn_impl")
+                       or ("auto" if cfg["use_pallas"] else "xla")),
             remat=cfg["remat"],
             remat_policy=cfg.get("remat_policy", "nothing"),
             scan_layers=cfg.get("scan_layers", False),
@@ -81,15 +90,15 @@ def build_model_factory(cfg, model_args, mesh=None):
         from avenir_tpu.models.llama import Llama, LlamaConfig
 
         lcfg = LlamaConfig.from_train_config(cfg, model_args)
-        if cp:
-            lcfg = dataclasses.replace(lcfg, attn_impl=cp)
+        if cp or cfg.get("attn_impl"):
+            lcfg = dataclasses.replace(lcfg, attn_impl=cp or cfg["attn_impl"])
         return mt, lcfg, (lambda seed: Llama(lcfg, rngs=nnx.Rngs(seed)))
     if mt == "mixtral":
         from avenir_tpu.models.mixtral import Mixtral, MixtralConfig
 
         mcfg = MixtralConfig.from_train_config(cfg, model_args)
-        if cp:
-            mcfg = dataclasses.replace(mcfg, attn_impl=cp)
+        if cp or cfg.get("attn_impl"):
+            mcfg = dataclasses.replace(mcfg, attn_impl=cp or cfg["attn_impl"])
         return mt, mcfg, (lambda seed: Mixtral(mcfg, rngs=nnx.Rngs(seed)))
     raise ValueError(f"unknown model_type {mt!r}")
 

@@ -1,0 +1,154 @@
+"""Pallas flash attention under multi-device SPMD (VERDICT r3 item 1).
+
+GSPMD has no partitioning rule for a pallas_call custom call: without the
+dispatcher's shard_map wrap, the jitted kernel on an 8-device mesh compiles
+with ~33 all-gathers and a REPLICATED output (measured; see
+ops/attention._flash_shard_specs). These tests pin the wrap's three
+contracts on the 8-fake-CPU-device harness (interpret-mode kernels, real
+meshes, real GSPMD):
+
+  1. the compiled HLO around the kernel contains NO all-gather and the
+     output keeps the input sharding (batch over data/fsdp, heads over
+     tensor) — MHA and GQA;
+  2. numerics match the jnp reference (fwd and grads) under the mesh;
+  3. the full product training loop (attn_impl="pallas") follows the
+     single-device trajectory on data/fsdp and fsdp/tensor meshes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from avenir_tpu.ops.attention import (
+    _flash_shard_specs,
+    causal_attention,
+    causal_attention_reference,
+)
+from avenir_tpu.parallel.mesh import make_mesh
+
+
+def _sharded_qkv(mesh, B, H, H_kv, T, D, dtype=np.float32):
+    rng = np.random.default_rng(0)
+    sh_q = NamedSharding(mesh, P(("data", "fsdp"), "tensor", None, None))
+    mk = lambda h: jax.device_put(
+        jnp.asarray(rng.standard_normal((B, h, T, D)).astype(dtype)), sh_q
+    )
+    return mk(H), mk(H_kv), mk(H_kv)
+
+
+@pytest.mark.parametrize("H,H_kv", [(4, 4), (4, 2)])
+def test_pallas_spmd_partitioned_and_correct(H, H_kv):
+    """data:2,fsdp:2,tensor:2 — the product GPT mesh shape. The custom
+    call must stay partitioned (zero all-gathers in the whole fwd+bwd
+    module) and fwd/grads must match the jnp reference."""
+    mesh = make_mesh("data:2,fsdp:2,tensor:2")
+    jax.set_mesh(mesh)
+    B, T, D = 8, 128, 32
+    q, k, v = _sharded_qkv(mesh, B, H, H_kv, T, D)
+
+    def loss(q, k, v):
+        o = causal_attention(q, k, v, impl="pallas", layout="bhtd")
+        return jnp.sum(o * o), o
+
+    f = jax.jit(jax.grad(loss, argnums=(0, 1, 2), has_aux=True))
+    hlo = f.lower(q, k, v).compile().as_text()
+    assert hlo.count("all-gather") == 0, (
+        "pallas custom call was not partitioned — GSPMD inserted "
+        f"{hlo.count('all-gather')} all-gathers"
+    )
+    (dq, dk, dv), o = f(q, k, v)
+    assert o.sharding.spec == P(("data", "fsdp"), "tensor", None, None)
+    assert dq.sharding.spec == P(("data", "fsdp"), "tensor", None, None)
+
+    # numerics vs the jnp oracle (bthd layout, GQA repeated explicitly)
+    tr = lambda x: x.transpose(0, 2, 1, 3)
+    rep = lambda x: jnp.repeat(x, H // H_kv, axis=2)
+
+    def loss_ref(q, k, v):
+        o = causal_attention_reference(tr(q), rep(tr(k)), rep(tr(v)))
+        return jnp.sum(o * o), o
+
+    (dq_r, dk_r, dv_r), o_r = jax.jit(
+        jax.grad(loss_ref, argnums=(0, 1, 2), has_aux=True)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(tr(o_r)),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(dq_r),
+                               atol=2e-3, rtol=2e-3)
+    # the repeat sits inside loss_ref, so autodiff already folds the GQA
+    # group sum: dk_r/dv_r are (B, H_kv, T, D) like ours
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(dk_r),
+                               atol=2e-3, rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(dv_r),
+                               atol=2e-3, rtol=2e-3)
+
+
+def test_flash_shard_specs_fallbacks():
+    """Axis selection degrades gracefully: indivisible batch drops batch
+    axes, indivisible heads drop 'tensor', nothing shardable → None."""
+    mesh = make_mesh("data:2,fsdp:2,tensor:2")
+    jax.set_mesh(mesh)
+    # everything divides → full spec
+    assert _flash_shard_specs("bhtd", (8, 4, 64, 16), 4, 4) == \
+        P(("data", "fsdp"), "tensor", None, None)
+    # bthd layout puts heads third
+    assert _flash_shard_specs("bthd", (8, 64, 4, 16), 4, 4) == \
+        P(("data", "fsdp"), None, "tensor", None)
+    # B=6: divisible by data(2) but not data*fsdp(4) → fsdp dropped
+    assert _flash_shard_specs("bhtd", (6, 4, 64, 16), 4, 4) == \
+        P(("data",), "tensor", None, None)
+    # odd H_kv → tensor dropped (GQA group map must stay shard-local)
+    assert _flash_shard_specs("bhtd", (8, 4, 64, 16), 4, 1) == \
+        P(("data", "fsdp"), None, None, None)
+    # nothing divides → no wrap
+    assert _flash_shard_specs("bhtd", (3, 3, 64, 16), 3, 3) is None
+
+
+def test_flash_shard_specs_no_mesh():
+    """No ambient mesh (single-device use) → no wrap (conftest resets the
+    ambient mesh to empty before each test)."""
+    assert _flash_shard_specs("bhtd", (8, 4, 64, 16), 4, 4) is None
+
+
+def test_flash_shard_specs_none_inside_manual():
+    """Inside an enclosing shard_map body (ulysses calls the local kernel
+    there) every mesh axis is Manual — the dispatcher must NOT nest
+    another wrap."""
+    mesh = make_mesh("data:2,tensor:2")
+    jax.set_mesh(mesh)
+    seen = []
+
+    def body(x):
+        seen.append(_flash_shard_specs("bhtd", (8, 4, 64, 16), 4, 4))
+        return x
+
+    f = jax.shard_map(
+        body, in_specs=P(("data",), None), out_specs=P(("data",), None),
+        check_vma=False,
+    )
+    jax.jit(f)(jnp.ones((8, 4)))
+    assert seen == [None]
+
+
+@pytest.mark.parametrize("mesh_shape", ["data:2,fsdp:2", "fsdp:2,tensor:2"])
+def test_spmd_trajectory_pallas(char_dataset, tmp_path, mesh_shape):
+    """The PRODUCT configuration (training loop + pallas hot path) under a
+    mesh: loss trajectory must equal the single-device pallas trajectory
+    (same seeds, same global batch) — pallas-under-SPMD is pure layout."""
+    from tests.test_train_tpu import make_cfg
+    from avenir_tpu.train.loop import run_training
+
+    cfg1 = make_cfg(char_dataset["dir"], tmp_path / "o1", max_iters=4,
+                    gradient_accumulation_steps=4, mesh_shape="data:1",
+                    attn_impl="pallas")
+    ref = run_training(cfg1)
+    cfgN = make_cfg(char_dataset["dir"], tmp_path / "o2", max_iters=4,
+                    gradient_accumulation_steps=4, mesh_shape=mesh_shape,
+                    attn_impl="pallas")
+    got = run_training(cfgN)
+    ref_l = np.array([l for _, l in ref["loss_history"]])
+    got_l = np.array([l for _, l in got["loss_history"]])
+    np.testing.assert_allclose(got_l, ref_l, atol=2e-4, rtol=2e-4)

@@ -97,6 +97,10 @@ remat_policy = "nothing"
 context_parallel_impl = "ring"
 scan_layers = False  # lax.scan over blocks (fast compiles for deep models)
 use_pallas = True  # pallas flash attention on TPU (auto-falls back off-TPU)
+# hard attention-impl override ("pallas"/"xla"/...): unlike use_pallas's
+# "auto" it never falls back silently — the CPU-harness SPMD tests force
+# "pallas" (interpret mode) through the real mesh dispatch with this
+attn_impl = ""
 fused_adamw = False  # accepted+ignored: XLA-fused optax IS the hot path (BASELINE.md)
 profile = False  # capture a jax.profiler trace window
 # save checkpoints from a background thread (single-process only; training
