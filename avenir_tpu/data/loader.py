@@ -65,3 +65,22 @@ class DataLoader:
         gx = jax.make_array_from_process_local_data(self.sharding, x, global_shape)
         gy = jax.make_array_from_process_local_data(self.sharding, y, global_shape)
         return gx, gy
+
+    def get_batch_window(self, split, k):
+        """`k` consecutive batches stacked on a leading (unsharded) step
+        axis — (k, grad_accum, B, T) — for the windowed multi-step
+        dispatch (train/step.jit_windowed_train_step). Draws from the SAME
+        per-process stream as get_batch, so k window calls and k·1 single
+        calls yield the identical batch sequence."""
+        assert not self.flat, "windowed batches are a train-path concept"
+        xs, ys = zip(*(self._sample_local(split) for _ in range(k)))
+        x, y = np.stack(xs), np.stack(ys)
+        if self.sharding is None:
+            return jax.numpy.asarray(x), jax.numpy.asarray(y)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        wsh = NamedSharding(self.sharding.mesh, P(None, *self.sharding.spec))
+        gshape = (k, self.grad_accum, self.batch_size, self.block_size)
+        gx = jax.make_array_from_process_local_data(wsh, x, gshape)
+        gy = jax.make_array_from_process_local_data(wsh, y, gshape)
+        return gx, gy

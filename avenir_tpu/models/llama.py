@@ -8,10 +8,12 @@ model.norm / lm_head) so the checkpoint bridge's name rules apply
 unchanged; tests/test_llama.py pins logits parity against
 transformers' torch LlamaForCausalLM on shared random weights.
 
-TPU notes: GQA runs through ops.causal_attention (Pallas flash kernel —
-KV heads repeated to Q heads before the kernel), RMSNorm through the
-ops dispatch (Pallas on TPU), RoPE tables are trace-time constants XLA
-folds. lm_head is UNTIED (Llama-3 convention)."""
+TPU notes: GQA runs through ops.causal_attention — on the Pallas path
+K/V stay at H_kv heads end to end (the kernels map each q head to its
+shared kv head in their BlockSpec index fns; ops/pallas/
+flash_attention.py) — RMSNorm through the ops dispatch (Pallas on TPU),
+RoPE tables are trace-time constants XLA folds. lm_head is UNTIED
+(Llama-3 convention)."""
 
 import math
 from dataclasses import dataclass

@@ -137,13 +137,19 @@ class MixtralSparseMoeBlock(nnx.Module):
         # on an overflow cell, and dispatch/combine become O(N·K·d) row
         # gathers (autodiff turns them into scatter-adds). Same semantics:
         # unique cells, token-major queue order, dropped slots contribute 0.
-        slot = jnp.where(keep, topk_idx * C + pos_tok, E * C)  # (N, K)
+        # kept pairs own expert queue cell `topk_idx·C + pos_tok`; each
+        # DROPPED pair gets its own distinct out-of-bounds cell E·C + pair
+        # index, so the indices really are globally unique — a shared E·C
+        # sentinel worked only because mode="drop" discards OOB writes,
+        # but duplicated indices under a unique_indices=True promise are
+        # implementation-defined (ADVICE r3)
+        pair_idx = jnp.arange(N * K).reshape(N, K)
+        slot = jnp.where(keep, topk_idx * C + pos_tok, E * C + pair_idx)
         tok_of_pair = jnp.broadcast_to(jnp.arange(N)[:, None], (N, K))
         # inverse permutation: which token fills each expert queue cell
         # (sentinel N = "empty cell" -> the appended zero row of xf). The
-        # scatter target is exactly (E*C,): dropped pairs' overflow index
-        # E*C falls out of bounds and mode="drop" discards them, so the
-        # remaining writes are genuinely unique (one owner per cell).
+        # scatter target is exactly (E*C,): every dropped pair's index is
+        # out of bounds and mode="drop" discards it.
         token_for_slot = jnp.full((E * C,), N, dtype=jnp.int32)
         token_for_slot = token_for_slot.at[slot.reshape(-1)].set(
             tok_of_pair.reshape(-1).astype(jnp.int32), mode="drop",
@@ -160,7 +166,8 @@ class MixtralSparseMoeBlock(nnx.Module):
             [expert_out.reshape(E * C, d), jnp.zeros((1, d), expert_out.dtype)],
             axis=0,
         )
-        gathered = out_flat[slot]  # (N, K, d); dropped pairs hit the zero row
+        # dropped pairs (slot >= E·C) read the appended zero row explicitly
+        gathered = out_flat[jnp.minimum(slot, E * C)]  # (N, K, d)
         out = jnp.einsum("nk,nkd->nd",
                          (topk_probs * keep).astype(self._cdtype), gathered)
         return out.reshape(B, T, d).astype(x.dtype), stats

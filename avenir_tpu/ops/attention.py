@@ -155,10 +155,12 @@ def causal_attention(q, k, v, *, dropout_rate=0.0, deterministic=True,
     (B,T,H,D)<->(B,H,T,D) copies around the kernel (VERDICT r2 item 1).
 
     GQA head sharing is impl-specific: the pallas kernels index the shared
-    kv head in their BlockSpec index maps and the ulysses path all-to-alls
-    unrepeated KV to the local kernel (K/V never repeated — no 4x
-    HBM/VMEM/comm tax at Llama-3's 32:8); the xla and ring paths repeat
-    explicitly (XLA fuses the broadcast into the einsum)."""
+    kv head in their BlockSpec index maps, the ulysses path all-to-alls
+    unrepeated KV to the local kernel, and the ring rotates H_kv-sized
+    stripes with grouped-einsum block kernels (K/V never repeated on any
+    of the three — no 4x HBM/VMEM/comm tax at Llama-3's 32:8); only the
+    xla reference path repeats explicitly (XLA fuses the broadcast into
+    the einsum)."""
     assert layout in ("bthd", "bhtd"), f"unknown layout {layout!r}"
     h_axis = 1 if layout == "bhtd" else 2
     assert q.shape[h_axis] % k.shape[h_axis] == 0, (
@@ -169,7 +171,7 @@ def causal_attention(q, k, v, *, dropout_rate=0.0, deterministic=True,
     use_dropout = dropout_rate > 0.0 and not deterministic
     impl = resolve_attention_impl(impl, use_dropout=use_dropout,
                                   segment_ids=segment_ids)
-    if (impl not in ("pallas", "ulysses")
+    if (impl not in ("pallas", "ulysses", "ring")
             and q.shape[h_axis] != k.shape[h_axis]):
         rep = q.shape[h_axis] // k.shape[h_axis]
         k = jnp.repeat(k, rep, axis=h_axis)

@@ -80,9 +80,13 @@ def _branch(pred, then_fn, else_fn):
 # unrolled branch bodies defeat Mosaic's cross-grid-step pipelining. The
 # default therefore stays at the measured winner, halving (2); the env
 # knob exists for re-sweeping on other chips.
-_STAIRCASE_MAX_BRANCHES = max(
-    1, int(os.environ.get("AVENIR_STAIRCASE_BRANCHES", "2"))
-)  # <1 would emit no pl.when branch at all -> uninitialized output
+_ENV_STAIRCASE = os.environ.get("AVENIR_STAIRCASE_BRANCHES", "2")
+assert _ENV_STAIRCASE.lstrip("-").isdigit(), (
+    f"AVENIR_STAIRCASE_BRANCHES must be an integer branch count, got "
+    f"{_ENV_STAIRCASE!r}"
+)
+# <1 would emit no pl.when branch at all -> uninitialized output
+_STAIRCASE_MAX_BRANCHES = max(1, int(_ENV_STAIRCASE))
 
 
 def _staircase(i, nq, block_q, tp, body):

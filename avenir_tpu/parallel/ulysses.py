@@ -21,10 +21,11 @@ restores divisibility, falling back to full H).
 Tradeoffs vs the ring (both ship; pick per workload with
 `--context_parallel_impl`):
   - comm: Ulysses moves q+o at H heads and k+v at H_kv heads once each
-    ((2·H + 2·H_kv)·B·T·D/c per device, all-to-all); the ring moves
-    k+v (c-1) times (2·H_kv·B·T·D·(c-1)/c after its dispatch-side
-    repeat... the ring path repeats KV to H first, so 2·H·B·T·D·(c-1)/c).
-    For c >= 2 and GQA, Ulysses sends strictly less.
+    ((2·H + 2·H_kv)·B·T·D/c per device, all-to-all); the ring moves k+v
+    (c-1) times at H_kv heads (2·H_kv·B·T·D·(c-1)/c — the round-4 ring
+    rotates unrepeated GQA stripes) plus one extra hop returning dk/dv
+    in the backward. At Llama-3's 32:8 (H = 4·H_kv) the ring's forward
+    volume beats Ulysses' once c > 5; at MHA Ulysses wins only c = 2.
   - compute: Ulysses runs the single-device flash kernel (fast path,
     fused bwd) on full-T slices; the ring pays the online-softmax
     combine and lockstep hops but never materializes full T per device.

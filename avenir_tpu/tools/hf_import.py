@@ -178,7 +178,7 @@ def _llama_config_kwargs(hf_cfg, compute_dtype, attn_impl):
 
 
 def _family_from_hf(name_or_dir, family, *, compute_dtype, attn_impl, seed,
-                    block_size=None):
+                    block_size=None, capacity_factor=None):
     import json
 
     with open(_hf_file(name_or_dir, "config.json")) as f:
@@ -196,6 +196,10 @@ def _family_from_hf(name_or_dir, family, *, compute_dtype, attn_impl, seed,
             n_experts_per_tok=hf_cfg["num_experts_per_tok"],
             router_aux_loss_coef=hf_cfg.get("router_aux_loss_coef", 0.02),
         )
+        if capacity_factor is not None:
+            # runtime-only knob, not an HF config field: HF's dense MoE
+            # never drops, so exact-parity use wants E/K (capacity == N)
+            kwargs["capacity_factor"] = capacity_factor
         if hf_cfg.get("sliding_window") not in (None, 0):
             warnings.warn(
                 f"HF config declares sliding_window="
@@ -227,8 +231,12 @@ def llama_from_hf(name_or_dir, *, compute_dtype="float32", attn_impl="auto",
 
 
 def mixtral_from_hf(name_or_dir, *, compute_dtype="float32",
-                    attn_impl="auto", seed=0, block_size=None):
-    """Build an nnx Mixtral from an HF Mixtral checkpoint."""
+                    attn_impl="auto", seed=0, block_size=None,
+                    capacity_factor=None):
+    """Build an nnx Mixtral from an HF Mixtral checkpoint.
+    `capacity_factor` (runtime-only, not an HF field): E/K gives
+    capacity == all tokens, matching HF's dense routing exactly."""
     return _family_from_hf(name_or_dir, "mixtral",
                            compute_dtype=compute_dtype, attn_impl=attn_impl,
-                           seed=seed, block_size=block_size)
+                           seed=seed, block_size=block_size,
+                           capacity_factor=capacity_factor)

@@ -103,3 +103,39 @@ def jit_multi_train_step(train_step, tx):
         return params, opt_state, metrics
 
     return jax.jit(wrapped, donate_argnums=(0, 1))
+
+
+def jit_windowed_train_step(train_step, tx):
+    """K optimizer steps per dispatch for the TRAINING LOOP (VERDICT r3
+    item 2: the loop must deliver the throughput the bench harness
+    measures). Same scan-over-steps shape as `jit_multi_train_step`, but
+    the per-step rngs are `fold_in(base_rng, global_iter)` — bit-identical
+    to the single-step loop's rng stream, so `--dispatch_steps` can never
+    change a training trajectory. `start_iter` is a traced scalar: the
+    window's position in the run never forces a retrace (only a new window
+    LENGTH does).
+
+    windowed(params, opt_state, base_rng, start_iter, xs, ys)
+      -> (params, opt_state, metrics)
+      xs, ys: (K, grad_accum, B, T) int32; metrics arrays stacked (K,).
+    """
+
+    def wrapped(params, opt_state, base_rng, start_iter, xs, ys):
+        n_steps = xs.shape[0]
+        iters = start_iter + jnp.arange(n_steps)
+        step_rngs = jax.vmap(
+            lambda i: jax.random.fold_in(base_rng, i)
+        )(iters)
+
+        def body(carry, inp):
+            p, o = carry
+            x, y, r = inp
+            p, o, m = train_step(p, o, tx, r, x, y)
+            return (p, o), m
+
+        (params, opt_state), metrics = jax.lax.scan(
+            body, (params, opt_state), (xs, ys, step_rngs)
+        )
+        return params, opt_state, metrics
+
+    return jax.jit(wrapped, donate_argnums=(0, 1))

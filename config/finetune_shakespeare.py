@@ -1,0 +1,28 @@
+# Finetune GPT-2 on BPE-tokenized tiny-shakespeare (data/shakespeare/,
+# SURVEY.md §2a R3/R4: the reference's finetuning config shape — short run,
+# small LR, no decay, resume-or-hub init). Works on either backend:
+#   python train.py config/finetune_shakespeare.py --backend=tpu
+# In the zero-egress sandbox init_from="gpt2" needs a warm HF cache; train
+# from scratch instead with --init_from=scratch.
+
+out_dir = "out-shakespeare"
+eval_interval = 5
+eval_iters = 40
+wandb_log = False
+wandb_project = "shakespeare"
+wandb_run_name = "ft-gpt2"
+
+dataset = "shakespeare"
+init_from = "gpt2"  # HF GPT-2 124M weights through the bridge key-map
+
+# only save when val improves (finetuning overfits fast)
+always_save_checkpoint = False
+
+# 1 batch of 32 grad-accum steps ~ 32k tokens/iter
+batch_size = 1
+gradient_accumulation_steps = 32
+max_iters = 20
+
+# finetune at constant small LR
+learning_rate = 3e-5
+decay_lr = False

@@ -1,0 +1,71 @@
+"""Prepare tiny-shakespeare with the GPT-2 BPE tokenizer (SURVEY.md §2a
+R4 — the third reference prep script, completing the set next to
+shakespeare_char's char-level and openwebtext's full-corpus preps).
+
+Downloads the tinyshakespeare text and encodes it with tiktoken's GPT-2
+BPE into train.bin / val.bin uint16 memmaps (no meta.pkl: BPE datasets
+use the default 50304-padded GPT-2 vocab, same contract as openwebtext).
+In the zero-egress sandbox, --synthetic (or any download/tokenizer
+failure) produces a GPT-2-BPE-compatible stand-in so the training path
+runs end to end.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+URL = ("https://raw.githubusercontent.com/karpathy/char-rnn/master/data/"
+       "tinyshakespeare/input.txt")
+
+
+def _encode_or_zipf(text, seed=1337, n_tokens=400_000):
+    """GPT-2 BPE ids for `text`, or (offline, no tiktoken cache) a
+    Zipf-distributed id stream of comparable size — same fallback shape
+    as openwebtext's synthetic prep."""
+    try:
+        import tiktoken
+
+        enc = tiktoken.get_encoding("gpt2")
+        return np.array(enc.encode_ordinary(text), dtype=np.uint16)
+    except Exception:
+        rng = np.random.default_rng(seed)
+        ranks = np.arange(1, 50258, dtype=np.float64)
+        probs = (1.0 / ranks) / (1.0 / ranks).sum()
+        return rng.choice(50257, size=n_tokens, p=probs).astype(np.uint16)
+
+
+def prepare(here: str, synthetic: bool = False):
+    input_path = os.path.join(here, "input.txt")
+    text = None
+    if not synthetic:
+        if not os.path.exists(input_path):
+            try:
+                import requests
+
+                with open(input_path, "w") as f:
+                    f.write(requests.get(URL, timeout=30).text)
+            except Exception as e:
+                print(f"download failed ({e}); falling back to synthetic")
+        if os.path.exists(input_path):
+            with open(input_path) as f:
+                text = f.read()
+    if text is None:
+        from avenir_tpu.utils.corpus import synthetic_corpus
+
+        text = synthetic_corpus(n_chars=1_600_000, seed=1337)
+
+    ids = _encode_or_zipf(text)
+    # 90/10 split (the reference's ratio for this corpus); val stays
+    # comfortably larger than any block_size
+    n = int(0.9 * len(ids))
+    ids[:n].tofile(os.path.join(here, "train.bin"))
+    ids[n:].tofile(os.path.join(here, "val.bin"))
+    print(f"train tokens={n:,}, val tokens={len(ids) - n:,}")
+
+
+if __name__ == "__main__":
+    here = os.path.dirname(os.path.abspath(__file__))
+    prepare(here, synthetic="--synthetic" in sys.argv)

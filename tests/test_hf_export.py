@@ -1,0 +1,165 @@
+"""HF export round-trip tests (VERDICT r3 item 3): export_hf's output must
+load back bit-faithfully through BOTH consumers — our own hf_import dir
+loaders AND `transformers.*ForCausalLM.from_pretrained` (the file's core
+claim) — for all three families. Covers the GPT-2 Conv1D re-transpose,
+the Llama/Mixtral config-field reconstruction, Mixtral expert unstacking,
+and the ckpt.pt entry point after a real (tiny) training run.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+from flax import nnx
+
+from avenir_tpu.models.gpt import GPT, GPTConfig
+from avenir_tpu.tools.hf_export import export_hf, export_hf_from_ckpt
+
+GPT_TINY = dict(block_size=16, vocab_size=64, n_layer=2, n_head=2,
+                n_embd=32, dropout=0.0, bias=True)
+LLAMA_TINY = dict(block_size=32, vocab_size=96, n_layer=2, n_head=4,
+                  n_kv_head=2, n_embd=64, ffn_hidden=128,
+                  rope_theta=10000.0)
+
+
+def _gpt_model_args():
+    ma = dict(GPT_TINY)
+    ma.pop("dropout")
+    return ma
+
+
+def _logits(m, idx):
+    # pass targets so the model returns FULL-sequence logits (with
+    # targets=None the nanoGPT convention returns the last position only)
+    out, _ = m(jnp.asarray(idx), jnp.asarray(idx))
+    return np.asarray(out)
+
+
+def test_gpt_roundtrip_through_importer(tmp_path):
+    """export → raw safetensors → hf_import's GPT-2 loader into a fresh
+    model: logits identical. The Conv1D transpose pair (export T, import
+    T back) must be exactly inverse."""
+    from safetensors.numpy import load_file
+
+    from avenir_tpu.tools.hf_import import load_hf_gpt2_sd
+
+    m1 = GPT(GPTConfig(**GPT_TINY, attn_impl="xla"), rngs=nnx.Rngs(0))
+    dest = str(tmp_path / "hf")
+    export_hf(dest, params_or_model=m1, model_args=_gpt_model_args(),
+              model_family="gpt")
+
+    m2 = GPT(GPTConfig(**GPT_TINY, attn_impl="xla"), rngs=nnx.Rngs(1))
+    load_hf_gpt2_sd(m2, load_file(f"{dest}/model.safetensors"))
+
+    idx = np.random.default_rng(0).integers(0, 64, (2, 16))
+    np.testing.assert_array_equal(_logits(m1, idx), _logits(m2, idx))
+
+
+def test_gpt_transformers_from_pretrained(tmp_path):
+    """The core claim: `GPT2LMHeadModel.from_pretrained(dest)` loads the
+    export directly (config.json + safetensors, tied head re-derived)
+    and produces the same logits."""
+    from transformers import GPT2LMHeadModel
+
+    m1 = GPT(GPTConfig(**GPT_TINY, attn_impl="xla"), rngs=nnx.Rngs(0))
+    dest = str(tmp_path / "hf")
+    export_hf(dest, params_or_model=m1, model_args=_gpt_model_args(),
+              model_family="gpt")
+
+    hf = GPT2LMHeadModel.from_pretrained(dest, local_files_only=True)
+    hf.eval()
+    idx = np.random.default_rng(0).integers(0, 64, (2, 16))
+    with torch.no_grad():
+        t_logits = hf(torch.from_numpy(idx)).logits
+    np.testing.assert_allclose(_logits(m1, idx), t_logits.numpy(),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_llama_roundtrip_both_consumers(tmp_path):
+    from transformers import LlamaForCausalLM
+
+    from avenir_tpu.models.llama import Llama, LlamaConfig
+    from avenir_tpu.tools.hf_import import llama_from_hf
+
+    m1 = Llama(LlamaConfig(**LLAMA_TINY, attn_impl="xla"), rngs=nnx.Rngs(0))
+    ma = dict(LLAMA_TINY, norm_eps=1e-5)
+    dest = str(tmp_path / "hf")
+    export_hf(dest, params_or_model=m1, model_args=ma, model_family="llama")
+
+    idx = np.random.default_rng(0).integers(0, 96, (2, 24))
+    # our dir loader reconstructs the config from config.json
+    m2 = llama_from_hf(dest, attn_impl="xla")
+    np.testing.assert_array_equal(_logits(m1, idx), _logits(m2, idx))
+    # transformers
+    hf = LlamaForCausalLM.from_pretrained(
+        dest, local_files_only=True, attn_implementation="eager"
+    )
+    hf.eval()
+    with torch.no_grad():
+        t_logits = hf(torch.from_numpy(idx)).logits
+    np.testing.assert_allclose(_logits(m1, idx), t_logits.numpy(),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_mixtral_roundtrip_both_consumers(tmp_path):
+    from transformers import MixtralForCausalLM
+
+    from avenir_tpu.models.mixtral import Mixtral, MixtralConfig
+    from avenir_tpu.tools.hf_import import mixtral_from_hf
+
+    tiny = dict(LLAMA_TINY, n_experts=4, n_experts_per_tok=2)
+    # capacity E/K → nothing drops, so logits match HF exactly
+    cap = tiny["n_experts"] / tiny["n_experts_per_tok"]
+    m1 = Mixtral(MixtralConfig(**tiny, capacity_factor=cap, attn_impl="xla"),
+                 rngs=nnx.Rngs(0))
+    ma = dict(tiny, norm_eps=1e-5)
+    dest = str(tmp_path / "hf")
+    export_hf(dest, params_or_model=m1, model_args=ma,
+              model_family="mixtral")
+
+    idx = np.random.default_rng(0).integers(0, 96, (2, 16))
+    m2 = mixtral_from_hf(dest, attn_impl="xla", capacity_factor=cap)
+    np.testing.assert_array_equal(_logits(m1, idx), _logits(m2, idx))
+    hf = MixtralForCausalLM.from_pretrained(
+        dest, local_files_only=True, attn_implementation="eager"
+    )
+    hf.eval()
+    with torch.no_grad():
+        t_logits = hf(torch.from_numpy(idx)).logits
+    np.testing.assert_allclose(_logits(m1, idx), t_logits.numpy(),
+                               atol=5e-4, rtol=5e-4)
+
+
+def test_export_from_trained_ckpt(tmp_path, char_dataset):
+    """The CLI entry point: train 2 iters, convert out_dir/ckpt.pt, load
+    the export back — logits match the checkpoint-restored model."""
+    from safetensors.numpy import load_file
+
+    from avenir_tpu.checkpoint.bridge import load_torch_state_dict
+    from avenir_tpu.checkpoint.io import load_checkpoint
+    from avenir_tpu.tools.hf_import import load_hf_gpt2_sd
+    from avenir_tpu.train.loop import run_training
+    from tests.test_train_tpu import make_cfg
+
+    out = str(tmp_path / "out")
+    cfg = make_cfg(char_dataset["dir"], out, max_iters=2, eval_interval=2,
+                   mesh_shape="data:1", bias=True)
+    run_training(cfg)
+    dest = str(tmp_path / "hf")
+    export_hf_from_ckpt(out, dest)
+
+    ckpt = load_checkpoint(out)
+    vocab = ckpt["model_args"]["vocab_size"]
+    gcfg = GPTConfig(
+        block_size=32, vocab_size=vocab, n_layer=2, n_head=2, n_embd=32,
+        dropout=0.0, bias=True, attn_impl="xla",
+    )
+    ref = GPT(gcfg, rngs=nnx.Rngs(0))
+    load_torch_state_dict(ref, {k: np.asarray(v)
+                                for k, v in ckpt["model"].items()})
+    got = GPT(gcfg, rngs=nnx.Rngs(1))
+    load_hf_gpt2_sd(got, load_file(f"{dest}/model.safetensors"))
+
+    idx = np.random.default_rng(0).integers(0, vocab, (2, 16))
+    np.testing.assert_array_equal(_logits(ref, idx), _logits(got, idx))

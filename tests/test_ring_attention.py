@@ -14,21 +14,44 @@ from avenir_tpu.parallel.ring_attention import ring_causal_attention
 
 
 @pytest.mark.parametrize("ctx", [2, 4, 8])
-def test_ring_matches_dense(ctx):
+@pytest.mark.parametrize("h_kv", [2, 1])  # MHA and GQA (H=2, group=2)
+def test_ring_matches_dense(ctx, h_kv):
+    """Forward AND grads vs the dense oracle — the kv stripes rotate at
+    H_kv heads (never expanded); the oracle sees explicitly repeated KV,
+    and its dk/dv fold back over the group for comparison."""
     mesh = make_mesh(f"context:{ctx}")
     jax.set_mesh(mesh)
     B, T, H, D = 2, 64, 2, 16
     ks = jax.random.split(jax.random.key(0), 3)
     q = jax.random.normal(ks[0], (B, T, H, D), jnp.float32)
-    k = jax.random.normal(ks[1], (B, T, H, D), jnp.float32)
-    v = jax.random.normal(ks[2], (B, T, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, h_kv, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, h_kv, D), jnp.float32)
 
-    out = jax.jit(
-        lambda q, k, v: ring_causal_attention(q, k, v, mesh=mesh)
+    def loss_ring(q, k, v):
+        o = ring_causal_attention(q, k, v, mesh=mesh)
+        return jnp.sum(o * o), o
+
+    (dq, dk, dv), out = jax.jit(
+        jax.grad(loss_ring, argnums=(0, 1, 2), has_aux=True)
     )(q, k, v)
-    ref = causal_attention_reference(q, k, v)
+
+    rep = lambda x: jnp.repeat(x, H // h_kv, axis=2)
+
+    def loss_ref(q, k, v):
+        o = causal_attention_reference(q, rep(k), rep(v))
+        return jnp.sum(o * o), o
+
+    (dq_r, dk_r, dv_r), ref = jax.jit(
+        jax.grad(loss_ref, argnums=(0, 1, 2), has_aux=True)
+    )(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(dq_r),
+                               atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(dk_r),
+                               atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(dv_r),
+                               atol=2e-4, rtol=2e-4)
 
 
 def test_ring_trajectory_matches_single_device(char_dataset, tmp_path):

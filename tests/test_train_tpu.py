@@ -168,6 +168,28 @@ def test_multi_step_dispatch_matches_single_steps():
                                    atol=1e-7)
 
 
+def test_windowed_loop_matches_single_dispatch(char_dataset, tmp_path):
+    """--dispatch_steps is pure dispatch granularity: the windowed loop
+    (auto windows, fold_in rngs inside the scan) must reproduce the
+    single-dispatch loop's loss history EXACTLY — same iters logged, same
+    values (identical rng and batch streams; VERDICT r3 item 2). The
+    window cap of 3 forces windows to split mid-eval-interval, covering
+    remainder windows too."""
+    from avenir_tpu.train.loop import run_training
+
+    cfg1 = make_cfg(char_dataset["dir"], tmp_path / "o1", max_iters=7,
+                    eval_interval=5, dispatch_steps=1, mesh_shape="data:1")
+    ref = run_training(cfg1)
+    cfg3 = make_cfg(char_dataset["dir"], tmp_path / "o2", max_iters=7,
+                    eval_interval=5, dispatch_steps=3, mesh_shape="data:1")
+    got = run_training(cfg3)
+    assert [i for i, _ in ref["loss_history"]] == \
+        [i for i, _ in got["loss_history"]]
+    np.testing.assert_allclose(
+        np.array([l for _, l in got["loss_history"]]),
+        np.array([l for _, l in ref["loss_history"]]), rtol=1e-6, atol=1e-7)
+
+
 @pytest.mark.parametrize("mesh_shape", ["data:8", "data:2,fsdp:4",
                                         "data:2,fsdp:2,tensor:2"])
 def test_spmd_trajectory_matches_single_device(char_dataset, tmp_path, mesh_shape):

@@ -102,9 +102,14 @@ use_pallas = True  # pallas flash attention on TPU (auto-falls back off-TPU)
 # "pallas" (interpret mode) through the real mesh dispatch with this
 attn_impl = ""
 fused_adamw = False  # accepted+ignored: XLA-fused optax IS the hot path (BASELINE.md)
+# optimizer steps per XLA dispatch in the tpu loop: 0 = auto (windows of up
+# to 32 steps between eval/log/profile boundaries; identical trajectory,
+# amortized dispatch latency — train/step.jit_windowed_train_step), 1 = one
+# dispatch per step, N>1 = explicit window cap
+dispatch_steps = 0
 profile = False  # capture a jax.profiler trace window
 # save checkpoints from a background thread (single-process only; training
-# continues while the snapshot streams to ckpt.pt.tmp, atomically renamed)
+# continues while the snapshot streams to ckpt.pt.part, atomically renamed)
 async_checkpoint = False
 # accept silent replication of param dims the mesh doesn't divide (e.g. an
 # unpadded char vocab on tensor:2); default is a hard error (fail-loud)
