@@ -228,18 +228,20 @@ class AsyncCheckpoint:
 
 
 def _tree_device_bytes(tree):
-    """Per-device bytes a jnp.copy of `tree` would allocate (sharded
-    leaves copy shard-wise, so divide by the device count each leaf is
-    spread over)."""
-    import numpy as np
-
+    """Bytes a jnp.copy of `tree` would allocate ON ONE DEVICE: the sum of
+    the shards that live on the first device. A REPLICATED leaf holds a
+    full copy per device (its per-device cost is the full nbytes, NOT
+    nbytes / n_shards — dividing would understate the guard by
+    device_count× exactly when params are replicated, e.g. pure-DP
+    meshes)."""
     total = 0
     for leaf in jax.tree.leaves(tree):
-        if hasattr(leaf, "nbytes"):
-            n_shards = max(1, len(getattr(leaf, "addressable_shards", []) or []))
-            total += leaf.nbytes // n_shards if n_shards > 1 else int(
-                np.asarray(leaf.nbytes)
-            )
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            dev0 = shards[0].device
+            total += sum(s.data.nbytes for s in shards if s.device == dev0)
+        elif hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
     return total
 
 

@@ -487,6 +487,10 @@ def run_training(cfg):
                                   + (" (async)" if use_async_ckpt else ""))
                         with jax.profiler.TraceAnnotation("checkpoint"):
                             do_save(lr, iter_num)
+                # eval + save are host boundaries, not step throughput:
+                # restart the window timer so their cost doesn't smear
+                # into the next window's K per-iter dt lines
+                _t0[0] = time.time()
             if iter_num == 0 and cfg["eval_only"]:
                 break
 
@@ -525,9 +529,19 @@ def run_training(cfg):
                 with jax.profiler.TraceAnnotation("host_batch"):
                     xs, ys = train_loader.get_batch_window("train", K)
                 with jax.profiler.StepTraceAnnotation("train", step_num=iter_num):
+                    _td0 = time.time()
                     params, opt_state, metrics = window_step(
                         params, opt_state, base_rng, iter_num, xs, ys
                     )
+                    _td = time.time() - _td0
+                if _td > 0.5:
+                    # the dispatch call blocked the host: a new window
+                    # LENGTH traced+compiled (dispatch itself is ms).
+                    # That one-off host time is not device throughput —
+                    # exclude it from the pending window's dt, or one
+                    # compile smears ~1s/iter across K log lines and
+                    # poisons the running-MFU EMA
+                    _t0[0] += _td
                 flush_pending()  # logs the PREVIOUS window (one-window lag)
                 pending[0] = (iter_num, K, metrics)
             else:

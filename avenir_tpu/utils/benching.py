@@ -1,0 +1,41 @@
+"""Pipelined round timing for the tunneled-TPU bench harnesses.
+
+THE one implementation of the fence-hiding measurement discipline both
+bench.py and tools/bench_ladder.py (and, in spirit, the trainer's
+one-window-lag logging) rely on: on the axon-tunneled platform a D2H
+loss fetch is the only reliable execution fence and costs ~100ms RTT, so
+billing it inside a timed round understates throughput. Dispatch round
+i+1 BEFORE fetching round i's loss: the fence and the next dispatch
+overlap device compute, and the spacing between consecutive fetch
+completions is the round's true device-steady-state time. The LAST round
+has no successor and pays its fence exposed — use the (lower) median so
+it is discarded.
+"""
+
+import time
+
+
+def time_pipelined_rounds(dispatch, fetch, n_rounds=4):
+    """Times `n_rounds` calls of `dispatch()` (async; returns a handle)
+    with `fetch(handle)` forced one round behind. Returns the per-round
+    wall times; take `median_low` of them as the round time."""
+    assert n_rounds >= 2, "pipelining needs a successor round"
+    rounds, pending = [], None
+    t_prev = time.perf_counter()
+    for _ in range(n_rounds):
+        handle = dispatch()
+        if pending is not None:
+            fetch(pending)
+            t1 = time.perf_counter()
+            rounds.append(t1 - t_prev)
+            t_prev = t1
+        pending = handle
+    fetch(pending)
+    rounds.append(time.perf_counter() - t_prev)  # exposed fence
+    return rounds
+
+
+def median_low(xs):
+    """Lower median — discards the exposed-fence last round at even n."""
+    s = sorted(xs)
+    return s[(len(s) - 1) // 2]

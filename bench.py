@@ -152,23 +152,19 @@ def main():
                 # fetching round i's loss, exactly like the trainer's
                 # one-window-lag logging — the D2H fence (~100ms tunnel
                 # RTT) hides behind the next round's device time instead
-                # of being billed to the measurement. Per-round time =
-                # spacing between consecutive fetch completions; the last
-                # round has no successor and pays its fence exposed, so
-                # the median of 4 discards it.
-                rounds = []
-                pending = None
-                t_prev = time.perf_counter()
-                for _ in range(4):
-                    p, o, m = step(p, o, key, x, y)
-                    if pending is not None:
-                        float(pending["loss"][-1])
-                        t1 = time.perf_counter()
-                        rounds.append(t1 - t_prev)
-                        t_prev = t1
-                    pending = m
-                float(pending["loss"][-1])
-                rounds.append(time.perf_counter() - t_prev)
+                # of being billed to the measurement. ONE implementation,
+                # shared with tools/bench_ladder.py.
+                from avenir_tpu.utils.benching import time_pipelined_rounds
+
+                st = [p, o]
+
+                def dispatch():
+                    st[0], st[1], m = step(st[0], st[1], key, x, y)
+                    return m
+
+                rounds = time_pipelined_rounds(
+                    dispatch, lambda m: float(m["loss"][-1]))
+                p, o = st
             else:
                 # median of 3 fenced rounds: single rounds spread ~±4% on
                 # the tunneled platform (medians ~±2%, BASELINE.md)
@@ -179,7 +175,9 @@ def main():
                         p, o, m = step(p, o, key, x, y)
                     float(m["loss"])  # fences the whole donated-state chain
                     rounds.append(time.perf_counter() - t0)
-            dt = sorted(rounds)[len(rounds) // 2 - (len(rounds) % 2 == 0)]
+            from avenir_tpu.utils.benching import median_low
+
+            dt = median_low(rounds)
             value = gb * block * steps / dt / n_chips
             del p, o
             break

@@ -133,21 +133,28 @@ def test_flash_shard_specs_none_inside_manual():
     assert seen == [None]
 
 
+@pytest.mark.parametrize("model_kw", [
+    dict(),  # GPT (MHA)
+    # Llama GQA: n_head=4 over tensor:2 → 2 q heads + 1 kv head per shard
+    dict(model_type="llama", n_head=4, n_kv_head=2, ffn_hidden=64),
+], ids=["gpt", "llama-gqa"])
 @pytest.mark.parametrize("mesh_shape", ["data:2,fsdp:2", "fsdp:2,tensor:2"])
-def test_spmd_trajectory_pallas(char_dataset, tmp_path, mesh_shape):
+def test_spmd_trajectory_pallas(char_dataset, tmp_path, mesh_shape, model_kw):
     """The PRODUCT configuration (training loop + pallas hot path) under a
     mesh: loss trajectory must equal the single-device pallas trajectory
-    (same seeds, same global batch) — pallas-under-SPMD is pure layout."""
+    (same seeds, same global batch) — pallas-under-SPMD is pure layout.
+    The llama-gqa case puts GQA K/V head-sharding over 'tensor' through
+    the whole stack (kernel index maps + the wrap's head split)."""
     from tests.test_train_tpu import make_cfg
     from avenir_tpu.train.loop import run_training
 
     cfg1 = make_cfg(char_dataset["dir"], tmp_path / "o1", max_iters=4,
                     gradient_accumulation_steps=4, mesh_shape="data:1",
-                    attn_impl="pallas")
+                    attn_impl="pallas", **model_kw)
     ref = run_training(cfg1)
     cfgN = make_cfg(char_dataset["dir"], tmp_path / "o2", max_iters=4,
                     gradient_accumulation_steps=4, mesh_shape=mesh_shape,
-                    attn_impl="pallas")
+                    attn_impl="pallas", **model_kw)
     got = run_training(cfgN)
     ref_l = np.array([l for _, l in ref["loss_history"]])
     got_l = np.array([l for _, l in got["loss_history"]])

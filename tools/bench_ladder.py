@@ -28,7 +28,7 @@ def run_rung(name, family, cfg_kwargs, batch, steps, flops_per_token=None,
     from flax import nnx
 
     from avenir_tpu.train.optimizer import make_optimizer
-    from avenir_tpu.train.step import jit_train_step, make_step_fns
+    from avenir_tpu.train.step import jit_multi_train_step, make_step_fns
 
     if family == "gpt":
         from avenir_tpu.models.gpt import GPT, GPTConfig
@@ -65,24 +65,35 @@ def run_rung(name, family, cfg_kwargs, batch, steps, flops_per_token=None,
                            warmup_iters=10, lr_decay_iters=1000, min_lr=3e-5)
     opt_state = jax.jit(tx.init)(params)
     step_fn, _ = make_step_fns(graphdef, dropout=0.0)
-    step = jit_train_step(step_fn, tx)
+    # `steps` optimizer steps per dispatch + pipelined rounds (round 4,
+    # same form as bench.py): the next round is dispatched BEFORE the
+    # previous round's loss fence, so neither per-step dispatch latency
+    # (~9ms on the tunneled host) nor the ~100ms D2H RTT is billed to the
+    # rung — the r3 single-dispatch ladder understated heavy rungs 5-10%.
+    step = jit_multi_train_step(step_fn, tx)
 
     T = cfg.block_size
     rng = np.random.default_rng(0)
     V = cfg.vocab_size
-    x = jax.numpy.asarray(rng.integers(0, V, (1, batch, T)).astype(np.int32))
-    y = jax.numpy.asarray(rng.integers(0, V, (1, batch, T)).astype(np.int32))
+    x = jax.numpy.asarray(
+        rng.integers(0, V, (steps, 1, batch, T)).astype(np.int32))
+    y = jax.numpy.asarray(
+        rng.integers(0, V, (steps, 1, batch, T)).astype(np.int32))
     key = jax.random.key(0)
 
-    p, o = params, opt_state
-    for _ in range(2):
-        p, o, m = step(p, o, key, x, y)
-    float(m["loss"])  # fence (axon: D2H readback, not block_until_ready)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        p, o, m = step(p, o, key, x, y)
-    float(m["loss"])
-    dt = time.perf_counter() - t0
+    from avenir_tpu.utils.benching import median_low, time_pipelined_rounds
+
+    p, o, m = step(params, opt_state, key, x, y)  # warmup / compile
+    float(m["loss"][-1])  # fence (axon: D2H readback, not block_until_ready)
+    st = [p, o]
+
+    def dispatch():
+        st[0], st[1], m = step(st[0], st[1], key, x, y)
+        return m
+
+    rounds = time_pipelined_rounds(dispatch, lambda m: float(m["loss"][-1]),
+                                   n_rounds=3)
+    dt = median_low(rounds)
     toks = batch * T * steps / dt
 
     from avenir_tpu.models.common import tpu_peak_flops
