@@ -1,0 +1,10 @@
+# Evaluate HF GPT-2 XL (1.5B) on the configured dataset (SURVEY.md §2a R3
+# "eval configs" — the reference's eval_gpt2* family): load hub weights
+# through the bridge key-map, run estimate_loss, exit. Works on either
+# backend; in the zero-egress sandbox the HF cache must be warm.
+#   python train.py config/eval_gpt2_xl.py --backend=tpu
+batch_size = 8
+eval_iters = 500
+eval_only = True
+wandb_log = False
+init_from = "gpt2-xl"

@@ -137,7 +137,11 @@ def test_flash_shard_specs_none_inside_manual():
     dict(),  # GPT (MHA)
     # Llama GQA: n_head=4 over tensor:2 → 2 q heads + 1 kv head per shard
     dict(model_type="llama", n_head=4, n_kv_head=2, ffn_hidden=64),
-], ids=["gpt", "llama-gqa"])
+    # remat wraps each block — the rematerialized bwd re-enters the
+    # shard_map'd kernel; scan stacks the layers around it (the deep-rung
+    # product config: scan+remat+pallas under fsdp)
+    dict(remat=True, scan_layers=True),
+], ids=["gpt", "llama-gqa", "gpt-remat-scan"])
 @pytest.mark.parametrize("mesh_shape", ["data:2,fsdp:2", "fsdp:2,tensor:2"])
 def test_spmd_trajectory_pallas(char_dataset, tmp_path, mesh_shape, model_kw):
     """The PRODUCT configuration (training loop + pallas hot path) under a
