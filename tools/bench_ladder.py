@@ -9,6 +9,12 @@ single chip (matmul widths, head layout, expert count preserved; depth /
 vocab reduced — each deviation printed), producing real tok/s + MFU rows
 for BASELINE.md.
 
+Per-rung default batches are the r4 single-chip sweep winners
+(BASELINE.md "r4 batch sweep"): 1.5B B=8, Llama T=4096 B=5, LONG-T B=2,
+Mixtral B=32 — each sits just under the HBM cliff; remat_policy
+defaults to dots except Mixtral (nothing — dots measured 14% slower
+there).
+
 Usage: python tools/bench_ladder.py [--steps=8]
          [--rung=1p5b|llama8b|llama8b-longT|mixtral]
 """
@@ -115,7 +121,9 @@ def main():
         scan_override = args["scan"] in ("1", "True", "true")
     # per-rung default unless --scan was passed
     scan = lambda default: default if scan_override is None else scan_override
-    remat_policy = args.get("remat_policy", "nothing")
+    # dots is the measured winner on the dense remat rungs (Mixtral
+    # pins its own policy below); --remat_policy=nothing to compare
+    remat_policy = args.get("remat_policy", "dots")
 
     if which in ("all", "1p5b"):
         # GPT-2 1.5B shape: d=1600, 25 heads (BASELINE.json:9). Full 48
@@ -126,9 +134,10 @@ def main():
             dict(block_size=T, vocab_size=50304, n_layer=L, n_head=h,
                  n_embd=d, dropout=0.0, bias=True, compute_dtype="bfloat16",
                  attn_impl="pallas",
-                 scan_layers=scan(True), remat=True,
+                 # loop (not scan) is this rung's measured winner
+                 scan_layers=scan(False), remat=True,
                  remat_policy=remat_policy),
-            batch=batch_override or 4, steps=steps,
+            batch=batch_override or 8, steps=steps,
         )
 
     # Llama-3 8B shape: d=4096 ffn=14336 GQA 32/8 (BASELINE.json:10).
@@ -146,7 +155,7 @@ def main():
         run_rung(
             "llama3-8b-shape (L=32->2, vocab->16k, d/ffn/GQA/long-T full)",
             "llama", dict(block_size=4096, **llama_shape),
-            batch=batch_override or 1, steps=steps,
+            batch=batch_override or 5, steps=steps,
         )
 
     if which in ("all", "llama8b-longT"):
@@ -155,7 +164,7 @@ def main():
         run_rung(
             "llama3-8b-shape LONG-T blocked path (T=8192, L=2, vocab 16k)",
             "llama", dict(block_size=8192, **llama_shape),
-            batch=batch_override or 1, steps=steps,
+            batch=batch_override or 2, steps=steps,
         )
 
     if which in ("all", "mixtral"):
@@ -172,8 +181,11 @@ def main():
                  rope_theta=10000.0, compute_dtype="bfloat16",
                  attn_impl="pallas",
                  scan_layers=scan(False), remat=True,
-                 remat_policy=remat_policy),
-            batch=batch_override or 4, steps=steps,
+                 # dots HURTS this rung (B=32: 83.0k vs 96.0-96.6k,
+                 # r4 measured) — saving expert-matmul outputs for 8
+                 # experts costs the HBM the batch dilution needs
+                 remat_policy=args.get("remat_policy", "nothing")),
+            batch=batch_override or 32, steps=steps,
             # MFU on ACTIVE params: subtract the (E-K) unrouted experts
             active_params=lambda n: n - L * 3 * d * ffn * (E - K),
         )
