@@ -54,13 +54,20 @@ def test_ring_matches_dense(ctx, h_kv):
                                atol=2e-4, rtol=2e-4)
 
 
-def test_ring_trajectory_matches_single_device(char_dataset, tmp_path):
+@pytest.mark.parametrize("model_kw", [
+    dict(),  # GPT (MHA)
+    # Llama GQA: the kv stripes ride the ring at H_kv=2 heads while the
+    # model runs 4 q heads (the round-4 GQA-native rotation, end to end)
+    dict(model_type="llama", n_head=4, n_kv_head=2, ffn_hidden=64),
+], ids=["gpt", "llama-gqa"])
+def test_ring_trajectory_matches_single_device(char_dataset, tmp_path,
+                                               model_kw):
     from tests.test_train_tpu import make_cfg
 
     from avenir_tpu.train.loop import run_training
 
     common = dict(max_iters=5, gradient_accumulation_steps=4,
-                  eval_interval=50, block_size=32)
+                  eval_interval=50, block_size=32, **model_kw)
     ref = run_training(
         make_cfg(char_dataset["dir"], tmp_path / "o1", mesh_shape="data:1",
                  **common)
