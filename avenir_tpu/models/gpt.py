@@ -63,6 +63,9 @@ class GPTConfig:
     # are stored stacked (L, ...) under `h_scan`; checkpoint format and
     # partition rules are unchanged (bridge splits/stacks per layer).
     scan_layers: bool = False
+    # GPipe microbatches when the mesh has a pipe axis > 1 (requires
+    # scan_layers; parallel/pipeline.py). 0 = auto (2x the pipe size).
+    pipeline_microbatches: int = 0
 
 
 class CausalSelfAttention(nnx.Module):
@@ -226,9 +229,15 @@ class GPT(nnx.Module):
                 "scan_layers + dropout rng threading not supported; "
                 "train with dropout=0"
             )
-            x = scan_layer_stack(
+            from avenir_tpu.parallel.pipeline import layer_stack_dispatch
+
+            # GPipe over the 'pipe' mesh axis when the mesh has one
+            # (stages own contiguous layer blocks, microbatches ride
+            # ppermute), nnx.scan otherwise — one dispatch helper
+            x = layer_stack_dispatch(
                 x, self.h_scan,
                 call=lambda blk, h: blk(h, deterministic=deterministic),
+                n_micro=self.config.pipeline_microbatches,
                 remat=self.config.remat,
                 remat_policy=self.config.remat_policy,
             )

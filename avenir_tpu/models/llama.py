@@ -58,6 +58,9 @@ class LlamaConfig:
     remat: bool = False
     remat_policy: str = "nothing"  # see models/common.py resolve_remat_policy
     scan_layers: bool = False  # lax.scan over stacked layers (see models/gpt.py)
+    # GPipe microbatches when the mesh has a pipe axis > 1 (requires
+    # scan_layers; parallel/pipeline.py). 0 = auto (2x the pipe size).
+    pipeline_microbatches: int = 0
 
     @classmethod
     def from_train_config(cls, cfg, model_args):
@@ -74,6 +77,7 @@ class LlamaConfig:
             remat=cfg["remat"],
             remat_policy=cfg.get("remat_policy", "nothing"),
             scan_layers=cfg.get("scan_layers", False),
+            pipeline_microbatches=cfg.get("pipeline_microbatches", 0),
         )
 
 
@@ -208,16 +212,41 @@ class Llama(nnx.Module):
 
         stats_sum = self._zero_router_stats()
         if self.config.scan_layers:
+            from avenir_tpu.parallel.pipeline import (
+                layer_stack_dispatch, pipeline_axis_size,
+            )
+
+            if pipeline_axis_size() > 1:
+                # the pipeline carries activations only; router-stats
+                # families (MoE) need stats plumbing across stages —
+                # not supported yet
+                assert all(s.ndim == 0 for s in
+                           jax.tree.leaves(stats_sum)), (
+                    "pipeline parallelism does not support router-stats "
+                    "(MoE) models yet; use fsdp/expert/tensor axes"
+                )
+
             def scan_call(lyr, carry):
                 h, acc = carry
                 h, s = apply(lyr, h)
                 return (h, jax.tree.map(jnp.add, acc, s))
 
-            x, stats_sum = scan_layer_stack(
-                (x, stats_sum), self.layers_scan, call=scan_call,
+            def scan_fallback():
+                return scan_layer_stack(
+                    (x, stats_sum), self.layers_scan, call=scan_call,
+                    remat=self.config.remat,
+                    remat_policy=self.config.remat_policy,
+                )
+
+            out = layer_stack_dispatch(
+                x, self.layers_scan,
+                call=lambda lyr, h: apply(lyr, h)[0],
+                n_micro=self.config.pipeline_microbatches,
                 remat=self.config.remat,
                 remat_policy=self.config.remat_policy,
+                scan_fallback=scan_fallback,
             )
+            x, stats_sum = out if isinstance(out, tuple) else (out, stats_sum)
         else:
             layer_fn = (nnx.remat(apply,
                                   policy=resolve_remat_policy(

@@ -108,20 +108,29 @@ def _flash_shard_specs(layout, q_shape, h, h_kv):
     CPU harness, VERDICT r3 item 1) — every operand is dragged to every
     device. Flash attention is embarrassingly parallel over batch and
     heads, so the dispatcher wraps the kernel in jax.shard_map over
-    whichever of those mesh axes exist, divide the dims, and are not
-    already Manual (i.e. we're not inside an enclosing shard_map body such
-    as ulysses's — there the local kernel must stay local)."""
+    whichever of those mesh axes exist and divide the dims.
+
+    If ANY mesh axis is already Manual — we're inside an enclosing
+    shard_map body (ulysses's local kernel, or the GPipe pipeline
+    region) — the wrap stays out entirely and the kernel runs direct.
+    Nesting a check_vma=False shard_map inside a partial-manual region
+    mis-reduces parameter cotangents (measured: 7e-3 grad error on
+    pipe×data meshes), and check_vma=True cannot run the interpret-mode
+    kernels on this jax version (vma mismatch inside pallas interpret's
+    dynamic_slice — upstream limitation). Direct-under-GSPMD is correct
+    (semantics-preserving replication); pipeline meshes that want peak
+    attention throughput should keep batch axes off the attention
+    operands or use xla attention inside the pipe region — measured
+    tradeoffs belong in BASELINE.md when a pipe rung is benched."""
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty:
         return None
     from jax.sharding import AxisType
 
     sizes = dict(mesh.shape)
-    free = {
-        n: sizes[n]
-        for n, t in zip(mesh.axis_names, mesh.axis_types)
-        if sizes[n] > 1 and t != AxisType.Manual
-    }
+    if any(t == AxisType.Manual for t in mesh.axis_types):
+        return None  # inside an enclosing shard_map: run the kernel direct
+    free = {n: sizes[n] for n in mesh.axis_names if sizes[n] > 1}
     if not free:
         return None
     b = q_shape[0]

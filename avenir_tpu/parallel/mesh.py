@@ -11,10 +11,13 @@ Canonical axes, outermost→innermost:
     data    — pure data parallelism (gradient psum); put DCN here multi-slice
     fsdp    — data parallelism with params/opt-state sharded (ZeRO-3)
     expert  — MoE expert parallelism (all-to-all dispatch/combine)
+    pipe    — pipeline parallelism (GPipe microbatch ppermute; one
+              activation hop per microbatch per boundary — light traffic,
+              so it sits outside context/tensor)
     context — sequence/context parallelism (ring attention ppermute)
     tensor  — megatron-style tensor parallelism (innermost: most traffic)
 
-Every mesh carries all five axes (unused ones have size 1) so partition
+Every mesh carries all six axes (unused ones have size 1) so partition
 rules can always name any axis.
 """
 
@@ -25,7 +28,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-AXES = ("data", "fsdp", "expert", "context", "tensor")
+AXES = ("data", "fsdp", "expert", "pipe", "context", "tensor")
 
 
 def _already_initialized() -> bool:

@@ -78,16 +78,19 @@ def has_scan_segment(path) -> bool:
 
 def match_partition_rules(rules, paths):
     """Map each path (tuple or string) to its first matching PartitionSpec.
-    Params under a scan-stacked container get a leading None axis (the
-    layer axis is never sharded — each scan step must find its full layer
-    weights locally). Raises ValueError listing every unmatched path."""
+    Params under a scan-stacked container get a leading 'pipe' axis:
+    with pipeline parallelism each stage owns a contiguous block of
+    layers (parallel/pipeline.py); on meshes without a pipe axis (size
+    1) the entry is inert and each scan step finds its full layer
+    weights locally. Raises ValueError listing every unmatched path."""
     out = {}
     misses = []
     for path in paths:
         s = path_str(path) if not isinstance(path, str) else path
         for pattern, spec in rules:
             if re.search(pattern, s):
-                out[path] = P(None, *tuple(spec)) if has_scan_segment(path) else spec
+                out[path] = (P("pipe", *tuple(spec))
+                             if has_scan_segment(path) else spec)
                 break
         else:
             misses.append(s)

@@ -1,0 +1,182 @@
+"""Pipeline parallelism — GPipe-style microbatching over the 'pipe' mesh
+axis (BEYOND the blueprint: SURVEY.md §2c lists PP as a parity non-goal;
+this lands it anyway as the last missing first-class strategy, the
+TPU-idiomatic way the survey sketches — "shard_map + collective-permute
+microbatch pipeline").
+
+Mechanism. The scan-stacked layer params (L, ...) shard their LAYER axis
+over 'pipe' (partition.match_partition_rules), so stage s owns layers
+[s·L/p, (s+1)·L/p). A `jax.shard_map` manual ONLY over 'pipe'
+(axis_names={'pipe'}) runs the classic GPipe schedule: the batch splits
+into M microbatches, and for ticks t = 0..M+p-2 stage s processes
+microbatch t-s (when in range) through its local layer stack, then
+`lax.ppermute`s the activation one hop to stage s+1. Stage p-1 collects
+finished microbatches; a masked psum broadcasts the result back to every
+stage (embeddings/norm/head outside this region are replicated over
+'pipe', so all stages need the block-stack output). Backward is plain
+autodiff: the transpose of ppermute is the reverse ppermute and the
+transpose of the tick scan is the reverse schedule — activation stash is
+the scan's own residuals, O(M + p) microbatch activations (the GPipe
+memory shape); per-layer remat composes via scan_layer_stack's
+nnx.remat.
+
+Composition. Because the region is manual only over 'pipe', everything
+else stays GSPMD: batch stays sharded over data/fsdp, weights over
+fsdp/tensor. Nested shard_maps are NOT allowed inside (a check_vma=False
+shard_map nested in a partial-manual region mis-reduces parameter
+cotangents — measured 7e-3): the pallas dispatcher detects the Manual
+axis and runs its kernel direct under GSPMD (ops/attention.py), and the
+training loop REJECTS pipe×context meshes (ring/ulysses would nest the
+same way; loop.py fail-loud assert). Bubble fraction is the standard
+(p-1)/(M+p-1); pick M = pipeline_microbatches >= p to amortize
+(default 2p).
+
+Trajectory equivalence vs the unpipelined model is exact up to fp
+reassociation: the same layers run in the same order per token, only
+batch-sliced — pinned by tests/test_pipeline.py on pipe:2 / pipe:4 and
+pipe×data meshes.
+"""
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+from jax.sharding import PartitionSpec as P
+
+from avenir_tpu.models.common import resolve_remat_policy
+
+PIPE_AXIS = "pipe"
+
+
+def pipeline_axis_size() -> int:
+    """Size of the ambient mesh's 'pipe' axis (1 = pipelining off)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return 1
+    return dict(mesh.shape).get(PIPE_AXIS, 1)
+
+
+def layer_stack_dispatch(x, stacked, *, call, n_micro=0, remat=False,
+                         remat_policy=None, scan_fallback=None):
+    """THE one home for the pipeline-vs-scan choice, shared by every
+    dense family (gpt.py / llama.py have exactly one call site each):
+    GPipe when the ambient mesh has pipe > 1, else nnx.scan.
+    `scan_fallback()` overrides the non-pipelined path for families
+    whose scan carries extra state (llama's router-stats accumulation
+    tuple)."""
+    if pipeline_axis_size() > 1:
+        return pipeline_layer_stack(x, stacked, call=call, n_micro=n_micro,
+                                    remat=remat, remat_policy=remat_policy)
+    if scan_fallback is not None:
+        return scan_fallback()
+    from avenir_tpu.models.common import scan_layer_stack
+
+    return scan_layer_stack(x, stacked, call=call, remat=remat,
+                            remat_policy=remat_policy)
+
+
+def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
+                         remat_policy=None):
+    """Run (B, T, C) activations through a scan-stacked layer module with
+    the layer axis sharded over 'pipe', GPipe-scheduled. Drop-in
+    replacement for scan_layer_stack when the mesh has pipe > 1."""
+    p = pipeline_axis_size()
+    assert p > 1, "pipeline_layer_stack requires a pipe axis > 1"
+    if call is None:
+        call = lambda lyr, h: lyr(h)
+    graphdef, state = nnx.split(stacked)
+    n_layer = jax.tree.leaves(state)[0].shape[0]
+    assert n_layer % p == 0, (
+        f"n_layer={n_layer} must divide over pipe={p} stages"
+    )
+    B = x.shape[0]
+    if n_micro > 0:
+        M = n_micro
+    else:
+        # auto: 2p microbatches amortize the (p-1)-tick bubble; clamp to
+        # the largest divisor of B (tiny test batches) — a small M only
+        # costs bubble fraction, never correctness
+        M = min(2 * p, B)
+        while B % M:
+            M -= 1
+    assert B % M == 0, (
+        f"global batch {B} must divide into {M} pipeline microbatches "
+        "(set pipeline_microbatches to a divisor)"
+    )
+    state_specs = jax.tree.map(
+        lambda a: P(PIPE_AXIS, *([None] * (a.ndim - 1))), state
+    )
+    x_spec = P(*([None] * x.ndim))
+    # XLA:CPU's float-normalization pass CHECK-crashes ("Invalid binary
+    # instruction opcode copy", hlo_instruction.cc) on bf16 ppermute/psum
+    # inside a partial-manual region (minimal repro in the r4 notes;
+    # fp32 compiles fine, and TPU has native bf16 collectives so the
+    # pass never fires there). Off-TPU, move activations between stages
+    # in fp32 — bf16->fp32->bf16 is exact, so the trajectory is
+    # bit-identical; the 2x hop bytes only exist on the CPU harness.
+    f32_transport = (x.dtype == jnp.bfloat16
+                     and jax.default_backend() != "tpu")
+    t_dtype = jnp.float32 if f32_transport else x.dtype
+    c_dtype = x.dtype  # the layers always compute in the original dtype
+
+    def apply_layer(layer_state, h):
+        # plain lax.scan + direct module call instead of scan_layer_stack:
+        # nnx transforms refuse graph nodes created at an outer trace
+        # level, and this sits at shard_map->scan(tick)->scan(layer) depth
+        blk = nnx.merge(graphdef, layer_state)
+        return call(blk, h)
+
+    if remat:
+        apply_layer = jax.checkpoint(
+            apply_layer, policy=resolve_remat_policy(remat_policy)
+        )
+
+    def body(state_local, xl):
+        s = jax.lax.axis_index(PIPE_AXIS)
+        Bg, T, C = xl.shape
+        xm = xl.reshape(Bg // M, M, T, C)  # micro m = xm[:, m] (batch
+        # dim 0 keeps its data/fsdp sharding; the micro dim is unsharded)
+
+        def run_local_stack(h):
+            def layer_body(h, layer_state):
+                return apply_layer(layer_state, h), None
+
+            out, _ = jax.lax.scan(layer_body, h, state_local)
+            return out
+
+        def tick(carry, t):
+            outs, recv = carry
+            mi = jnp.clip(t - s, 0, M - 1)
+            inp = jnp.where(s == 0, xm[:, mi], recv).astype(c_dtype)
+            out = run_local_stack(inp)
+            recv_next = jax.lax.ppermute(
+                out.astype(t_dtype), PIPE_AXIS,
+                [(i, i + 1) for i in range(p - 1)]
+            )
+            active = jnp.logical_and(
+                s == p - 1, jnp.logical_and(t - s >= 0, t - s < M)
+            )
+            outs = jnp.where(active, outs.at[:, mi].set(out.astype(t_dtype)),
+                             outs)
+            return (outs, recv_next), None
+
+        (outs, _), _ = jax.lax.scan(
+            tick, (jnp.zeros(xm.shape, t_dtype),
+                   jnp.zeros(xm[:, 0].shape, t_dtype)),
+            jnp.arange(M + p - 1),
+        )
+        # only stage p-1 holds real outputs; masked psum broadcasts them.
+        # The region returns t_dtype: its replicated-over-pipe output
+        # transposes to a psum of the COTANGENT at the boundary, which
+        # must also avoid bf16 off-TPU (same XLA:CPU crash, bwd-side) —
+        # the cast back to compute dtype happens outside the shard_map
+        outs = jnp.where(s == p - 1, outs, jnp.zeros_like(outs))
+        outs = jax.lax.psum(outs, PIPE_AXIS)
+        return outs.reshape(Bg, T, C)
+
+    f = jax.shard_map(
+        body, in_specs=(state_specs, x_spec), out_specs=x_spec,
+        check_vma=False, axis_names={PIPE_AXIS},
+    )
+    # also keep the region INPUT in t_dtype: its cotangent rides the
+    # reverse boundary the same way
+    return f(state, x.astype(t_dtype)).astype(x.dtype)

@@ -54,6 +54,25 @@ def build_model_factory(cfg, model_args, mesh=None):
     import dataclasses
 
     mt = cfg["model_type"]
+    if mesh is not None and mesh.shape.get("pipe", 1) > 1:
+        # pipeline parallelism shards the stacked layer axis
+        # (parallel/pipeline.py) — there is nothing to shard without it
+        assert cfg.get("scan_layers", False), (
+            f"a pipe:{mesh.shape['pipe']} mesh requires scan_layers=True "
+            "(pipeline stages own slices of the stacked layer params)"
+        )
+        # ring/ulysses wrap attention in their own check_vma=False
+        # shard_map; nested inside the pipeline's partial-manual region
+        # that mis-reduces cotangents (the same defect measured for the
+        # pallas wrap — 1.9e-3 trajectory divergence on pipe×context,
+        # reproduced on the harness). Fail loud until CP-under-PP has a
+        # correct composition.
+        assert mesh.shape.get("context", 1) == 1, (
+            f"pipe:{mesh.shape['pipe']} cannot compose with context:"
+            f"{mesh.shape['context']} yet (sequence-parallel attention's "
+            "shard_map nests incorrectly inside the pipeline region); "
+            "drop one of the two axes"
+        )
     cp = None
     if mesh is not None and mesh.shape.get("context", 1) > 1:
         cp = cfg.get("context_parallel_impl", "ring")
@@ -84,6 +103,7 @@ def build_model_factory(cfg, model_args, mesh=None):
             remat=cfg["remat"],
             remat_policy=cfg.get("remat_policy", "nothing"),
             scan_layers=cfg.get("scan_layers", False),
+            pipeline_microbatches=cfg.get("pipeline_microbatches", 0),
         )
         return mt, gcfg, (lambda seed: GPT(gcfg, rngs=nnx.Rngs(seed)))
     if mt == "llama":

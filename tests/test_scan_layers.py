@@ -132,9 +132,11 @@ def test_scan_partition_rules_have_leading_layer_axis():
     assert scanned, "scan model should have h_scan params"
     for p in scanned:
         spec = tuple(specs[p])
-        assert spec[0] is None, (p, spec)  # layer axis never sharded
+        # the layer axis shards over 'pipe' (pipeline parallelism, r4);
+        # on meshes without a pipe axis the size-1 entry is inert
+        assert spec[0] == "pipe", (p, spec)
         # the underlying rule still applies to the trailing dims
-    # kernel under scan is (L, in, out): spec must not shard dim0
+    # kernel under scan is (L, in, out): spec dim0 is the layer axis
     k = next(p for p in scanned if p[-1] == "kernel" and "c_attn" in p)
     flat = dict(nnx.state(model, nnx.Param).flat_state())
     assert len(flat[k].get_value().shape) == 3
