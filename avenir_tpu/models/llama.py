@@ -212,41 +212,20 @@ class Llama(nnx.Module):
 
         stats_sum = self._zero_router_stats()
         if self.config.scan_layers:
-            from avenir_tpu.parallel.pipeline import (
-                layer_stack_dispatch, pipeline_axis_size,
-            )
+            from avenir_tpu.parallel.pipeline import layer_stack_dispatch
 
-            if pipeline_axis_size() > 1:
-                # the pipeline carries activations only; router-stats
-                # families (MoE) need stats plumbing across stages —
-                # not supported yet
-                assert all(s.ndim == 0 for s in
-                           jax.tree.leaves(stats_sum)), (
-                    "pipeline parallelism does not support router-stats "
-                    "(MoE) models yet; use fsdp/expert/tensor axes"
-                )
-
-            def scan_call(lyr, carry):
-                h, acc = carry
-                h, s = apply(lyr, h)
-                return (h, jax.tree.map(jnp.add, acc, s))
-
-            def scan_fallback():
-                return scan_layer_stack(
-                    (x, stats_sum), self.layers_scan, call=scan_call,
-                    remat=self.config.remat,
-                    remat_policy=self.config.remat_policy,
-                )
-
-            out = layer_stack_dispatch(
+            # router stats ride the shared aux carry: the scan path
+            # accumulates them through its carry, a pipe mesh through the
+            # pipeline's masked tick/psum machinery (batch-mean contract;
+            # NB MoE capacity is then computed per MICRObatch — see
+            # pipeline_layer_stack)
+            x, stats_sum = layer_stack_dispatch(
                 x, self.layers_scan,
-                call=lambda lyr, h: apply(lyr, h)[0],
+                call=apply, aux0=stats_sum,
                 n_micro=self.config.pipeline_microbatches,
                 remat=self.config.remat,
                 remat_policy=self.config.remat_policy,
-                scan_fallback=scan_fallback,
             )
-            x, stats_sum = out if isinstance(out, tuple) else (out, stats_sum)
         else:
             layer_fn = (nnx.remat(apply,
                                   policy=resolve_remat_policy(

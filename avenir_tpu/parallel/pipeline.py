@@ -56,29 +56,51 @@ def pipeline_axis_size() -> int:
 
 
 def layer_stack_dispatch(x, stacked, *, call, n_micro=0, remat=False,
-                         remat_policy=None, scan_fallback=None):
+                         remat_policy=None, aux0=None):
     """THE one home for the pipeline-vs-scan choice, shared by every
     dense family (gpt.py / llama.py have exactly one call site each):
-    GPipe when the ambient mesh has pipe > 1, else nnx.scan.
-    `scan_fallback()` overrides the non-pipelined path for families
-    whose scan carries extra state (llama's router-stats accumulation
-    tuple)."""
+    GPipe when the ambient mesh has pipe > 1, else nnx.scan. The aux
+    contract is shared by both paths: with `aux0` given, `call(layer, h)`
+    returns (h, aux) and the result is (out, aux0 + sum-over-layers) —
+    the scan path accumulates through its carry, the pipeline through
+    its tick/psum machinery (batch-mean statistics only; see
+    pipeline_layer_stack)."""
     if pipeline_axis_size() > 1:
         return pipeline_layer_stack(x, stacked, call=call, n_micro=n_micro,
-                                    remat=remat, remat_policy=remat_policy)
-    if scan_fallback is not None:
-        return scan_fallback()
+                                    remat=remat, remat_policy=remat_policy,
+                                    aux0=aux0)
     from avenir_tpu.models.common import scan_layer_stack
 
-    return scan_layer_stack(x, stacked, call=call, remat=remat,
+    if aux0 is None:
+        return scan_layer_stack(x, stacked, call=call, remat=remat,
+                                remat_policy=remat_policy)
+
+    def aux_call(lyr, carry):
+        h, acc = carry
+        h, a = call(lyr, h)
+        return (h, jax.tree.map(jnp.add, acc, a))
+
+    return scan_layer_stack((x, aux0), stacked, call=aux_call, remat=remat,
                             remat_policy=remat_policy)
 
 
 def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
-                         remat_policy=None):
+                         remat_policy=None, aux0=None):
     """Run (B, T, C) activations through a scan-stacked layer module with
     the layer axis sharded over 'pipe', GPipe-scheduled. Drop-in
-    replacement for scan_layer_stack when the mesh has pipe > 1."""
+    replacement for scan_layer_stack when the mesh has pipe > 1.
+
+    `aux0` (optional, a pytree of fp32 BATCH-MEAN statistics — MoE
+    router stats): `call(layer, h)` must then return (h, aux), and the
+    function returns (out, aux0 + aux_sum) where aux_sum accumulates
+    over local layers, real (non-bubble) ticks, and stages, scaled by
+    1/M. Exact for batch means: microbatches are equal-sized, so the
+    mean of micro-means IS the full-batch mean. (Capacity-style values
+    derived from the per-forward token count — Mixtral's expert queue
+    C — are computed per MICRObatch under the pipeline; exact parity
+    with the unpipelined model therefore holds when capacity admits
+    every token, and drop behavior matches a micro-batched run
+    otherwise.)"""
     p = pipeline_axis_size()
     assert p > 1, "pipeline_layer_stack requires a pipe axis > 1"
     if call is None:
@@ -123,12 +145,17 @@ def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
         # nnx transforms refuse graph nodes created at an outer trace
         # level, and this sits at shard_map->scan(tick)->scan(layer) depth
         blk = nnx.merge(graphdef, layer_state)
-        return call(blk, h)
+        out = call(blk, h)
+        if aux0 is None:
+            return out, jnp.float32(0.0)
+        return out  # (h, aux) per the aux contract
 
     if remat:
         apply_layer = jax.checkpoint(
             apply_layer, policy=resolve_remat_policy(remat_policy)
         )
+    aux_zero = (jax.tree.map(lambda a: jnp.zeros_like(a, jnp.float32), aux0)
+                if aux0 is not None else jnp.float32(0.0))
 
     def body(state_local, xl):
         s = jax.lax.axis_index(PIPE_AXIS)
@@ -138,30 +165,35 @@ def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
 
         def run_local_stack(h):
             def layer_body(h, layer_state):
-                return apply_layer(layer_state, h), None
+                h, aux = apply_layer(layer_state, h)
+                return h, aux
 
-            out, _ = jax.lax.scan(layer_body, h, state_local)
-            return out
+            out, auxs = jax.lax.scan(layer_body, h, state_local)
+            return out, jax.tree.map(lambda a: jnp.sum(a, axis=0), auxs)
 
         def tick(carry, t):
-            outs, recv = carry
+            outs, recv, aux_acc = carry
             mi = jnp.clip(t - s, 0, M - 1)
             inp = jnp.where(s == 0, xm[:, mi], recv).astype(c_dtype)
-            out = run_local_stack(inp)
+            out, aux_m = run_local_stack(inp)
             recv_next = jax.lax.ppermute(
                 out.astype(t_dtype), PIPE_AXIS,
                 [(i, i + 1) for i in range(p - 1)]
             )
-            active = jnp.logical_and(
-                s == p - 1, jnp.logical_and(t - s >= 0, t - s < M)
+            # this stage processed a REAL microbatch this tick (not a
+            # warmup/drain bubble): its aux contribution counts
+            real = jnp.logical_and(t - s >= 0, t - s < M)
+            aux_acc = jax.tree.map(
+                lambda acc, a: acc + jnp.where(real, a, 0.0), aux_acc, aux_m
             )
+            active = jnp.logical_and(s == p - 1, real)
             outs = jnp.where(active, outs.at[:, mi].set(out.astype(t_dtype)),
                              outs)
-            return (outs, recv_next), None
+            return (outs, recv_next, aux_acc), None
 
-        (outs, _), _ = jax.lax.scan(
+        (outs, _, aux_acc), _ = jax.lax.scan(
             tick, (jnp.zeros(xm.shape, t_dtype),
-                   jnp.zeros(xm[:, 0].shape, t_dtype)),
+                   jnp.zeros(xm[:, 0].shape, t_dtype), aux_zero),
             jnp.arange(M + p - 1),
         )
         # only stage p-1 holds real outputs; masked psum broadcasts them.
@@ -171,12 +203,22 @@ def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
         # the cast back to compute dtype happens outside the shard_map
         outs = jnp.where(s == p - 1, outs, jnp.zeros_like(outs))
         outs = jax.lax.psum(outs, PIPE_AXIS)
-        return outs.reshape(Bg, T, C)
+        # aux: stages hold disjoint layer groups -> psum sums all layers;
+        # /M folds the sum over micros back to the full-batch mean
+        aux_tot = jax.tree.map(
+            lambda a: jax.lax.psum(a, PIPE_AXIS) / M, aux_acc
+        )
+        return outs.reshape(Bg, T, C), aux_tot
 
+    aux_specs = jax.tree.map(lambda a: P(*([None] * a.ndim)), aux_zero)
     f = jax.shard_map(
-        body, in_specs=(state_specs, x_spec), out_specs=x_spec,
+        body, in_specs=(state_specs, x_spec), out_specs=(x_spec, aux_specs),
         check_vma=False, axis_names={PIPE_AXIS},
     )
     # also keep the region INPUT in t_dtype: its cotangent rides the
     # reverse boundary the same way
-    return f(state, x.astype(t_dtype)).astype(x.dtype)
+    out, aux_tot = f(state, x.astype(t_dtype))
+    out = out.astype(x.dtype)
+    if aux0 is None:
+        return out
+    return out, jax.tree.map(jnp.add, aux0, aux_tot)
