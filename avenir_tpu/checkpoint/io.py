@@ -228,31 +228,38 @@ class AsyncCheckpoint:
 
 
 def _tree_device_bytes(tree):
-    """Bytes a jnp.copy of `tree` would allocate ON ONE DEVICE: the sum of
-    the shards that live on the first device. A REPLICATED leaf holds a
+    """Bytes a jnp.copy of `tree` would allocate on the WORST local
+    device: per-device shard totals, maxed. A REPLICATED leaf holds a
     full copy per device (its per-device cost is the full nbytes, NOT
     nbytes / n_shards — dividing would understate the guard by
     device_count× exactly when params are replicated, e.g. pure-DP
-    meshes)."""
-    total = 0
+    meshes); mixed replicated/sharded trees can load devices unevenly,
+    so the guard takes the max, not device 0's total."""
+    per_dev = {}
+    host_only = 0
     for leaf in jax.tree.leaves(tree):
         shards = getattr(leaf, "addressable_shards", None)
         if shards:
-            dev0 = shards[0].device
-            total += sum(s.data.nbytes for s in shards if s.device == dev0)
+            for s in shards:
+                per_dev[s.device] = per_dev.get(s.device, 0) + s.data.nbytes
         elif hasattr(leaf, "nbytes"):
-            total += int(leaf.nbytes)
-    return total
+            host_only += int(leaf.nbytes)
+    return (max(per_dev.values()) if per_dev else 0) + host_only
 
 
 def _device_free_bytes():
-    """Free HBM on the first local device, or None when the platform
-    exposes no memory stats (CPU harness)."""
-    try:
-        stats = jax.local_devices()[0].memory_stats() or {}
-        return int(stats["bytes_limit"]) - int(stats["bytes_in_use"])
-    except Exception:
-        return None
+    """Free HBM on the TIGHTEST local device (min over local devices), or
+    None when the platform exposes no memory stats (CPU harness). Min,
+    not device 0: asymmetric residency (replicated leaves beside sharded
+    ones) means the copy can OOM on a device other than the first."""
+    frees = []
+    for d in jax.local_devices():
+        try:  # per-device: one stats-less device must not disable the guard
+            stats = d.memory_stats() or {}
+            frees.append(int(stats["bytes_limit"]) - int(stats["bytes_in_use"]))
+        except Exception:
+            continue
+    return min(frees) if frees else None
 
 
 def save_checkpoint_async(out_dir, *, params, opt_state, **kw):
@@ -284,7 +291,9 @@ def save_checkpoint_async(out_dir, *, params, opt_state, **kw):
     # synchronous save (training pauses for the write, but survives)
     # instead. 10% headroom keeps the copy from landing exactly at the
     # limit (XLA needs scratch).
-    need = _tree_device_bytes(params) + _tree_device_bytes(opt_state)
+    # ONE combined tree: params' heaviest device and opt_state's can
+    # differ; maxing them separately would overstate any single device
+    need = _tree_device_bytes((params, opt_state))
     free = _device_free_bytes()
     if free is not None and need > 0.9 * free:
         print(f"[ckpt] async snapshot needs {need / 1e9:.2f} GB but only "

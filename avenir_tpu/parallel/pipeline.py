@@ -120,6 +120,19 @@ def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
         M = min(2 * p, B)
         while B % M:
             M -= 1
+        if M < p:
+            # e.g. prime B: auto-selection degraded below p and the
+            # bubble dominates ((p-1)/(M+p-1) >= 50%) — tell the user
+            # instead of silently serializing the pipeline
+            import warnings
+
+            warnings.warn(
+                f"pipeline auto-microbatching picked M={M} < p={p} stages "
+                f"(batch {B} has no divisor in [p, 2p]); bubble fraction "
+                f"{(p - 1) / (M + p - 1):.0%} — set pipeline_microbatches "
+                "or pick a batch size divisible by a multiple of the "
+                "stage count", stacklevel=2,
+            )
     assert B % M == 0, (
         f"global batch {B} must divide into {M} pipeline microbatches "
         "(set pipeline_microbatches to a divisor)"

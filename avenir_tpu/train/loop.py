@@ -455,7 +455,10 @@ def run_training(cfg):
         if not np.all(np.isfinite(losses_np)):
             bad = start + int(np.argmax(~np.isfinite(losses_np)))
             raise FloatingPointError(
-                f"non-finite loss at iter {bad}; rerun "
+                f"non-finite loss at iter {bad} (windowed dispatch checks "
+                "one window late: up to ~2 windows of further optimizer "
+                "steps ran on the bad params before this abort; the "
+                "checkpoint cadence is unaffected); rerun "
                 "with --debug_nans=True to locate the producing op"
             )
         if not master:

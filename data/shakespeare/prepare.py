@@ -22,19 +22,20 @@ URL = ("https://raw.githubusercontent.com/karpathy/char-rnn/master/data/"
 
 
 def _encode_or_zipf(text, seed=1337, n_tokens=400_000):
-    """GPT-2 BPE ids for `text`, or (offline, no tiktoken cache) a
-    Zipf-distributed id stream of comparable size — same fallback shape
-    as openwebtext's synthetic prep."""
+    """(ids, is_bpe): GPT-2 BPE ids for `text`, or (offline, no tiktoken
+    cache) a Zipf-distributed id stream of comparable size — same
+    fallback shape as openwebtext's synthetic prep."""
     try:
         import tiktoken
 
         enc = tiktoken.get_encoding("gpt2")
-        return np.array(enc.encode_ordinary(text), dtype=np.uint16)
+        return np.array(enc.encode_ordinary(text), dtype=np.uint16), True
     except Exception:
         rng = np.random.default_rng(seed)
         ranks = np.arange(1, 50258, dtype=np.float64)
         probs = (1.0 / ranks) / (1.0 / ranks).sum()
-        return rng.choice(50257, size=n_tokens, p=probs).astype(np.uint16)
+        ids = rng.choice(50257, size=n_tokens, p=probs).astype(np.uint16)
+        return ids, False
 
 
 def prepare(here: str, synthetic: bool = False):
@@ -52,18 +53,33 @@ def prepare(here: str, synthetic: bool = False):
         if os.path.exists(input_path):
             with open(input_path) as f:
                 text = f.read()
+    text_is_synthetic = text is None
     if text is None:
         from avenir_tpu.utils.corpus import synthetic_corpus
 
         text = synthetic_corpus(n_chars=1_600_000, seed=1337)
 
-    ids = _encode_or_zipf(text)
+    ids, ids_are_bpe = _encode_or_zipf(text)
     # 90/10 split (the reference's ratio for this corpus); val stays
     # comfortably larger than any block_size
     n = int(0.9 * len(ids))
     ids[:n].tofile(os.path.join(here, "train.bin"))
     ids[n:].tofile(os.path.join(here, "val.bin"))
-    print(f"train tokens={n:,}, val tokens={len(ids) - n:,}")
+    # record which variant produced the committed memmaps — in the
+    # zero-egress sandbox the bins are usually the synthetic fallback,
+    # and nothing else distinguishes them from real BPE output
+    tok = "tiktoken-gpt2-bpe" if ids_are_bpe else "zipf-fallback"
+    if not ids_are_bpe:
+        # the Zipf fallback ignores the text entirely: the bins derive
+        # from no corpus, real or synthetic
+        corpus = "none (zipf ids; text unused)"
+    else:
+        corpus = "synthetic" if text_is_synthetic else "tinyshakespeare"
+    with open(os.path.join(here, "PROVENANCE.txt"), "w") as f:
+        f.write(f"corpus={corpus}\ntokenizer={tok}\n"
+                f"train_tokens={n}\nval_tokens={len(ids) - n}\n")
+    print(f"train tokens={n:,}, val tokens={len(ids) - n:,} "
+          f"({corpus}/{tok})")
 
 
 if __name__ == "__main__":

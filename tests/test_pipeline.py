@@ -4,8 +4,8 @@ BEYOND the blueprint: SURVEY.md §2c marks PP as a parity non-goal; it is
 implemented anyway as the last missing first-class strategy. The GPipe
 schedule must be pure layout like every other axis: loss trajectories on
 pipe meshes — alone, composed with data/fsdp/tensor, with remat, with
-the pallas kernel's nested shard_map wrap, and for Llama — equal the
-single-device run; save/resume works with the layer axis sharded.
+the pallas kernel, and for Llama — equal the single-device run;
+save/resume works with the layer axis sharded.
 """
 
 import numpy as np
@@ -38,8 +38,11 @@ def _losses(res):
     ("fsdp:2,pipe:2", {}),
     ("pipe:2,tensor:2", {}),
     ("pipe:2", dict(remat=True)),
-    # the pallas wrap nests INSIDE the pipeline's partial-manual region
-    # (free axes exclude 'pipe'); interpret mode on the CPU harness
+    # pallas inside the pipeline's partial-manual region: the dispatcher
+    # detects the Manual 'pipe' axis and REFUSES to wrap (nesting a
+    # check_vma=False shard_map there mis-reduces cotangents, measured
+    # 7e-3) — the kernel runs direct under GSPMD, correctness via
+    # replication; interpret mode on the CPU harness
     ("data:2,pipe:2", dict(attn_impl="pallas")),
     # llama: GQA blocks through the pipeline (activation-only carry)
     ("pipe:2", dict(model_type="llama", n_head=4, n_kv_head=2,
