@@ -98,9 +98,9 @@ def resolve_attention_impl(impl, *, use_dropout=False, segment_ids=None):
 
 
 def _flash_shard_specs(layout, q_shape, h, h_kv):
-    """PartitionSpec (shared by q/k/v/out — head entries name the same
-    axis for H and H_kv dims) for running the Pallas flash kernel under
-    SPMD, or None when no wrap is needed.
+    """(PartitionSpec, axis_names) for running the Pallas flash kernel
+    under SPMD — the spec is shared by q/k/v/out (head entries name the
+    same axis for H and H_kv dims) — or None when no wrap is needed.
 
     GSPMD has NO partitioning rule for the pallas_call custom call: on an
     8-device data:2,fsdp:2,tensor:2 mesh the jitted kernel compiles with
@@ -110,27 +110,28 @@ def _flash_shard_specs(layout, q_shape, h, h_kv):
     heads, so the dispatcher wraps the kernel in jax.shard_map over
     whichever of those mesh axes exist and divide the dims.
 
-    If ANY mesh axis is already Manual — we're inside an enclosing
-    shard_map body (ulysses's local kernel, or the GPipe pipeline
-    region) — the wrap stays out entirely and the kernel runs direct.
-    Nesting a check_vma=False shard_map inside a partial-manual region
-    mis-reduces parameter cotangents (measured: 7e-3 grad error on
-    pipe×data meshes), and check_vma=True cannot run the interpret-mode
-    kernels on this jax version (vma mismatch inside pallas interpret's
-    dynamic_slice — upstream limitation). Direct-under-GSPMD is correct
-    (semantics-preserving replication); pipeline meshes that want peak
-    attention throughput should keep batch axes off the attention
-    operands or use xla attention inside the pipe region — measured
-    tradeoffs belong in BASELINE.md when a pipe rung is benched."""
+    The wrap names ALL free (non-Manual) mesh axes — never the axes an
+    enclosing shard_map (the GPipe 'pipe' region) is already manual
+    over. Naming a Manual axis whose in_spec entry is absent claims the
+    inputs are replicated over it, and the shard_map transpose then
+    psums cotangents over that axis — stage activations are NOT
+    replicated over 'pipe', so that psum silently corrupted every
+    upstream gradient (measured 2.8e-3; the r4 release refused to nest
+    at all and ran the kernel replicated inside pipeline meshes). See
+    partition.free_axis_names for the rule; naming all FREE axes (not
+    just the ones in the spec) also keeps GSPMD from re-entering the
+    body and replicating the kernel over an unnamed free axis.
+    check_vma=True would catch this class statically but cannot run the
+    interpret-mode kernels (vma mismatch inside pallas's hlo_interpreter
+    — upstream limitation, re-verified on jax 0.9)."""
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty:
         return None
-    from jax.sharding import AxisType
+    from avenir_tpu.parallel.partition import free_axis_names
 
+    names = free_axis_names(mesh)
     sizes = dict(mesh.shape)
-    if any(t == AxisType.Manual for t in mesh.axis_types):
-        return None  # inside an enclosing shard_map: run the kernel direct
-    free = {n: sizes[n] for n in mesh.axis_names if sizes[n] > 1}
+    free = {n: s for n, s in sizes.items() if n in names and s > 1}
     if not free:
         return None
     b = q_shape[0]
@@ -148,8 +149,8 @@ def _flash_shard_specs(layout, q_shape, h, h_kv):
     from jax.sharding import PartitionSpec as P
 
     if layout == "bhtd":
-        return P(b_entry, head, None, None)
-    return P(b_entry, None, head, None)
+        return P(b_entry, head, None, None), names
+    return P(b_entry, None, head, None), names
 
 
 def causal_attention(q, k, v, *, dropout_rate=0.0, deterministic=True,
@@ -216,15 +217,16 @@ def causal_attention(q, k, v, *, dropout_rate=0.0, deterministic=True,
         # harness, the driver's virtual-device dryrun) the kernel runs in
         # interpret mode — same trace, emulated execution.
         interpret = not _on_tpu()
-        spec = _flash_shard_specs(layout, q.shape, q.shape[h_axis],
-                                  k.shape[h_axis])
-        if spec is not None:
+        sn = _flash_shard_specs(layout, q.shape, q.shape[h_axis],
+                                k.shape[h_axis])
+        if sn is not None:
+            spec, names = sn
             body = lambda ql, kl, vl: flash_attention(
                 ql, kl, vl, causal=True, layout=layout, interpret=interpret
             )
             return jax.shard_map(
                 body, in_specs=(spec, spec, spec), out_specs=spec,
-                check_vma=False,
+                check_vma=False, axis_names=names,
             )(q, k, v)
         return flash_attention(q, k, v, causal=True, layout=layout,
                                interpret=interpret)

@@ -160,6 +160,37 @@ BATCH_AXES = ("data", "fsdp", "expert")
 TP_AXIS = "tensor"
 
 
+def free_axis_names(mesh=None):
+    """Axis names of `mesh` (ambient abstract mesh when None) that are NOT
+    already Manual — i.e. the axes a nested `jax.shard_map` may go manual
+    over from the current trace position.
+
+    THE safety rule for every attention shard_map in this repo (flash
+    wrap, ring/ulysses): pass `axis_names=free_axis_names()`. A shard_map
+    that defaults to ALL mesh axes while some axis is already Manual
+    (e.g. 'pipe' inside the GPipe region) claims its inputs are
+    REPLICATED over that axis — the in_specs never mention it — and the
+    shard_map TRANSPOSE then inserts a psum over it on every cotangent.
+    Stage activations are NOT replicated over 'pipe', so that psum
+    silently corrupts every gradient upstream of the region (measured
+    2.8e-3 on a pipe:2,data:2 GPT before this rule; r4 measured 7e-3 and
+    fenced it off by refusing to nest at all — tools/exp_v1_partition.py
+    and exp_v1_nested.py hold the round-5 repro ladder). Naming only the
+    free axes keeps Manual axes out of the inner shard_map's domain
+    entirely: no replication claim, no transpose psum, exact gradients
+    (1e-8 on the same repro)."""
+    import jax
+
+    if mesh is None:
+        mesh = jax.sharding.get_abstract_mesh()
+    from jax.sharding import AxisType
+
+    return frozenset(
+        n for n, t in zip(mesh.axis_names, mesh.axis_types)
+        if t != AxisType.Manual
+    )
+
+
 def batch_pspec(with_accum: bool = True) -> P:
     """Global batch layout: batch dim sharded over every data-parallel-like
     axis — 'expert' is a data axis outside the MoE blocks (the standard EP

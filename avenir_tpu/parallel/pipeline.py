@@ -22,14 +22,19 @@ nnx.remat.
 
 Composition. Because the region is manual only over 'pipe', everything
 else stays GSPMD: batch stays sharded over data/fsdp, weights over
-fsdp/tensor. Nested shard_maps are NOT allowed inside (a check_vma=False
-shard_map nested in a partial-manual region mis-reduces parameter
-cotangents — measured 7e-3): the pallas dispatcher detects the Manual
-axis and runs its kernel direct under GSPMD (ops/attention.py), and the
-training loop REJECTS pipe×context meshes (ring/ulysses would nest the
-same way; loop.py fail-loud assert). Bubble fraction is the standard
-(p-1)/(M+p-1); pick M = pipeline_microbatches >= p to amortize
-(default 2p).
+fsdp/tensor. Nested shard_maps compose since r5 PROVIDED they name only
+the free (non-Manual) axes — partition.free_axis_names documents the
+transpose hazard (a nested wrap that default-names the Manual 'pipe'
+axis claims replication over it and psums cotangents across stages;
+measured 2.8e-3 gradient corruption, 7e-3 in the r4 form). The pallas
+flash wrap and ring/ulysses all follow the rule, so pipe meshes keep
+partitioned attention (zero all-gathers, test_pallas_spmd) and
+pipe×context trains sequence-parallel inside the pipeline
+(tests/test_pipeline.py pp-cp-* cases). One residual constraint:
+jax.lax.axis_index cannot lower in a nested shard_map under Shardy —
+ring ships its position in as data instead (ring_attention). Bubble
+fraction is the standard (p-1)/(M+p-1); pick M =
+pipeline_microbatches >= p to amortize (default 2p).
 
 Trajectory equivalence vs the unpipelined model is exact up to fp
 reassociation: the same layers run in the same order per token, only

