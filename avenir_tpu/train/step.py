@@ -21,10 +21,16 @@ def make_step_fns(graphdef, *, dropout: float):
       x, y: (grad_accum, B, T) int32. `tx` is the optax transform (static).
     """
 
+    def _i32(t):
+        # batches arrive uint16 (the loader's wire format — half the H2D
+        # bytes; data/loader.py) — widen on device, fused into the gather
+        return t.astype(jnp.int32) if t.dtype != jnp.int32 else t
+
     def micro_loss(params, x, y, step_rng):
         model = nnx.merge(graphdef, params)
         rngs = nnx.Rngs(dropout=step_rng) if dropout > 0.0 else None
-        _, loss = model(x, y, deterministic=dropout == 0.0, rngs=rngs)
+        _, loss = model(_i32(x), _i32(y), deterministic=dropout == 0.0,
+                        rngs=rngs)
         return loss
 
     def train_step(params, opt_state, tx, rng, x, y):
@@ -56,7 +62,7 @@ def make_step_fns(graphdef, *, dropout: float):
 
     def eval_step(params, x, y):
         model = nnx.merge(graphdef, params)
-        _, loss = model(x, y, deterministic=True)
+        _, loss = model(_i32(x), _i32(y), deterministic=True)
         return loss
 
     return train_step, eval_step

