@@ -394,6 +394,8 @@ def save_checkpoint_sharded_async(out_dir, *, params, opt_state, hyper,
     # still backgrounds) instead of OOMing on the copies
     need = _tree_device_bytes(tuple(trees.values()))
     free = _device_free_bytes()
+    shapes = {name: {k: tuple(a.shape) for k, a in flat.items()}
+              for name, flat in trees.items()}
     if free is not None and need > 0.9 * free:
         print(f"[ckpt] sharded async snapshot needs {need / 1e9:.2f} GB "
               f"but only {free / 1e9:.2f} GB HBM is free — fetching "
@@ -404,14 +406,10 @@ def save_checkpoint_sharded_async(out_dir, *, params, opt_state, hyper,
                    for k, a in flat.items()}
             for name, flat in trees.items()
         }
-        shapes = {name: {k: tuple(a.shape) for k, a in flat.items()}
-                  for name, flat in trees.items()}
     else:
         copies = {name: {k: jnp.copy(a) for k, a in flat.items()}
                   for name, flat in trees.items()}
         snap = None
-        shapes = {name: {k: tuple(a.shape) for k, a in flat.items()}
-                  for name, flat in trees.items()}
 
     pid, nproc = jax.process_index(), jax.process_count()
     path = os.path.join(out_dir, _SHARD_FMT.format(pid))
@@ -497,10 +495,17 @@ def load_sharded_checkpoint(out_dir, meta_only=False):
             return None
     nproc = headers[0][1]["process_count"]
     iters = {h["iter_num"] for _, h in headers}
-    if len(headers) != nproc or len(iters) != 1:
+    nprocs = {h["process_count"] for _, h in headers}
+    pids = {h["process_index"] for _, h in headers}
+    # pids/process_count uniformity: a crash between renames during a
+    # resume at a DIFFERENT process count can leave same-iter shards
+    # that tile different index ranges — assembling that union would
+    # silently mix np.empty garbage into live weights
+    if (len(headers) != nproc or len(iters) != 1 or len(nprocs) != 1
+            or pids != set(range(nproc))):
         print(f"[ckpt] sharded set in {out_dir} is incomplete or torn "
-              f"({len(headers)}/{nproc} files, iters {sorted(iters)}); "
-              "falling back to ckpt.pt")
+              f"({len(headers)}/{nproc} files, iters {sorted(iters)}, "
+              f"process_counts {sorted(nprocs)}); falling back to ckpt.pt")
         return None
     out = {k: headers[0][1][k] for k in
            ("iter_num", "best_val_loss", "count", "hyper", "model_args",

@@ -100,12 +100,22 @@ def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
     function returns (out, aux0 + aux_sum) where aux_sum accumulates
     over local layers, real (non-bubble) ticks, and stages, scaled by
     1/M. Exact for batch means: microbatches are equal-sized, so the
-    mean of micro-means IS the full-batch mean. (Capacity-style values
-    derived from the per-forward token count — Mixtral's expert queue
-    C — are computed per MICRObatch under the pipeline; exact parity
-    with the unpipelined model therefore holds when capacity admits
-    every token, and drop behavior matches a micro-batched run
-    otherwise.)"""
+    mean of micro-means IS the full-batch mean — and Mixtral's router
+    STATS are computed pre-capacity ('on intent'), so the aggregated
+    stats (and any aux loss derived from them, however nonlinear) equal
+    the unpipelined full-batch run exactly, drops or no drops.
+    Capacity-style values derived from the per-forward token count —
+    Mixtral's expert queue C — are computed per MICRObatch under the
+    pipeline; exact parity of the TOKEN OUTPUTS with the unpipelined
+    model therefore holds when capacity admits every token, and with
+    drops the outputs/CE-loss/grads match the mean of M independent
+    B/M-sized forwards over the STRIDED row groups b % M == m — the
+    (B,)->(B//M, M) reshape keeps the sharded batch dim intact, so the
+    micro axis is the fast-varying one (pinned by
+    test_pipeline_mixtral_drop_semantics_match_microbatched_oracle).
+    NB the mean of per-micro AUX losses is NOT the pipelined aux (the
+    aux is nonlinear in the stats; the pipeline aggregates stats first,
+    which is the faithful-to-full-batch choice)."""
     p = pipeline_axis_size()
     assert p > 1, "pipeline_layer_stack requires a pipe axis > 1"
     if call is None:

