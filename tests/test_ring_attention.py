@@ -79,3 +79,42 @@ def test_ring_trajectory_matches_single_device(char_dataset, tmp_path,
     ref_l = np.array([l for _, l in ref["loss_history"]])
     got_l = np.array([l for _, l in got["loss_history"]])
     np.testing.assert_allclose(got_l, ref_l, atol=3e-4, rtol=3e-4)
+
+
+def test_ring_blockwise_padding_interior_stripe():
+    """T/c not a multiple of the streaming block: the pad's phantom
+    positions alias the NEXT stripe's global positions on interior
+    stripes and must stay masked (review r5 — unmasked zero keys
+    inflated the softmax denominator by 0.24 max-abs). Exercised by
+    shrinking the block so Tk=16 pads to 2 blocks of 12."""
+    import avenir_tpu.parallel.ring_attention as ra
+
+    from avenir_tpu.ops.attention import causal_attention_reference
+
+    ctx = 2
+    mesh = make_mesh(f"context:{ctx}")
+    jax.set_mesh(mesh)
+    B, T, H, D = 2, 32, 4, 8
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    old = ra._BLOCK_K
+    ra._BLOCK_K = 12  # Tk = 16 → nb=2, pad=8: interior-stripe aliasing
+    ra._build_ring_body.cache_clear()  # bodies close over the block size
+    try:
+        def loss(f, q, k, v):
+            return jnp.sum(f(q, k, v) ** 2)
+
+        ref_g = jax.jit(jax.grad(
+            lambda q, k, v: loss(causal_attention_reference, q, k, v),
+            argnums=(0, 1, 2)))(q, k, v)
+        got_g = jax.jit(jax.grad(
+            lambda q, k, v: loss(ra.ring_causal_attention, q, k, v),
+            argnums=(0, 1, 2)))(q, k, v)
+        for a, b in zip(got_g, ref_g):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5, rtol=2e-5)
+    finally:
+        ra._BLOCK_K = old
+        ra._build_ring_body.cache_clear()

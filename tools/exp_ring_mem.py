@@ -1,0 +1,63 @@
+"""Measure compiled temp-memory of ring attention fwd+bwd on the 8-CPU
+harness (per-device, via XLA memory_analysis) — the A/B for the r5
+blockwise rewrite (VERDICT r4 missing #6: the dense per-hop
+(B,H,Tq,Tk) fp32 score matrices re-import the memory profile flash
+attention exists to avoid).
+
+Run: python tools/exp_ring_mem.py [T] [c] [B] [H] [H_kv] [D]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_platforms", "cpu")
+
+from avenir_tpu.parallel.mesh import make_mesh
+from avenir_tpu.parallel.ring_attention import ring_causal_attention
+
+
+def main():
+    a = sys.argv[1:]
+    T = int(a[0]) if len(a) > 0 else 4096
+    c = int(a[1]) if len(a) > 1 else 2
+    B = int(a[2]) if len(a) > 2 else 1
+    H = int(a[3]) if len(a) > 3 else 8
+    H_kv = int(a[4]) if len(a) > 4 else 2
+    D = int(a[5]) if len(a) > 5 else 64
+    mesh = make_mesh(f"context:{c}")
+    jax.set_mesh(mesh)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P(None, "context", None, None))
+    rng = np.random.default_rng(0)
+    q = jax.device_put(rng.standard_normal((B, T, H, D)).astype(np.float32), sh)
+    k = jax.device_put(rng.standard_normal((B, T, H_kv, D)).astype(np.float32), sh)
+    v = jax.device_put(rng.standard_normal((B, T, H_kv, D)).astype(np.float32), sh)
+
+    def loss(q, k, v):
+        return jnp.sum(ring_causal_attention(q, k, v) ** 2)
+
+    comp = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, k, v).compile()
+    ma = comp.memory_analysis()
+    print(f"T={T} c={c} B={B} H={H}/{H_kv} D={D}: "
+          f"temp={ma.temp_size_in_bytes / 1e6:.1f} MB "
+          f"(args {ma.argument_size_in_bytes / 1e6:.1f}, "
+          f"out {ma.output_size_in_bytes / 1e6:.1f})")
+
+
+if __name__ == "__main__":
+    main()
