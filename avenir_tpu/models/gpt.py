@@ -66,6 +66,10 @@ class GPTConfig:
     # GPipe microbatches when the mesh has a pipe axis > 1 (requires
     # scan_layers; parallel/pipeline.py). 0 = auto (2x the pipe size).
     pipeline_microbatches: int = 0
+    # pipeline backward schedule: 'gpipe' (autodiff through the tick
+    # scan) or 'remat' (reverse-tick stage-input stash — the 1F1B
+    # activation-memory class; parallel/pipeline.py)
+    pipeline_schedule: str = "gpipe"
 
 
 class CausalSelfAttention(nnx.Module):
@@ -240,6 +244,7 @@ class GPT(nnx.Module):
                 n_micro=self.config.pipeline_microbatches,
                 remat=self.config.remat,
                 remat_policy=self.config.remat_policy,
+                schedule=self.config.pipeline_schedule,
             )
         else:
             if self.config.remat:

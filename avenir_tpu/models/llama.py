@@ -61,6 +61,7 @@ class LlamaConfig:
     # GPipe microbatches when the mesh has a pipe axis > 1 (requires
     # scan_layers; parallel/pipeline.py). 0 = auto (2x the pipe size).
     pipeline_microbatches: int = 0
+    pipeline_schedule: str = "gpipe"  # see GPTConfig/parallel.pipeline
 
     @classmethod
     def from_train_config(cls, cfg, model_args):
@@ -78,6 +79,7 @@ class LlamaConfig:
             remat_policy=cfg.get("remat_policy", "nothing"),
             scan_layers=cfg.get("scan_layers", False),
             pipeline_microbatches=cfg.get("pipeline_microbatches", 0),
+            pipeline_schedule=cfg.get("pipeline_schedule", "gpipe"),
         )
 
 
@@ -218,14 +220,20 @@ class Llama(nnx.Module):
             # accumulates them through its carry, a pipe mesh through the
             # pipeline's masked tick/psum machinery (batch-mean contract;
             # NB MoE capacity is then computed per MICRObatch — see
-            # pipeline_layer_stack)
-            x, stats_sum = layer_stack_dispatch(
-                x, self.layers_scan,
-                call=apply, aux0=stats_sum,
-                n_micro=self.config.pipeline_microbatches,
-                remat=self.config.remat,
-                remat_policy=self.config.remat_policy,
-            )
+            # pipeline_layer_stack). Families with no aux consumer
+            # (coef=0: plain Llama) skip the carry entirely — which also
+            # unlocks the aux-free 'remat' pipeline schedule for them
+            kw = dict(n_micro=self.config.pipeline_microbatches,
+                      remat=self.config.remat,
+                      remat_policy=self.config.remat_policy,
+                      schedule=self.config.pipeline_schedule)
+            if getattr(self.config, "router_aux_loss_coef", 0.0):
+                x, stats_sum = layer_stack_dispatch(
+                    x, self.layers_scan, call=apply, aux0=stats_sum, **kw)
+            else:
+                x = layer_stack_dispatch(
+                    x, self.layers_scan,
+                    call=lambda lyr, h: apply(lyr, h)[0], **kw)
         else:
             layer_fn = (nnx.remat(apply,
                                   policy=resolve_remat_policy(

@@ -13,12 +13,15 @@ microbatch t-s (when in range) through its local layer stack, then
 `lax.ppermute`s the activation one hop to stage s+1. Stage p-1 collects
 finished microbatches; a masked psum broadcasts the result back to every
 stage (embeddings/norm/head outside this region are replicated over
-'pipe', so all stages need the block-stack output). Backward is plain
-autodiff: the transpose of ppermute is the reverse ppermute and the
-transpose of the tick scan is the reverse schedule — activation stash is
-the scan's own residuals, O(M + p) microbatch activations (the GPipe
-memory shape); per-layer remat composes via scan_layer_stack's
-nnx.remat.
+'pipe', so all stages need the block-stack output). TWO backward
+schedules share this forward (`pipeline_schedule`): 'gpipe' is plain
+autodiff (the transpose of ppermute is the reverse ppermute and the
+transpose of the tick scan is the reverse schedule — stash is the
+scan's own per-layer residuals for every in-flight micro), 'remat' is
+a custom-vjp mirrored-tick backward stashing only stage INPUTS with
+just-in-time recompute (the 1F1B activation-stash class; measured
+3.4-6.9× smaller compiled temp memory — BASELINE.md "Pipeline cost
+table"). Per-layer remat composes with both.
 
 Composition. Because the region is manual only over 'pipe', everything
 else stays GSPMD: batch stays sharded over data/fsdp, weights over
@@ -52,6 +55,15 @@ from avenir_tpu.models.common import resolve_remat_policy
 PIPE_AXIS = "pipe"
 
 
+def _staircase(t, s, M):
+    """(micro index, is-real) for stage s at tick t — THE schedule math,
+    shared by the gpipe tick body and the remat schedule's forward AND
+    mirrored backward so the three can never drift (review r5)."""
+    mi = jnp.clip(t - s, 0, M - 1)
+    real = jnp.logical_and(t - s >= 0, t - s < M)
+    return mi, real
+
+
 def pipeline_axis_size() -> int:
     """Size of the ambient mesh's 'pipe' axis (1 = pipelining off)."""
     mesh = jax.sharding.get_abstract_mesh()
@@ -61,7 +73,7 @@ def pipeline_axis_size() -> int:
 
 
 def layer_stack_dispatch(x, stacked, *, call, n_micro=0, remat=False,
-                         remat_policy=None, aux0=None):
+                         remat_policy=None, aux0=None, schedule="gpipe"):
     """THE one home for the pipeline-vs-scan choice, shared by every
     dense family (gpt.py / llama.py have exactly one call site each):
     GPipe when the ambient mesh has pipe > 1, else nnx.scan. The aux
@@ -69,11 +81,12 @@ def layer_stack_dispatch(x, stacked, *, call, n_micro=0, remat=False,
     returns (h, aux) and the result is (out, aux0 + sum-over-layers) —
     the scan path accumulates through its carry, the pipeline through
     its tick/psum machinery (batch-mean statistics only; see
-    pipeline_layer_stack)."""
+    pipeline_layer_stack). `schedule` picks the pipeline backward form
+    ('gpipe' | 'remat'); off-pipe meshes ignore it."""
     if pipeline_axis_size() > 1:
         return pipeline_layer_stack(x, stacked, call=call, n_micro=n_micro,
                                     remat=remat, remat_policy=remat_policy,
-                                    aux0=aux0)
+                                    aux0=aux0, schedule=schedule)
     from avenir_tpu.models.common import scan_layer_stack
 
     if aux0 is None:
@@ -90,10 +103,32 @@ def layer_stack_dispatch(x, stacked, *, call, n_micro=0, remat=False,
 
 
 def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
-                         remat_policy=None, aux0=None):
+                         remat_policy=None, aux0=None, schedule="gpipe"):
     """Run (B, T, C) activations through a scan-stacked layer module with
     the layer axis sharded over 'pipe', GPipe-scheduled. Drop-in
     replacement for scan_layer_stack when the mesh has pipe > 1.
+
+    `schedule` selects the BACKWARD memory strategy (identical forward
+    schedule and identical trajectories):
+      - 'gpipe' (default): plain autodiff through the tick scan — the
+        scan stashes per-LAYER residuals for every in-flight microbatch,
+        O((M+p) * L/p) layer-activation sets per stage.
+      - 'remat': custom-vjp reverse tick schedule — the forward stashes
+        ONLY each microbatch's stage INPUT (O(M) single activations per
+        stage), and the backward re-runs the local stack per microbatch
+        just-in-time in mirrored tick order, so per-layer residuals
+        exist for ONE microbatch at a time. This is the activation-stash
+        class 1F1B targets. What it is NOT: 1F1B's forward/backward
+        INTERLEAVING, which cannot exist under PP-as-pure-layout — the
+        backward of micro m may only start once the loss is known, and
+        the loss lives OUTSIDE this region (after the psum-broadcast,
+        in the model head); interleaving would require the per-micro
+        loss computed at the last stage inside the schedule, i.e. a
+        dedicated pipeline_train_step that owns embeddings/head/loss
+        rather than a layer-stack layout transform. Measured memory in
+        BASELINE.md "Pipeline cost table". MoE aux stats are gpipe-only
+        (the remat backward would need the aux cotangent threaded
+        through the recompute — fail-loud below).
 
     `aux0` (optional, a pytree of fp32 BATCH-MEAN statistics — MoE
     router stats): `call(layer, h)` must then return (h, aux), and the
@@ -185,6 +220,20 @@ def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
     aux_zero = (jax.tree.map(lambda a: jnp.zeros_like(a, jnp.float32), aux0)
                 if aux0 is not None else jnp.float32(0.0))
 
+    if schedule == "remat":
+        assert aux0 is None, (
+            "pipeline_schedule='remat' does not carry MoE aux stats yet "
+            "(the reverse-tick backward would need the aux cotangent "
+            "threaded through the recompute); use the default 'gpipe' "
+            "schedule for MoE models"
+        )
+        return _remat_schedule(x, state, p=p, M=M, apply_layer=apply_layer,
+                               state_specs=state_specs, x_spec=x_spec,
+                               t_dtype=t_dtype, c_dtype=c_dtype)
+    assert schedule == "gpipe", (
+        f"unknown pipeline_schedule {schedule!r}; one of 'gpipe', 'remat'"
+    )
+
     def body(state_local, xl):
         s = jax.lax.axis_index(PIPE_AXIS)
         Bg, T, C = xl.shape
@@ -201,16 +250,15 @@ def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
 
         def tick(carry, t):
             outs, recv, aux_acc = carry
-            mi = jnp.clip(t - s, 0, M - 1)
+            mi, real = _staircase(t, s, M)
             inp = jnp.where(s == 0, xm[:, mi], recv).astype(c_dtype)
             out, aux_m = run_local_stack(inp)
             recv_next = jax.lax.ppermute(
                 out.astype(t_dtype), PIPE_AXIS,
                 [(i, i + 1) for i in range(p - 1)]
             )
-            # this stage processed a REAL microbatch this tick (not a
-            # warmup/drain bubble): its aux contribution counts
-            real = jnp.logical_and(t - s >= 0, t - s < M)
+            # real: this stage processed a REAL microbatch this tick (not
+            # a warmup/drain bubble) — its aux contribution counts
             aux_acc = jax.tree.map(
                 lambda acc, a: acc + jnp.where(real, a, 0.0), aux_acc, aux_m
             )
@@ -250,3 +298,122 @@ def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
     if aux0 is None:
         return out
     return out, jax.tree.map(jnp.add, aux0, aux_tot)
+
+
+def _remat_schedule(x, state, *, p, M, apply_layer, state_specs, x_spec,
+                    t_dtype, c_dtype):
+    """The 'remat' pipeline backward (see pipeline_layer_stack): a
+    custom-vjp pair of shard_map regions, both manual only over 'pipe'.
+
+    Forward: the standard GPipe tick staircase, but each stage also
+    STASHES the microbatch input it consumed — (M, Bm, T, C) per stage,
+    exported pipe-sharded as (p*M, Bm, T, C) so it rides to the backward
+    as a plain residual.
+
+    Backward: the mirrored staircase. At reverse tick t (from M+p-2 down
+    to 0) stage s handles micro m = t-s: it re-runs its local stack from
+    stash[m] under jax.vjp, applies the cotangent arriving from stage
+    s+1 (reverse ppermute — the transpose of the forward hop), adds the
+    weight-grad contribution, and sends the input-cotangent one hop
+    upstream. The cotangent for micro m reaches stage s exactly one
+    reverse tick after stage s+1 produced it — the same lockstep the
+    forward uses, mirrored. Per-layer residuals therefore exist for ONE
+    microbatch per stage at any time, instead of for every in-flight
+    microbatch across the whole tick scan."""
+    fwd_perm = [(i, i + 1) for i in range(p - 1)]
+    bwd_perm = [(i + 1, i) for i in range(p - 1)]
+
+    def run_local(state_local, h):
+        def layer_body(h, layer_state):
+            h, _ = apply_layer(layer_state, h)
+            return h, None
+
+        out, _ = jax.lax.scan(layer_body, h, state_local)
+        return out
+
+    def fwd_body(state_local, xl):
+        s = jax.lax.axis_index(PIPE_AXIS)
+        Bg, T, C = xl.shape
+        xm = xl.reshape(Bg // M, M, T, C)
+
+        def tick(carry, t):
+            outs, recv, stash = carry
+            mi, real = _staircase(t, s, M)
+            inp = jnp.where(s == 0, xm[:, mi], recv)
+            stash = jnp.where(real, stash.at[mi].set(inp), stash)
+            out = run_local(state_local, inp.astype(c_dtype)).astype(t_dtype)
+            recv_next = jax.lax.ppermute(out, PIPE_AXIS, fwd_perm)
+            active = jnp.logical_and(s == p - 1, real)
+            outs = jnp.where(active, outs.at[:, mi].set(out), outs)
+            return (outs, recv_next, stash), None
+
+        Bm = xl.shape[0] // M
+        init = (jnp.zeros(xm.shape, t_dtype),
+                jnp.zeros((Bm, T, C), t_dtype),
+                jnp.zeros((M, Bm, T, C), t_dtype))
+        (outs, _, stash), _ = jax.lax.scan(tick, init, jnp.arange(M + p - 1))
+        outs = jnp.where(s == p - 1, outs, jnp.zeros_like(outs))
+        outs = jax.lax.psum(outs, PIPE_AXIS)
+        return outs.reshape(Bg, T, C), stash
+
+    stash_spec = P(PIPE_AXIS, *([None] * x.ndim))
+    f_fwd = jax.shard_map(
+        fwd_body, in_specs=(state_specs, x_spec),
+        out_specs=(x_spec, stash_spec),
+        check_vma=False, axis_names={PIPE_AXIS},
+    )
+
+    def bwd_body(state_local, stash_local, dout):
+        s = jax.lax.axis_index(PIPE_AXIS)
+        Bg, T, C = dout.shape
+        dm = dout.reshape(Bg // M, M, T, C)
+
+        def stage_fn(st, h):
+            return run_local(st, h.astype(c_dtype)).astype(t_dtype)
+
+        def tick(carry, tt):
+            dstate, drecv, dxm = carry
+            t = (M + p - 2) - tt
+            mi, real = _staircase(t, s, M)
+            dout_in = jnp.where(s == p - 1, dm[:, mi], drecv)
+            _, vjp_fn = jax.vjp(stage_fn, state_local, stash_local[mi])
+            dst_i, dinp = vjp_fn(dout_in)
+            dstate = jax.tree.map(
+                lambda acc, g: acc + jnp.where(real, g, 0.0), dstate, dst_i
+            )
+            first = jnp.logical_and(s == 0, real)
+            dxm = jnp.where(first, dxm.at[:, mi].set(dinp), dxm)
+            drecv_next = jax.lax.ppermute(dinp, PIPE_AXIS, bwd_perm)
+            return (dstate, drecv_next, dxm), None
+
+        init = (jax.tree.map(jnp.zeros_like, state_local),
+                jnp.zeros_like(dm[:, 0]), jnp.zeros_like(dm))
+        (dstate, _, dxm), _ = jax.lax.scan(tick, init,
+                                           jnp.arange(M + p - 1))
+        dxm = jnp.where(s == 0, dxm, jnp.zeros_like(dxm))
+        dxm = jax.lax.psum(dxm, PIPE_AXIS)
+        return dstate, dxm.reshape(Bg, T, C)
+
+    f_bwd = jax.shard_map(
+        bwd_body, in_specs=(state_specs, stash_spec, x_spec),
+        out_specs=(state_specs, x_spec),
+        check_vma=False, axis_names={PIPE_AXIS},
+    )
+
+    @jax.custom_vjp
+    def run(state, xl):
+        outs, _ = f_fwd(state, xl)
+        return outs
+
+    def run_fwd(state, xl):
+        outs, stash = f_fwd(state, xl)
+        return outs, (state, stash)
+
+    def run_bwd(res, dout):
+        state, stash = res
+        dstate, dx = f_bwd(state, stash, dout.astype(t_dtype))
+        return dstate, dx
+
+    run.defvjp(run_fwd, run_bwd)
+    out = run(state, x.astype(t_dtype))
+    return out.astype(x.dtype)

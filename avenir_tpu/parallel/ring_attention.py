@@ -127,7 +127,8 @@ def _block_attention(q, k, v, q_offset, kv_offset, sm_scale, seq_len,
     return o, m + jnp.log(l)
 
 
-def _ring_forward(q, k, v, idx, *, axis_name, seq_len, sm_scale):
+def _ring_forward(q, k, v, idx, *, axis_name, seq_len, sm_scale,
+                  block_k=None):
     """n-hop ring forward on local stripes (B, T/c, H, D). Returns the
     merged output (q.dtype) and global logsumexp (B, H, Tq, 1) fp32.
 
@@ -151,7 +152,7 @@ def _ring_forward(q, k, v, idx, *, axis_name, seq_len, sm_scale):
         o_i, lse_i = _block_attention(
             q, kv[0], kv[1],
             q_offset=idx * Tl, kv_offset=src * Tl,
-            sm_scale=sm_scale, seq_len=seq_len,
+            sm_scale=sm_scale, seq_len=seq_len, block_k=block_k,
         )
         # online merge of normalized partials
         lse_new = jnp.logaddexp(lse, lse_i)
@@ -224,7 +225,7 @@ def _block_grads(q, k, v, do, lse, delta, q_offset, kv_offset, sm_scale,
 
 
 def _ring_backward(q, k, v, o, lse, do, idx, *, axis_name, seq_len,
-                   sm_scale):
+                   sm_scale, block_k=None):
     """Ring backward that RE-ROTATES the kv stripes instead of keeping all
     n of them as autodiff residuals (VERDICT r2 weak #6: the unrolled-loop
     residuals made bwd memory O(full KV) per device — exactly what context
@@ -247,7 +248,7 @@ def _ring_backward(q, k, v, o, lse, do, idx, *, axis_name, seq_len,
         dq_i, dk_i, dv_i = _block_grads(
             q, kv_dkv[0], kv_dkv[1], do, lse, delta,
             q_offset=idx * Tl, kv_offset=src * Tl,
-            sm_scale=sm_scale, seq_len=seq_len,
+            sm_scale=sm_scale, seq_len=seq_len, block_k=block_k,
         )
         dq = dq + dq_i
         kv_dkv = (kv_dkv[0], kv_dkv[1], kv_dkv[2] + dk_i, kv_dkv[3] + dv_i)
@@ -262,29 +263,31 @@ def _ring_backward(q, k, v, o, lse, do, idx, *, axis_name, seq_len,
 
 
 @functools.lru_cache(maxsize=32)
-def _build_ring_body(axis_name, seq_len, sm_scale):
+def _build_ring_body(axis_name, seq_len, sm_scale, block_k=None):
     """Per-device ring attention with a custom VJP (one cached closure per
-    static config, so jit retraces reuse it). Takes (q, k, v, pos) where
-    pos is the (1,)-shaped local slice of the position iota; its
-    cotangent is float0 (integer input)."""
+    static config — block_k is part of the cache key). Takes
+    (q, k, v, pos) where pos is the (1,)-shaped local slice of the
+    position iota; its cotangent is float0 (integer input)."""
     import numpy as np
 
     @jax.custom_vjp
     def f(q, k, v, pos):
         o, _ = _ring_forward(q, k, v, pos[0], axis_name=axis_name,
-                             seq_len=seq_len, sm_scale=sm_scale)
+                             seq_len=seq_len, sm_scale=sm_scale,
+                             block_k=block_k)
         return o
 
     def f_fwd(q, k, v, pos):
         o, lse = _ring_forward(q, k, v, pos[0], axis_name=axis_name,
-                               seq_len=seq_len, sm_scale=sm_scale)
+                               seq_len=seq_len, sm_scale=sm_scale,
+                               block_k=block_k)
         return o, (q, k, v, o, lse, pos)
 
     def f_bwd(res, do):
         q, k, v, o, lse, pos = res
         dq, dk, dv = _ring_backward(q, k, v, o, lse, do, pos[0],
                                     axis_name=axis_name, seq_len=seq_len,
-                                    sm_scale=sm_scale)
+                                    sm_scale=sm_scale, block_k=block_k)
         return dq, dk, dv, np.zeros(pos.shape, jax.dtypes.float0)
 
     f.defvjp(f_fwd, f_bwd)
@@ -323,7 +326,7 @@ def context_shard_map(body, *, axis_name, mesh=None, n_in=3,
 
 
 def ring_causal_attention(q, k, v, *, axis_name="context", mesh=None,
-                          sm_scale=None):
+                          sm_scale=None, block_k=None):
     """Causal attention with the sequence sharded over `axis_name`.
     q: GLOBAL (B, T, H, D) under jit; k/v may be GQA (B, T, H_kv, D)
     with H_kv | H. T must divide by the axis size. Uses the ambient mesh
@@ -331,7 +334,7 @@ def ring_causal_attention(q, k, v, *, axis_name="context", mesh=None,
     B, T, H, D = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
-    body = _build_ring_body(axis_name, T, float(sm_scale))
+    body = _build_ring_body(axis_name, T, float(sm_scale), block_k)
     am = mesh.abstract_mesh if mesh is not None \
         else jax.sharding.get_abstract_mesh()
     c = dict(am.shape)[axis_name]

@@ -97,6 +97,7 @@ def build_model_factory(cfg, model_args, mesh=None):
             remat_policy=cfg.get("remat_policy", "nothing"),
             scan_layers=cfg.get("scan_layers", False),
             pipeline_microbatches=cfg.get("pipeline_microbatches", 0),
+            pipeline_schedule=cfg.get("pipeline_schedule", "gpipe"),
         )
         return mt, gcfg, (lambda seed: GPT(gcfg, rngs=nnx.Rngs(seed)))
     if mt == "llama":

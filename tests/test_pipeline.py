@@ -72,6 +72,76 @@ def test_pipeline_trajectory_matches_single_device(char_dataset, tmp_path,
                                atol=3e-4, rtol=3e-4)
 
 
+@pytest.mark.parametrize("mesh_shape,over", [
+    ("pipe:2", {}),
+    ("data:2,pipe:2", dict(attn_impl="pallas", pipeline_microbatches=2)),
+    ("pipe:2,context:2", {}),
+    ("pipe:2", dict(model_type="llama", n_head=4, n_kv_head=2,
+                    ffn_hidden=64)),
+    ("pipe:2", dict(remat=True)),
+    ("pipe:4", dict(n_layer=4)),
+], ids=["pipe2", "dp-pp-pallas-nested", "pp-cp-ring", "llama", "remat",
+        "pipe4"])
+def test_remat_schedule_trajectory_matches_single_device(
+        char_dataset, tmp_path, mesh_shape, over):
+    """pipeline_schedule='remat' (reverse-tick stage-input stash,
+    parallel/pipeline._remat_schedule) must reproduce the single-device
+    trajectory across the composition matrix exactly like the gpipe
+    schedule — including the nested pallas wrap, ring CP under the
+    pipeline, llama GQA, and per-layer remat stacked on top. Tolerance
+    covers the recompute's fp reassociation (~1e-6 per step)."""
+    ref = _run(char_dataset, tmp_path / "o1", "data:1", **over)
+    got = _run(char_dataset, tmp_path / "o2", mesh_shape,
+               pipeline_schedule="remat", **over)
+    np.testing.assert_allclose(_losses(got), _losses(ref),
+                               atol=3e-4, rtol=3e-4)
+
+
+def test_remat_schedule_memory_win():
+    """The point of the remat schedule: compiled fwd+bwd temp bytes must
+    be well under the gpipe schedule's (the stash is O(M) stage inputs
+    instead of O((M+p)·L/p) per-layer residual sets — measured 3.4-6.9×
+    on the harness, BASELINE.md 'Pipeline cost table')."""
+    from flax import nnx
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+
+    def temp_bytes(schedule):
+        cfg = GPTConfig(block_size=128, vocab_size=256, n_layer=8,
+                        n_head=4, n_embd=128, dropout=0.0, bias=False,
+                        attn_impl="xla", scan_layers=True,
+                        pipeline_microbatches=4,
+                        pipeline_schedule=schedule)
+        mesh = make_mesh("pipe:2")
+        with jax.set_mesh(mesh):
+            graphdef, params = nnx.split(GPT(cfg, rngs=nnx.Rngs(0)),
+                                         nnx.Param)
+            x = jax.random.randint(jax.random.key(1), (8, 128), 0, 256)
+            y = jax.random.randint(jax.random.key(2), (8, 128), 0, 256)
+
+            def loss_fn(params):
+                _, loss = nnx.merge(graphdef, params)(x, targets=y)
+                return loss
+
+            comp = jax.jit(jax.grad(loss_fn)).lower(params).compile()
+            return comp.memory_analysis().temp_size_in_bytes
+
+    g, r = temp_bytes("gpipe"), temp_bytes("remat")
+    assert r < 0.5 * g, (g, r)
+
+
+def test_remat_schedule_rejects_moe_aux(char_dataset, tmp_path):
+    """MoE aux stats are gpipe-only under the pipeline (the remat
+    backward does not thread the aux cotangent through the recompute) —
+    fail loud, never silently drop router statistics."""
+    with pytest.raises(AssertionError, match="gpipe"):
+        _run(char_dataset, tmp_path / "o", "pipe:2",
+             pipeline_schedule="remat", model_type="mixtral", n_head=4,
+             n_kv_head=2, n_embd=32, ffn_hidden=64, n_experts=4,
+             n_experts_per_tok=2, capacity_factor=2.0,
+             router_aux_loss_coef=0.02)
+
+
 @pytest.mark.parametrize("mesh_shape", ["pipe:2", "expert:2,pipe:2"])
 def test_pipeline_mixtral_trajectory(char_dataset, tmp_path, mesh_shape):
     """MoE through the pipeline: router stats ride the aux carry

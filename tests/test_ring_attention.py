@@ -99,22 +99,18 @@ def test_ring_blockwise_padding_interior_stripe():
     q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
-    old = ra._BLOCK_K
-    ra._BLOCK_K = 12  # Tk = 16 → nb=2, pad=8: interior-stripe aliasing
-    ra._build_ring_body.cache_clear()  # bodies close over the block size
-    try:
-        def loss(f, q, k, v):
-            return jnp.sum(f(q, k, v) ** 2)
+    # block_k=12: Tk = 16 → nb=2, pad=8 — interior-stripe aliasing
+    ring = lambda q, k, v: ra.ring_causal_attention(q, k, v, block_k=12)
 
-        ref_g = jax.jit(jax.grad(
-            lambda q, k, v: loss(causal_attention_reference, q, k, v),
-            argnums=(0, 1, 2)))(q, k, v)
-        got_g = jax.jit(jax.grad(
-            lambda q, k, v: loss(ra.ring_causal_attention, q, k, v),
-            argnums=(0, 1, 2)))(q, k, v)
-        for a, b in zip(got_g, ref_g):
-            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       atol=2e-5, rtol=2e-5)
-    finally:
-        ra._BLOCK_K = old
-        ra._build_ring_body.cache_clear()
+    def loss(f, q, k, v):
+        return jnp.sum(f(q, k, v) ** 2)
+
+    ref_g = jax.jit(jax.grad(
+        lambda q, k, v: loss(causal_attention_reference, q, k, v),
+        argnums=(0, 1, 2)))(q, k, v)
+    got_g = jax.jit(jax.grad(
+        lambda q, k, v: loss(ring, q, k, v),
+        argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(got_g, ref_g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-5)
