@@ -20,11 +20,10 @@ if "--xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import numpy as np
-
 import jax
-import jax.numpy as jnp
 
+# env var alone is not enough: the axon sitecustomize imports jax and
+# force-sets jax_platforms before this line runs (see tests/conftest.py)
 jax.config.update("jax_platforms", "cpu")
 
 from flax import nnx
