@@ -9,3 +9,15 @@ over ICI/DCN. Import is torch-free: a TPU pod never needs torch
 """
 
 __version__ = "0.1.0"
+
+# API shims for older jax/flax runtimes (ambient-mesh spelling, nnx.List,
+# flat_state pairs, Variable.get_value) — must be live before any model
+# or loop module runs; see avenir_tpu/compat.py. Tolerate a jax-less
+# interpreter: the obs subsystem (metrics/sink/report) is stdlib-only so
+# tools like tools/obs_report.py must import without jax installed.
+try:
+    from avenir_tpu.compat import install_jax_compat as _install_jax_compat
+
+    _install_jax_compat()
+except ImportError:
+    pass

@@ -8,10 +8,13 @@ torch AdamW param grouping we must reproduce).
 
 import collections
 import os
+import time
 
 import jax
 import numpy as np
 from flax import nnx
+
+from avenir_tpu.obs.metrics import get_registry
 
 from avenir_tpu.checkpoint.bridge import (
     export_torch_state_dict,
@@ -200,11 +203,17 @@ def save_checkpoint(out_dir, *, params, opt_state, hyper, model_args,
     # previous good checkpoint
     write = jax.process_index() == 0
     path = os.path.join(out_dir, "ckpt.pt")
+    t0 = time.perf_counter()
     if write:
         os.makedirs(out_dir, exist_ok=True)
     save_pt(ckpt, path + ".part", write=write)
     if write:
         os.replace(path + ".part", path)
+    reg = get_registry()
+    reg.counter("ckpt_saves").add(1)
+    reg.counter("ckpt_save_ms").add((time.perf_counter() - t0) * 1e3)
+    if write:
+        reg.counter("ckpt_bytes_written").add(os.path.getsize(path))
 
 
 class AsyncCheckpoint:
@@ -218,7 +227,14 @@ class AsyncCheckpoint:
         self.error = None
 
     def join(self):
-        if self._thread is not None:
+        if self._thread is not None and self._thread.is_alive():
+            # async-writer lag: how long the training loop blocks waiting
+            # for an unfinished background save
+            t0 = time.perf_counter()
+            self._thread.join()
+            get_registry().counter("ckpt_join_wait_ms").add(
+                (time.perf_counter() - t0) * 1e3)
+        elif self._thread is not None:
             self._thread.join()
         if self.error is not None:
             raise self.error
@@ -419,6 +435,7 @@ def save_checkpoint_sharded_async(out_dir, *, params, opt_state, hyper,
 
     def run():
         try:
+            t0 = time.perf_counter()
             # TWO pickle records per file: a small header first, then the
             # tensor body — resume can read every file's header (set
             # validation, iter comparison vs ckpt.pt) without pulling
@@ -449,6 +466,10 @@ def save_checkpoint_sharded_async(out_dir, *, params, opt_state, hyper,
                 pickle.dump(header, f, protocol=4)
                 pickle.dump(body, f, protocol=4)
             os.replace(tmp, path)
+            reg = get_registry()
+            reg.counter("ckpt_saves").add(1)
+            reg.counter("ckpt_save_ms").add((time.perf_counter() - t0) * 1e3)
+            reg.counter("ckpt_bytes_written").add(os.path.getsize(path))
             if pid == 0:
                 # drop stale shards a LARGER previous run left behind
                 # (indices >= nproc) — the loader counts files against
@@ -512,12 +533,19 @@ def load_sharded_checkpoint(out_dir, meta_only=False):
             "config", "model_family")}
     if meta_only:
         return out
+    # NB read amplification (ADVICE r5, docs/OPERATIONS.md): every process
+    # reads ALL N shard bodies off shared storage and assembles the full
+    # global tree (~3x model size host RAM: params+mu+nu). The restore
+    # bytes/duration counters below make that cost visible per run.
+    t0 = time.perf_counter()
+    bytes_read = 0
     for name in ("params", "mu", "nu"):
         out[name] = {}
     for f, _ in headers:
         with open(f, "rb") as fh:
             pickle.load(fh)  # skip the header record
             body = pickle.load(fh)
+        bytes_read += os.path.getsize(f)
         for name in ("params", "mu", "nu"):
             sec = out[name]
             for k, ent in body[name].items():
@@ -527,6 +555,9 @@ def load_sharded_checkpoint(out_dir, meta_only=False):
                 for idx, arr in ent["shards"]:
                     sl = tuple(slice(a, b) for a, b in idx)
                     sec[k][sl] = arr
+    reg = get_registry()
+    reg.counter("ckpt_restore_ms").add((time.perf_counter() - t0) * 1e3)
+    reg.counter("ckpt_restore_bytes").add(bytes_read)
     return out
 
 
@@ -580,7 +611,15 @@ def load_checkpoint(out_dir, lazy=False):
     raw dict; use restore_params/restore_opt_state to place on device.
     `lazy=True`: tensors are LazyArray stubs read from the zip only when
     restore places them — the host never holds the full tree."""
-    return load_pt(os.path.join(out_dir, "ckpt.pt"), lazy=lazy)
+    path = os.path.join(out_dir, "ckpt.pt")
+    t0 = time.perf_counter()
+    out = load_pt(path, lazy=lazy)
+    reg = get_registry()
+    # lazy loads defer the tensor reads to restore time; the file size is
+    # still the honest "bytes this restore will pull" figure
+    reg.counter("ckpt_restore_ms").add((time.perf_counter() - t0) * 1e3)
+    reg.counter("ckpt_restore_bytes").add(os.path.getsize(path))
+    return out
 
 
 def _strip_compile_prefix(sd):

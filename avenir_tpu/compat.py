@@ -1,0 +1,328 @@
+"""Ambient-mesh compatibility across jax versions.
+
+The trainer targets the jax >= 0.6 context-mesh API (`jax.set_mesh`,
+`jax.sharding.get_mesh`); older runtimes (0.4.x, still common in CPU CI
+images) spell the same thing as the `Mesh` context manager backed by
+`thread_resources`. This module is the ONLY place the difference lives:
+`set_mesh`/`get_mesh` are drop-in helpers, and `install_jax_compat()`
+patches the modern names onto the jax module itself when they are
+missing, so test files and tools written against the modern API run
+unmodified on the legacy runtime. Everything else in the codebase uses
+explicit NamedShardings, which are stable across versions.
+"""
+
+import threading
+
+import jax
+
+_entered = []  # Mesh contexts entered on the legacy path, outermost first
+
+# axes currently Manual because an enclosing compat shard_map went manual
+# over them — the legacy runtime has no ambient tracking of this, so the
+# adapter records it for the dynamic extent of each region's trace
+# (consumed by _CompatAbstractMesh.axis_types / partition.free_axis_names)
+_manual_axes = threading.local()
+
+
+def _legacy_install(meshes):
+    """Make `meshes` (outermost first) the ambient-mesh stack."""
+    while _entered:
+        _entered.pop().__exit__(None, None, None)
+    for m in meshes:
+        m.__enter__()
+        _entered.append(m)
+
+
+def _is_empty(mesh):
+    try:
+        return mesh is None or mesh.devices.size == 0
+    except AttributeError:
+        return False
+
+
+class _LegacySetMesh:
+    """Return value of the legacy set_mesh: the mesh is installed at
+    construction (statement use persists it, like modern jax.set_mesh);
+    used as a context manager, __exit__ restores the previous ambient
+    stack (matching `with jax.set_mesh(mesh):` semantics)."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self._prev = list(_entered)
+        _legacy_install([] if _is_empty(mesh) else [mesh])
+
+    def __enter__(self):
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _legacy_install(self._prev)
+        return False
+
+
+def set_mesh(mesh):
+    """Install `mesh` as the ambient mesh (makes bare-PartitionSpec
+    sharding constraints inside jit resolvable). Passing the empty mesh
+    captured by `get_mesh()` before any install restores the default."""
+    native = getattr(jax, "set_mesh", None)
+    if native is not None and native is not set_mesh:
+        return native(mesh)
+    return _LegacySetMesh(mesh)
+
+
+def get_mesh():
+    """The current ambient mesh (an empty mesh when none is installed)."""
+    native = getattr(jax.sharding, "get_mesh", None)
+    if native is not None and native is not get_mesh:
+        return native()
+    from jax._src import mesh as _mesh_lib
+
+    return _mesh_lib.thread_resources.env.physical_mesh
+
+
+class _CompatAxisType:
+    """Stand-in for jax.sharding.AxisType (0.6+): three sentinel values
+    with identity comparison, which is all the codebase uses."""
+
+    Auto = "auto"
+    Explicit = "explicit"
+    Manual = "manual"
+
+
+class _CompatAbstractMesh:
+    """The slice of the modern AbstractMesh interface the codebase reads:
+    axis_names / axis_types / shape / empty. An axis reports as Manual
+    while an enclosing compat `shard_map` region is being traced over it
+    (the `_manual_axes` thread-local); everything else is Auto."""
+
+    def __init__(self, names, sizes):
+        self.axis_names = tuple(names)
+        self._sizes = tuple(int(s) for s in sizes)
+
+    @property
+    def shape(self):
+        import collections
+
+        return collections.OrderedDict(zip(self.axis_names, self._sizes))
+
+    @property
+    def axis_types(self):
+        axis_type = getattr(jax.sharding, "AxisType", _CompatAxisType)
+        manual = getattr(_manual_axes, "names", frozenset())
+        return tuple(
+            axis_type.Manual if n in manual else axis_type.Auto
+            for n in self.axis_names
+        )
+
+    @property
+    def empty(self):
+        return not self.axis_names
+
+    def __eq__(self, other):
+        return (getattr(other, "axis_names", None) == self.axis_names
+                and tuple(getattr(other, "shape", {}).values())
+                == self._sizes)
+
+    def __hash__(self):
+        return hash((self.axis_names, self._sizes))
+
+
+def _abstract_view(mesh):
+    if mesh is None or getattr(mesh, "devices", None) is None \
+            or mesh.devices.size == 0:
+        return _CompatAbstractMesh((), ())
+    return _CompatAbstractMesh(mesh.axis_names,
+                               [mesh.shape[n] for n in mesh.axis_names])
+
+
+def get_abstract_mesh():
+    """Abstract view of the current ambient mesh (modern
+    jax.sharding.get_abstract_mesh)."""
+    native = getattr(jax.sharding, "get_abstract_mesh", None)
+    if native is not None and native is not get_abstract_mesh:
+        return native()
+    return _abstract_view(get_mesh())
+
+
+def _install_flax_compat():
+    """Bridge the flax.nnx API generations the codebase straddles:
+
+    - `nnx.List`: newer flax's explicit list container. Older nnx treats a
+      plain python list attribute as a graph node with the same integer
+      path parts, so a pass-through `list()` is a faithful stand-in.
+    - `State.flat_state()`: newer flax returns a sequence of
+      (path, VariableState) pairs; older returns a {path: state} dict.
+      Normalize to the pair-sequence form the codebase iterates.
+    - `Variable.get_value()/set_value()`: accessor spelling used
+      throughout the models; older flax only has the `.value` attribute.
+    """
+    from flax import nnx
+    from flax.nnx import statelib, variablelib
+
+    if not hasattr(nnx, "List"):
+        nnx.List = list
+
+    probe = statelib.State({"a": variablelib.VariableState(nnx.Param, 0)})
+    if type(probe.flat_state()) is dict:
+
+        class _FlatStatePairs(dict):
+            """dict whose default iteration yields (path, value) PAIRS.
+            flax internals keep their mapping view (`.items()`, `dict()`,
+            `in`, `.keys()` all behave); codebase-style `for p, v in
+            state.flat_state()` gets the newer pair-sequence behavior."""
+
+            def __iter__(self):
+                return iter(self.items())
+
+        orig = statelib.State.flat_state
+
+        def flat_state_pairs(self, *a, **kw):
+            return _FlatStatePairs(orig(self, *a, **kw))
+
+        statelib.State.flat_state = flat_state_pairs
+
+    for cls in (variablelib.Variable, variablelib.VariableState):
+        if not hasattr(cls, "get_value"):
+            cls.get_value = lambda self: self.value
+        if not hasattr(cls, "set_value"):
+            def _set_value(self, v):
+                self.value = v
+
+            cls.set_value = _set_value
+
+    _install_none_param_compat()
+
+
+def _install_none_param_compat():
+    """Older nnx materializes `nnx.Param(None)` for use_bias=False /
+    use_scale=False layers, so phantom bias/scale leaves (value None)
+    appear in every split state — crashing shape accounting, partition
+    matching, and checkpoint export written against newer flax, where
+    the attribute is plain `None` and the leaf does not exist. Replace
+    the sentinel Params with None after layer init and give Linear /
+    LayerNorm None-tolerant __call__s (verbatim ports of the originals
+    minus the `.value` access on the missing param)."""
+    import inspect
+
+    import jax.numpy as jnp
+    from flax import nnx
+    from flax.nnx.nn import dtypes, normalization
+
+    # source-level probe, NOT a layer construction: building a real
+    # nnx.Linear here would run jax.random ops and initialize the jax
+    # backend as a side effect of `import avenir_tpu` (before callers
+    # get to configure platforms)
+    if "Param(None)" not in inspect.getsource(nnx.LayerNorm.__init__):
+        return  # modern flax: use_bias=False leaves the attribute None
+    if getattr(nnx.Linear.__init__, "_avenir_none_param_compat", False):
+        return  # already installed
+
+    lin_init = nnx.Linear.__init__
+
+    def linear_init(self, *a, **kw):
+        lin_init(self, *a, **kw)
+        if getattr(self.bias, "value", 0) is None:
+            self.bias = None
+
+    def linear_call(self, inputs):
+        kernel = self.kernel.value
+        bias = self.bias.value if self.bias is not None else None
+        inputs, kernel, bias = dtypes.promote_dtype(
+            (inputs, kernel, bias), dtype=self.dtype)
+        y = self.dot_general(
+            inputs, kernel, (((inputs.ndim - 1,), (0,)), ((), ())),
+            precision=self.precision)
+        if bias is not None:
+            y += jnp.reshape(bias, (1,) * (y.ndim - 1) + (-1,))
+        return y
+
+    linear_init._avenir_none_param_compat = True
+    nnx.Linear.__init__ = linear_init
+    nnx.Linear.__call__ = linear_call
+
+    ln_init = nnx.LayerNorm.__init__
+
+    def layernorm_init(self, *a, **kw):
+        ln_init(self, *a, **kw)
+        if getattr(self.bias, "value", 0) is None:
+            self.bias = None
+        if getattr(self.scale, "value", 0) is None:
+            self.scale = None
+
+    def layernorm_call(self, x, *, mask=None):
+        mean, var = normalization._compute_stats(
+            x, self.reduction_axes, self.dtype, self.axis_name,
+            self.axis_index_groups,
+            use_fast_variance=self.use_fast_variance, mask=mask)
+        return normalization._normalize(
+            x, mean, var,
+            self.scale.value if self.scale is not None else None,
+            self.bias.value if self.bias is not None else None,
+            self.reduction_axes, self.feature_axes, self.dtype,
+            self.epsilon)
+
+    nnx.LayerNorm.__init__ = layernorm_init
+    nnx.LayerNorm.__call__ = layernorm_call
+
+
+def shard_map(f, *, mesh=None, in_specs, out_specs, check_vma=True,
+              axis_names=None):
+    """Adapter from the modern `jax.shard_map` keyword surface to the
+    legacy `jax.experimental.shard_map.shard_map`:
+
+    - `mesh=None` resolves to the ambient mesh (set_mesh), like modern
+      jax; an AbstractMesh resolves to the ambient concrete mesh.
+    - `axis_names` (the axes to go Manual over) maps to the legacy
+      `auto=` complement.
+    - `check_vma` maps to `check_rep`.
+    """
+    from jax.experimental.shard_map import shard_map as legacy_shard_map
+
+    concrete = mesh if isinstance(mesh, jax.sharding.Mesh) else get_mesh()
+    assert concrete is not None and concrete.devices.size > 0, (
+        "shard_map with no mesh requires an ambient mesh (set_mesh)"
+    )
+    manual = frozenset(axis_names if axis_names is not None
+                       else concrete.axis_names)
+    auto = frozenset(concrete.axis_names) - manual
+
+    def traced(*a, **k):
+        # record this region's manual axes for the dynamic extent of its
+        # trace, so nested wraps (free_axis_names) see them as Manual
+        prev = getattr(_manual_axes, "names", frozenset())
+        _manual_axes.names = prev | manual
+        try:
+            return f(*a, **k)
+        finally:
+            _manual_axes.names = prev
+
+    return legacy_shard_map(traced, concrete, in_specs=in_specs,
+                            out_specs=out_specs, check_rep=bool(check_vma),
+                            auto=auto)
+
+
+def install_jax_compat():
+    """Patch `jax.set_mesh` / `jax.sharding.get_mesh` onto the jax module
+    and the nnx API shims onto flax when this runtime lacks them.
+    Idempotent; a no-op on modern versions. Called from
+    avenir_tpu/__init__.py (every consumer), platform.
+    honor_jax_platforms_env (entrypoints), and tests/conftest.py."""
+    legacy = not hasattr(jax, "set_mesh")  # before any patching below
+    if legacy:
+        jax.set_mesh = set_mesh
+    if not hasattr(jax.sharding, "get_mesh"):
+        jax.sharding.get_mesh = get_mesh
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        jax.sharding.get_abstract_mesh = get_abstract_mesh
+    if not hasattr(jax.sharding, "AxisType"):
+        jax.sharding.AxisType = _CompatAxisType
+    if legacy:
+        # the legacy Mesh.abstract_mesh exists but reports axis_types=None;
+        # replace it with the compat view (axis_types always populated)
+        jax.sharding.Mesh.abstract_mesh = property(_abstract_view)
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = shard_map
+    if not hasattr(jax.lax, "axis_size"):
+        # psum of a literal 1 is constant-folded to the axis size (no
+        # collective is emitted) — the legacy spelling of axis_size
+        jax.lax.axis_size = lambda axis_name: jax.lax.psum(1, axis_name)
+    _install_flax_compat()

@@ -13,25 +13,44 @@ the np.memmap leak (train.py:145-147).
 """
 
 import os
+import time
 
 import jax
 import numpy as np
 
+from avenir_tpu.obs.metrics import get_registry
+
+# the on-disk .bin format AND the H2D wire format are uint16 (half the
+# transfer bytes of int32 — the r5 win); any vocab that doesn't fit must
+# fail at loader construction, not wrap token ids mid-run
+WIRE_DTYPE = np.uint16
+WIRE_VOCAB_CAP = int(np.iinfo(WIRE_DTYPE).max) + 1  # 65536
+
 
 class DataLoader:
     def __init__(self, data_dir, block_size, batch_size, *, sharding=None,
-                 grad_accum=1, seed=0, flat=False):
+                 grad_accum=1, seed=0, flat=False, vocab_size=None):
         """`batch_size` is the GLOBAL batch size in sequences per micro-step;
         each call to get_batch returns (grad_accum, B, T) stacked micro
         batches as a sharded global array (leading accum dim unsharded).
-        `flat=True` (eval): grad_accum must be 1 and batches are (B, T)."""
+        `flat=True` (eval): grad_accum must be 1 and batches are (B, T).
+        `vocab_size` (when known) is validated against the uint16 wire
+        format — a Llama-3-sized 128k vocab must fail loud HERE instead of
+        silently wrapping ids modulo 65536 (ADVICE r5)."""
         self.data_dir = data_dir
         self.block_size = block_size
         self.batch_size = batch_size
         self.grad_accum = grad_accum
         self.sharding = sharding
         self.flat = flat
+        self._reg = get_registry()
         assert not (flat and grad_accum != 1)
+        assert vocab_size is None or vocab_size <= WIRE_VOCAB_CAP, (
+            f"vocab_size={vocab_size} does not fit the loader's "
+            f"{WIRE_DTYPE.__name__} wire/on-disk token format (max "
+            f"{WIRE_VOCAB_CAP}); token ids would wrap silently — the .bin "
+            "corpus format needs a wider dtype before such a vocab can run"
+        )
         n_proc = jax.process_count()
         assert batch_size % n_proc == 0, (
             f"global batch {batch_size} must divide over {n_proc} processes"
@@ -42,7 +61,8 @@ class DataLoader:
 
     def _sample_local(self, split):
         arr = np.memmap(
-            os.path.join(self.data_dir, f"{split}.bin"), dtype=np.uint16, mode="r"
+            os.path.join(self.data_dir, f"{split}.bin"), dtype=WIRE_DTYPE,
+            mode="r",
         )
         n = self.grad_accum * self.local_batch
         ix = self.rng.integers(0, len(arr) - self.block_size, size=n)
@@ -60,16 +80,27 @@ class DataLoader:
             shape = (self.grad_accum, self.local_batch, self.block_size)
         return x.reshape(shape), y.reshape(shape)
 
+    def _count(self, x, t0):
+        """Batch-staging telemetry: wall time spent sampling + assembling
+        on this process, batches staged, input tokens moved."""
+        self._reg.counter("data_stage_ms").add((time.perf_counter() - t0) * 1e3)
+        self._reg.counter("data_batches").add(1)
+        self._reg.counter("data_tokens").add(int(np.prod(x.shape)))
+
     def get_batch(self, split):
+        t0 = time.perf_counter()
         x, y = self._sample_local(split)
         if self.sharding is None:
-            return jax.numpy.asarray(x), jax.numpy.asarray(y)
+            out = jax.numpy.asarray(x), jax.numpy.asarray(y)
+            self._count(x, t0)
+            return out
         if self.flat:
             global_shape = (self.batch_size, self.block_size)
         else:
             global_shape = (self.grad_accum, self.batch_size, self.block_size)
         gx = jax.make_array_from_process_local_data(self.sharding, x, global_shape)
         gy = jax.make_array_from_process_local_data(self.sharding, y, global_shape)
+        self._count(x, t0)
         return gx, gy
 
     def get_batch_window(self, split, k):
@@ -79,14 +110,18 @@ class DataLoader:
         per-process stream as get_batch, so k window calls and k·1 single
         calls yield the identical batch sequence."""
         assert not self.flat, "windowed batches are a train-path concept"
+        t0 = time.perf_counter()
         xs, ys = zip(*(self._sample_local(split) for _ in range(k)))
         x, y = np.stack(xs), np.stack(ys)
         if self.sharding is None:
-            return jax.numpy.asarray(x), jax.numpy.asarray(y)
+            out = jax.numpy.asarray(x), jax.numpy.asarray(y)
+            self._count(x, t0)
+            return out
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         wsh = NamedSharding(self.sharding.mesh, P(None, *self.sharding.spec))
         gshape = (k, self.grad_accum, self.batch_size, self.block_size)
         gx = jax.make_array_from_process_local_data(wsh, x, gshape)
         gy = jax.make_array_from_process_local_data(wsh, y, gshape)
+        self._count(x, t0)
         return gx, gy

@@ -1,0 +1,26 @@
+"""avenir_tpu.obs — structured run telemetry (ISSUE 1).
+
+Dependency-free (stdlib; jax only for trace annotations, optional):
+
+- metrics.py: schema-checked counters/gauges/histograms in one
+  process-local registry (METRIC_SCHEMA is the JSONL contract)
+- sink.py:    JSONL run log (out_dir/metrics.jsonl), coordinator-owned
+- spans.py:   phase spans feeding both XProf and the registry
+- watchdog.py: stall watchdog for silently hung pod collectives
+- report.py:  metrics.jsonl -> goodput/timing summary (tools/obs_report.py)
+"""
+
+from avenir_tpu.obs.metrics import (
+    METRIC_SCHEMA,
+    MetricsRegistry,
+    get_registry,
+    reset_registry,
+)
+from avenir_tpu.obs.sink import RECORD_KINDS, JsonlSink, NullSink
+from avenir_tpu.obs.spans import span
+from avenir_tpu.obs.watchdog import StallWatchdog
+
+__all__ = [
+    "METRIC_SCHEMA", "MetricsRegistry", "get_registry", "reset_registry",
+    "RECORD_KINDS", "JsonlSink", "NullSink", "span", "StallWatchdog",
+]

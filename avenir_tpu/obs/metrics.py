@@ -1,0 +1,250 @@
+"""Metrics registry: counters, gauges, histograms (ISSUE 1 tentpole).
+
+Dependency-free (stdlib only) so every layer of the training path —
+loader, checkpoint writer threads, the loop, the watchdog — can record
+into one process-local registry without import cycles or optional deps.
+The registry is the single source of truth for the JSONL schema: a
+metric key that is not declared in METRIC_SCHEMA cannot be created
+(fail loud, same policy as the partition-rule miss), which is what lets
+tests/test_metrics_schema.py pin the docs/OBSERVABILITY.md table against
+the code and keep the metrics.jsonl contract from drifting silently.
+
+Thread-safety: one lock per registry guards every mutation and the
+snapshot — async checkpoint writers and the stall watchdog record from
+their own threads.
+"""
+
+import threading
+
+# key -> (kind, unit, description). The ONE schema; docs/OBSERVABILITY.md
+# mirrors this table and tests/test_metrics_schema.py asserts the mirror.
+METRIC_SCHEMA = {
+    # -- train-loop time accounting (goodput components) --
+    "step_window_ms": (
+        "counter", "ms",
+        "wall time inside flushed train windows: host batch staging + "
+        "dispatch + D2H fence; compile time excluded (see compile_ms)"),
+    "host_batch_ms": (
+        "counter", "ms",
+        "loop-side batch staging (the host_batch spans; overlaps device "
+        "compute in the windowed loop, so it is a subset of "
+        "step_window_ms, not additive to it)"),
+    "eval_ms": (
+        "counter", "ms", "estimate_loss wall time (the eval spans)"),
+    "checkpoint_ms": (
+        "counter", "ms",
+        "loop-blocking checkpoint time (snapshot + enqueue for async "
+        "saves, the full write for sync saves)"),
+    "compile_ms": (
+        "counter", "ms",
+        "trace+compile wall time of the first dispatch of each window "
+        "length (the seen-window-length timer exclusions, made explicit)"),
+    "d2h_fence_ms": (
+        "counter", "ms",
+        "loss-stack device-to-host fetch in the window flush (the only "
+        "reliable execution fence on tunneled hosts)"),
+    "train_dispatch_ms": (
+        "counter", "ms",
+        "wall time of train-step dispatch calls (includes trace+compile "
+        "on the first call of each input shape)"),
+    "train_dispatches": (
+        "counter", "1", "train-step XLA dispatches issued"),
+    # -- data loader --
+    "data_stage_ms": (
+        "counter", "ms",
+        "loader-side sampling + global-array assembly (subset of "
+        "host_batch_ms when called from the loop)"),
+    "data_batches": (
+        "counter", "1", "batch stacks staged by the loader"),
+    "data_tokens": (
+        "counter", "tok", "input tokens staged by the loader (x only)"),
+    # -- checkpoint io --
+    "ckpt_saves": ("counter", "1", "checkpoint saves started"),
+    "ckpt_save_ms": (
+        "counter", "ms",
+        "checkpoint writer wall time (runs on the writer thread for "
+        "async saves — not loop-blocking; see checkpoint_ms)"),
+    "ckpt_bytes_written": (
+        "counter", "bytes", "checkpoint bytes written by this process"),
+    "ckpt_join_wait_ms": (
+        "counter", "ms",
+        "time the loop blocked joining an in-flight async writer "
+        "(async-writer lag made visible)"),
+    "ckpt_restore_ms": (
+        "counter", "ms", "checkpoint read/assembly wall time on restore"),
+    "ckpt_restore_bytes": (
+        "counter", "bytes",
+        "checkpoint bytes read on restore (sharded sets: every process "
+        "reads all N shard bodies — docs/OPERATIONS.md read amplification)"),
+    # -- watchdog --
+    "watchdog_stalls": (
+        "counter", "1", "stall-watchdog warnings fired"),
+    # -- per-record gauges (latest value at log cadence) --
+    "loss": ("gauge", "nats", "train loss at the last logged iter"),
+    "lr": ("gauge", "1", "learning rate at the last logged iter"),
+    "mfu": ("gauge", "1", "running MFU EMA (fraction of peak)"),
+    "tokens_per_sec": (
+        "gauge", "tok/s", "global tokens/sec over the last window"),
+    "iter_dt_ms": (
+        "gauge", "ms", "per-iter wall time, window-amortized"),
+    "setup_ms": (
+        "gauge", "ms",
+        "run_training entry to loop start (mesh + init + restore)"),
+    "grad_norm": ("gauge", "1", "global grad norm at the last logged iter"),
+    # -- histograms --
+    "window_dt_ms": (
+        "hist", "ms", "per-iter wall time of each flushed window"),
+    "host_batch_dt_ms": (
+        "hist", "ms", "wall time of each host_batch staging span"),
+}
+
+
+class Counter:
+    """Monotone cumulative sum. `add` accepts int or float."""
+
+    def __init__(self, lock):
+        self._lock = lock
+        self.total = 0.0
+        self.events = 0
+
+    def add(self, v=1.0):
+        with self._lock:
+            self.total += float(v)
+            self.events += 1
+
+
+class Gauge:
+    """Latest-value metric."""
+
+    def __init__(self, lock):
+        self._lock = lock
+        self.value = None
+
+    def set(self, v):
+        with self._lock:
+            self.value = float(v)
+
+
+class Histogram:
+    """count/sum/min/max plus p50/p95 from a bounded ring of the most
+    recent observations (exact percentiles on short runs, recent-window
+    percentiles on long ones — good enough for a stall threshold and a
+    report, with O(1) memory)."""
+
+    RING = 512
+
+    def __init__(self, lock):
+        self._lock = lock
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+        self._ring = []
+        self._ring_pos = 0
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            if len(self._ring) < self.RING:
+                self._ring.append(v)
+            else:
+                self._ring[self._ring_pos] = v
+                self._ring_pos = (self._ring_pos + 1) % self.RING
+
+    def _percentile(self, q):
+        # caller holds the lock
+        if not self._ring:
+            return None
+        s = sorted(self._ring)
+        return s[min(len(s) - 1, int(q * len(s)))]
+
+    def summary(self):
+        with self._lock:
+            return {
+                "count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max,
+                "p50": self._percentile(0.50), "p95": self._percentile(0.95),
+            }
+
+
+class MetricsRegistry:
+    """get-or-create metric accessors, schema-checked at creation.
+
+    `counter(key)` / `gauge(key)` / `hist(key)` raise on a key absent
+    from METRIC_SCHEMA or declared under a different kind — emitting an
+    undocumented metric must fail in tests, not drift in production
+    JSONL (tests/test_metrics_schema.py)."""
+
+    def __init__(self, schema=METRIC_SCHEMA):
+        self._schema = schema
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get(self, key, kind, cls):
+        assert key in self._schema, (
+            f"metric key {key!r} is not declared in METRIC_SCHEMA — add it "
+            "there AND to the docs/OBSERVABILITY.md table (the schema lint "
+            "test pins the two against each other)"
+        )
+        assert self._schema[key][0] == kind, (
+            f"metric {key!r} is declared as a {self._schema[key][0]}, "
+            f"not a {kind}"
+        )
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = self._metrics[key] = cls(self._lock)
+            assert isinstance(m, cls)
+            return m
+
+    def counter(self, key):
+        return self._get(key, "counter", Counter)
+
+    def gauge(self, key):
+        return self._get(key, "gauge", Gauge)
+
+    def hist(self, key):
+        return self._get(key, "hist", Histogram)
+
+    def counters(self):
+        """Counters-only view ({key: total}) — the per-iter record's
+        cheap path (no histogram ring sorting, unlike snapshot())."""
+        with self._lock:
+            return {k: m.total for k, m in self._metrics.items()
+                    if isinstance(m, Counter)}
+
+    def snapshot(self):
+        """{"counters": {key: total}, "gauges": {key: value},
+        "hists": {key: summary}} — JSON-serializable, for sink records."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out = {"counters": {}, "gauges": {}, "hists": {}}
+        for key, m in items:
+            if isinstance(m, Counter):
+                out["counters"][key] = m.total
+            elif isinstance(m, Gauge):
+                out["gauges"][key] = m.value
+            elif isinstance(m, Histogram):
+                out["hists"][key] = m.summary()
+        return out
+
+
+_global = [None]
+
+
+def get_registry():
+    """The process-global registry every instrumented layer records into.
+    Created on first use; `reset_registry()` swaps in a fresh one (tests,
+    or back-to-back runs in one process)."""
+    if _global[0] is None:
+        _global[0] = MetricsRegistry()
+    return _global[0]
+
+
+def reset_registry():
+    _global[0] = MetricsRegistry()
+    return _global[0]

@@ -1,0 +1,168 @@
+"""Turn a metrics.jsonl run log into a goodput/timing summary.
+
+The report answers the question the raw iter lines can't: where did the
+wall time actually go? Components (docs/OBSERVABILITY.md "Goodput"):
+
+  device      steady-state window time minus host batch staging —
+              time the devices were doing optimizer steps
+  host_batch  host-side batch staging (loop spans; overlapped with
+              device compute in the windowed loop, charged here so the
+              components partition the total)
+  eval        estimate_loss (host-blocking by design)
+  checkpoint  loop-blocking save time (async writer time is separate —
+              it overlaps training and is reported as a footnote)
+  compile     trace+compile of each new window length
+  untracked   total minus all of the above (loop bookkeeping, signal
+              exchanges; should be small — a big number here is a bug)
+
+CLI wrapper: tools/obs_report.py. Library entry: summarize(records).
+"""
+
+import json
+import statistics
+
+
+def load_records(path):
+    """Parse a metrics.jsonl. A killed run (SIGKILL, ENOSPC) can leave a
+    torn final line — skip unparseable lines with a warning instead of
+    crashing on exactly the logs a crashed run leaves behind."""
+    import sys
+
+    records = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"[obs_report] skipping unparseable line {lineno} "
+                      f"of {path} (torn write from a killed run?)",
+                      file=sys.stderr)
+    return records
+
+
+def _by_kind(records, kind):
+    return [r for r in records if r.get("kind") == kind]
+
+
+def summarize(records):
+    """Compute the goodput breakdown + run facts from parsed records.
+    Returns a plain dict (format_report renders it). A resumed run's log
+    holds one SEGMENT per launch (each starting with run_meta, appended
+    by the sink); the summary covers the last segment — earlier segments
+    stay on disk and can be sliced out by their run_meta records."""
+    assert records, "empty metrics log"
+    metas = [i for i, r in enumerate(records) if r.get("kind") == "run_meta"]
+    n_segments = len(metas)
+    if metas:
+        records = records[metas[-1]:]
+    meta = (_by_kind(records, "run_meta") or [{}])[0]
+    end = (_by_kind(records, "run_end") or [{}])[-1]
+    iters = _by_kind(records, "iter")
+    evals = _by_kind(records, "eval")
+    stalls = _by_kind(records, "stall")
+
+    counters = dict(end.get("counters") or
+                    (iters[-1].get("counters") if iters else {}) or {})
+    t0 = meta.get("t", records[0].get("t"))
+    t1 = end.get("t", records[-1].get("t"))
+    total_ms = max(0.0, (t1 - t0) * 1e3) if (t0 and t1) else 0.0
+
+    step_window = counters.get("step_window_ms", 0.0)
+    host_batch = counters.get("host_batch_ms", 0.0)
+    components = {
+        "device": max(0.0, step_window - host_batch),
+        "host_batch": host_batch,
+        "eval": counters.get("eval_ms", 0.0),
+        "checkpoint": counters.get("checkpoint_ms", 0.0),
+        "compile": counters.get("compile_ms", 0.0),
+    }
+    tracked_ms = sum(components.values())
+    untracked_ms = total_ms - tracked_ms
+
+    losses = [(r["iter"], r["loss"]) for r in iters]
+    dts = [r["dt_ms"] for r in iters if "dt_ms" in r]
+    toks = [r["tok_per_sec"] for r in iters if "tok_per_sec" in r]
+    return {
+        "meta": meta,
+        "n_segments": n_segments,
+        "total_ms": total_ms,
+        "components": components,
+        "tracked_ms": tracked_ms,
+        "untracked_ms": untracked_ms,
+        "coverage": (tracked_ms / total_ms) if total_ms else None,
+        "counters": counters,
+        "n_iter_records": len(iters),
+        "first_loss": losses[0] if losses else None,
+        "last_loss": losses[-1] if losses else None,
+        "median_dt_ms": statistics.median_low(dts) if dts else None,
+        "median_tok_per_sec": statistics.median_low(toks) if toks else None,
+        "n_evals": len(evals),
+        "n_stalls": len(stalls),
+        "ckpt_async_writer_ms": counters.get("ckpt_save_ms", 0.0),
+        "ckpt_bytes_written": counters.get("ckpt_bytes_written", 0.0),
+        "restore_ms": counters.get("ckpt_restore_ms", 0.0),
+        "restore_bytes": counters.get("ckpt_restore_bytes", 0.0),
+    }
+
+
+def _fmt_ms(ms):
+    return f"{ms / 1e3:10.3f}s"
+
+
+def format_report(s):
+    meta = s["meta"]
+    lines = []
+    lines.append("== avenir run report ==")
+    if s.get("n_segments", 1) > 1:
+        lines.append(f"(resumed run: {s['n_segments']} segments in the log; "
+                     "summarizing the last)")
+    if meta:
+        fields = [f"{k}={meta[k]}" for k in
+                  ("model_type", "n_chips", "tokens_per_iter", "block_size")
+                  if k in meta]
+        if fields:
+            lines.append("run:      " + "  ".join(fields))
+    if s["first_loss"] is not None:
+        (i0, l0), (i1, l1) = s["first_loss"], s["last_loss"]
+        lines.append(f"loss:     {l0:.4f} (iter {i0}) -> {l1:.4f} (iter {i1})"
+                     f"  over {s['n_iter_records']} logged iters")
+    if s["median_dt_ms"] is not None:
+        tps = s["median_tok_per_sec"]
+        lines.append(f"speed:    median {s['median_dt_ms']:.2f} ms/iter"
+                     + (f", {tps:,.0f} tok/s global" if tps else ""))
+    lines.append("")
+    lines.append("-- goodput (share of loop wall time) --")
+    total = s["total_ms"]
+    for name in ("device", "host_batch", "eval", "checkpoint", "compile"):
+        ms = s["components"][name]
+        pct = (100.0 * ms / total) if total else 0.0
+        lines.append(f"  {name:<11}{_fmt_ms(ms)}  {pct:5.1f}%")
+    pct_un = (100.0 * s["untracked_ms"] / total) if total else 0.0
+    lines.append(f"  {'untracked':<11}{_fmt_ms(s['untracked_ms'])}  {pct_un:5.1f}%")
+    lines.append(f"  {'total':<11}{_fmt_ms(total)}  100.0%")
+    if s["coverage"] is not None:
+        lines.append(f"  tracked coverage: {100.0 * s['coverage']:.1f}% "
+                     "(device+host_batch+eval+checkpoint+compile)")
+    extras = []
+    if s["ckpt_async_writer_ms"]:
+        extras.append(f"checkpoint writer {s['ckpt_async_writer_ms'] / 1e3:.3f}s "
+                      f"/ {s['ckpt_bytes_written'] / 1e6:.1f} MB "
+                      "(overlaps training when async)")
+    if s["restore_ms"]:
+        extras.append(f"restore {s['restore_ms'] / 1e3:.3f}s "
+                      f"/ {s['restore_bytes'] / 1e6:.1f} MB read")
+    if s["n_stalls"]:
+        extras.append(f"WATCHDOG STALL WARNINGS: {s['n_stalls']}")
+    if extras:
+        lines.append("")
+        lines += ["  " + e for e in extras]
+    return "\n".join(lines)
+
+
+def main(argv):
+    assert len(argv) == 1, "usage: python tools/obs_report.py <metrics.jsonl>"
+    records = load_records(argv[0])
+    print(format_report(summarize(records)))

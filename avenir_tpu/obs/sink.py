@@ -1,0 +1,66 @@
+"""JSONL run-log sink (ISSUE 1 tentpole).
+
+One line per record, flushed on every write so a killed run still leaves
+a parseable log up to its last event. Records are plain dicts; the loop
+stamps each with a `kind` (see RECORD_KINDS) and wall time `t`. The
+coordinator owns the file; other processes (and library code that may
+run without a sink) use NullSink so call sites stay branch-free.
+
+Thread-safe: the stall watchdog and async checkpoint callbacks write
+from their own threads.
+"""
+
+import json
+import threading
+
+# every record's "kind" value; docs/OBSERVABILITY.md documents each and
+# tests/test_metrics_schema.py pins the mirror
+RECORD_KINDS = {
+    "run_meta",   # one per run, at loop start: static run facts
+    "iter",       # per logged iter: loss/dt/mfu/tok_per_sec + counters
+    "eval",       # per estimate_loss: split losses + duration
+    "ckpt",       # per checkpoint save decision: duration, async or not
+    "compile",    # per first-dispatch of a window length: compile wall
+    "stall",      # watchdog warning: seconds since last progress
+    "run_end",    # one per run, at exit: final counter snapshot
+}
+
+
+class JsonlSink:
+    def __init__(self, path, append=False):
+        """`append=True` (resumed runs) keeps the earlier segments'
+        records — a preempted-and-relaunched run must not destroy the
+        telemetry of the segment before the preemption. Each segment
+        starts with its own run_meta record; report.summarize() analyzes
+        the last segment."""
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "a" if append else "w")
+
+    def write(self, record):
+        assert record.get("kind") in RECORD_KINDS, (
+            f"unknown record kind {record.get('kind')!r} — add it to "
+            "sink.RECORD_KINDS and the docs/OBSERVABILITY.md table"
+        )
+        line = json.dumps(record)  # raises on non-serializable: fail loud
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+class NullSink:
+    """No-op sink for non-coordinator processes / metrics_log=False."""
+
+    def write(self, record):
+        pass
+
+    def close(self):
+        pass

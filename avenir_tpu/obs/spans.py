@@ -1,0 +1,47 @@
+"""Host-side spans that feed both XProf and the metrics registry.
+
+The loop's phase annotations (`host_batch`/`train`/`eval`/`checkpoint`)
+already group device activity in XProf traces via
+jax.profiler.TraceAnnotation; `span()` keeps that and ALSO accumulates
+the host-side wall time of each phase into a registry counter — the raw
+material for per-run goodput accounting (docs/OBSERVABILITY.md). The
+annotation name is the XProf trace name, so a span in a trace viewer
+and its `*_ms` counter in metrics.jsonl are the same phase by
+construction.
+"""
+
+import time
+from contextlib import contextmanager, nullcontext
+
+from avenir_tpu.obs.metrics import get_registry
+
+try:
+    from jax.profiler import StepTraceAnnotation, TraceAnnotation
+except Exception:  # pragma: no cover — jax-less tooling contexts
+    StepTraceAnnotation = TraceAnnotation = None
+
+
+@contextmanager
+def span(name, *, counter=None, hist=None, step_num=None, registry=None):
+    """Context manager: XProf TraceAnnotation (StepTraceAnnotation when
+    `step_num` is given) + wall-time accumulation into the counter
+    `{name}_ms` (override with `counter=`; must be a METRIC_SCHEMA key).
+    `hist` optionally also observes the duration into a histogram."""
+    reg = registry if registry is not None else get_registry()
+    c = reg.counter(counter or f"{name}_ms")
+    h = reg.hist(hist) if hist else None
+    if TraceAnnotation is None:
+        ann = nullcontext()
+    elif step_num is not None:
+        ann = StepTraceAnnotation(name, step_num=step_num)
+    else:
+        ann = TraceAnnotation(name)
+    t0 = time.perf_counter()
+    try:
+        with ann:
+            yield
+    finally:
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        c.add(dt_ms)
+        if h is not None:
+            h.observe(dt_ms)

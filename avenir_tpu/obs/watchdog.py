@@ -1,0 +1,139 @@
+"""Stall watchdog: a daemon thread that notices a frozen training loop.
+
+A hung pod collective (one process wedged in a psum the others already
+entered, a dead DCN link, a deadlocked host callback) freezes the loop
+SILENTLY — no exception, no log line, just no more windows. The
+watchdog turns that silence into a warning: the loop calls
+`notify(window_secs)` every time a window completes, and the thread
+fires when no progress lands within max(floor_secs, factor x median
+window time). On the first warning of a stall episode it optionally
+dumps all Python thread stacks via faulthandler (the fastest way to see
+WHERE the main thread is wedged), increments the `watchdog_stalls`
+counter, and writes a `stall` record to the run log. Repeat warnings
+are spaced one threshold apart so a long stall logs O(log) lines, not
+one per poll tick.
+
+The median-based threshold keeps one knob (`--watchdog_secs`, the
+floor) meaningful across model scales: tiny CPU smokes complete windows
+in milliseconds, an 8B pod run in tens of seconds — 10x the median is a
+stall for both.
+"""
+
+import statistics
+import sys
+import threading
+import time
+from contextlib import contextmanager
+
+
+class StallWatchdog:
+    def __init__(self, *, floor_secs, factor=10.0, poll_secs=1.0,
+                 registry=None, sink=None, dump_stacks=True,
+                 echo=print):
+        """`floor_secs`: minimum stall threshold (the --watchdog_secs
+        flag; also the only threshold until the first window lands).
+        `factor`: multiple of the median completed-window time that
+        counts as a stall once windows have completed."""
+        assert floor_secs > 0 and factor > 0
+        self.floor_secs = float(floor_secs)
+        self.factor = float(factor)
+        self.poll_secs = float(poll_secs)
+        self._registry = registry
+        self._sink = sink
+        self._dump_stacks = dump_stacks
+        self._echo = echo
+        self._lock = threading.Lock()
+        self._last_progress = time.monotonic()
+        self._durations = []  # recent window wall times, secs (cap 128)
+        self._iter = 0
+        self._paused = 0  # >0: inside a declared host boundary, don't fire
+        self._warned_at = None  # monotonic time of last warning, or None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="avenir-stall-watchdog", daemon=True)
+        self._thread.start()
+
+    def notify(self, window_secs=None, iter_num=None):
+        """Record loop progress (call on every completed window)."""
+        with self._lock:
+            self._last_progress = time.monotonic()
+            self._warned_at = None
+            if iter_num is not None:
+                self._iter = int(iter_num)
+            if window_secs is not None:
+                self._durations.append(float(window_secs))
+                if len(self._durations) > 128:
+                    del self._durations[:64]
+
+    @contextmanager
+    def pause(self):
+        """Declare a legitimate long host boundary (eval, sync save, an
+        expected first-window compile): the watchdog holds its fire for
+        the duration and restarts its clock when the boundary ends. A
+        hang INSIDE a paused region is by definition indistinguishable
+        from the boundary running long, so it is not flagged — the
+        watchdog's contract is steady-state window progress."""
+        with self._lock:
+            self._paused += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._paused -= 1
+                self._last_progress = time.monotonic()
+                self._warned_at = None
+
+    def threshold_secs(self):
+        with self._lock:
+            if not self._durations:
+                return self.floor_secs
+            return max(self.floor_secs,
+                       self.factor * statistics.median_low(self._durations))
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    # ---- thread body ----
+
+    def _run(self):
+        while not self._stop.wait(self.poll_secs):
+            now = time.monotonic()
+            thr = self.threshold_secs()
+            with self._lock:
+                if self._paused:
+                    continue
+                since = now - self._last_progress
+                warned_at = self._warned_at
+            if since <= thr:
+                continue
+            # re-warn one threshold after the previous warning, not per tick
+            if warned_at is not None and now - warned_at < thr:
+                continue
+            with self._lock:
+                self._warned_at = now
+            self._fire(since, thr)
+
+    def _fire(self, since, thr):
+        self._echo(
+            f"[watchdog] no training window completed in {since:.1f}s "
+            f"(stall threshold {thr:.1f}s = max(floor {self.floor_secs:.1f}s, "
+            f"{self.factor:.0f}x median window)); last progress at iter "
+            f"{self._iter} — a hung collective or wedged host thread?"
+        )
+        if self._registry is not None:
+            self._registry.counter("watchdog_stalls").add(1)
+        if self._sink is not None:
+            self._sink.write({
+                "kind": "stall", "t": time.time(), "iter": self._iter,
+                "secs_since_progress": round(since, 3),
+                "threshold_s": round(thr, 3),
+            })
+        if self._dump_stacks:
+            import faulthandler
+
+            self._echo("[watchdog] python stacks of all threads:")
+            try:
+                faulthandler.dump_traceback(file=sys.stderr)
+            except Exception:
+                pass  # never let diagnostics kill the watchdog

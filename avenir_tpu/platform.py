@@ -11,8 +11,13 @@ import os
 
 def honor_jax_platforms_env():
     """If the environment explicitly requests CPU, pin it through the live
-    jax config as well. No-op otherwise (the real chip stays default)."""
+    jax config as well. No-op otherwise (the real chip stays default).
+    Also installs the ambient-mesh API compat shims (avenir_tpu/compat.py)
+    so entrypoints written against modern jax run on legacy runtimes."""
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    from avenir_tpu.compat import install_jax_compat
+
+    install_jax_compat()

@@ -34,6 +34,13 @@ from avenir_tpu.models.common import (
     tpu_peak_flops,
 )
 from avenir_tpu.models.gpt import GPT, GPTConfig
+from avenir_tpu.obs import (
+    JsonlSink,
+    NullSink,
+    StallWatchdog,
+    get_registry,
+    span,
+)
 from avenir_tpu.parallel.mesh import initialize_distributed, is_coordinator, make_mesh
 from avenir_tpu.parallel.partition import (
     batch_pspec,
@@ -120,8 +127,10 @@ def build_model_factory(cfg, model_args, mesh=None):
 def setup_state(cfg, mesh, model_args, *, verbose=True):
     """Shared bring-up for training and sampling: sharded param init (or
     abstract shapes only), partition specs, graphdef."""
+    from avenir_tpu.compat import set_mesh
+
     mt, gcfg, ctor = build_model_factory(cfg, model_args, mesh=mesh)
-    jax.set_mesh(mesh)  # context mesh: makes in-model PartitionSpec constraints live
+    set_mesh(mesh)  # context mesh: makes in-model PartitionSpec constraints live
     model_abs = nnx.eval_shape(lambda: ctor(cfg["seed"]))
     graphdef, abs_state = nnx.split(model_abs, nnx.Param)
     paths = [p for p, _ in abs_state.flat_state()]
@@ -180,6 +189,13 @@ def init_sharded_opt_state(tx, params, shard_tree):
 
 
 def run_training(cfg):
+    _t_entry = time.time()  # setup_ms gauge: entry -> loop start
+    # fresh counters per run: a second in-process run_training (sweeps,
+    # bench, tests) must not inherit the previous run's cumulative totals
+    # — restore counters recorded later in THIS run are preserved
+    from avenir_tpu.obs import reset_registry
+
+    reset_registry()
     initialize_distributed()
     master = is_coordinator()
     if cfg.get("debug_nans"):
@@ -344,10 +360,12 @@ def run_training(cfg):
     train_loader = DataLoader(
         data_dir, block_size, global_micro_batch,
         sharding=batch_sharding, grad_accum=grad_accum, seed=cfg["seed"],
+        vocab_size=model_args["vocab_size"],
     )
     eval_loader = DataLoader(
         data_dir, block_size, global_micro_batch,
         sharding=eval_sharding, grad_accum=1, seed=cfg["seed"] + 1, flat=True,
+        vocab_size=model_args["vocab_size"],
     )
 
     # ---- step fns ----
@@ -433,19 +451,30 @@ def run_training(cfg):
             best_val_loss=best_val_loss, config=cfg,
             model_family=st["model_type"],
         )
-        if pending_ckpt[0] is not None:
-            # one save in flight at a time — and a sync save must never
-            # race a background writer's rename of the same file
-            pending_ckpt[0].join()
-            pending_ckpt[0] = None
-        if use_async_ckpt and not sync:
-            if jax.process_count() == 1:
-                pending_ckpt[0] = save_checkpoint_async(cfg["out_dir"], **kw)
+        # the span counts only LOOP-BLOCKING time: snapshot + enqueue for
+        # async saves, the whole write for sync ones (the async writer's
+        # own time lands in ckpt_save_ms from its thread)
+        with span("checkpoint"), wd_pause():
+            if pending_ckpt[0] is not None:
+                # one save in flight at a time — and a sync save must never
+                # race a background writer's rename of the same file
+                pending_ckpt[0].join()
+                pending_ckpt[0] = None
+            t_s0 = time.time()
+            is_async = use_async_ckpt and not sync
+            if is_async:
+                if jax.process_count() == 1:
+                    pending_ckpt[0] = save_checkpoint_async(cfg["out_dir"], **kw)
+                else:
+                    pending_ckpt[0] = save_checkpoint_sharded_async(
+                        cfg["out_dir"], **kw)
             else:
-                pending_ckpt[0] = save_checkpoint_sharded_async(
-                    cfg["out_dir"], **kw)
-        else:
-            save_checkpoint(cfg["out_dir"], **kw)
+                save_checkpoint(cfg["out_dir"], **kw)
+        sink.write({
+            "kind": "ckpt", "t": time.time(), "iter": it,
+            "dur_ms": round((time.time() - t_s0) * 1e3, 3),
+            "async": is_async,
+        })
 
     # graceful preemption (SURVEY §5 failure/recovery): SIGTERM sets a
     # flag; the loop finishes the in-flight iteration, saves, and exits
@@ -465,6 +494,34 @@ def run_training(cfg):
     except ValueError:  # not on the main thread (embedded use): skip
         _prev_handler = None
 
+    # ---- observability (avenir_tpu/obs, ISSUE 1): metrics registry +
+    # JSONL run log + stall watchdog. The registry is process-local and
+    # always on (counter adds are ~ns); the sink file is coordinator-only
+    # and gated on --metrics_log. run_meta/t below is the goodput "total"
+    # anchor: everything after it is loop time (setup_ms covers before).
+    reg = get_registry()
+    sink = (JsonlSink(os.path.join(cfg["out_dir"], "metrics.jsonl"),
+                      append=(cfg["init_from"] == "resume"))
+            if (cfg.get("metrics_log", True) and master) else NullSink())
+    wd = None
+    if float(cfg.get("watchdog_secs", 0) or 0) > 0:
+        wd = StallWatchdog(
+            floor_secs=float(cfg["watchdog_secs"]), registry=reg, sink=sink,
+            echo=(print if master else
+                  (lambda m: print(f"[p{jax.process_index()}] {m}"))),
+        )
+    from contextlib import nullcontext
+
+    # declared host boundaries (eval, saves, expected compiles) hold the
+    # watchdog's fire — they are not missing-window stalls
+    wd_pause = wd.pause if wd is not None else nullcontext
+    if cfg["decay_lr"]:
+        # warm the schedule's jnp kernels NOW: the one-time eager-op
+        # compile of the first lr evaluation (~0.5s on a cold CPU host)
+        # belongs to setup_ms, not smeared untracked into the loop
+        float(lr_schedule(iter_num))
+    reg.gauge("setup_ms").set((time.time() - _t_entry) * 1e3)
+
     # pipelined window logging: the windowed path fetches/logs a window's
     # metrics only AFTER the next window is enqueued, so the D2H fence and
     # the next window's host staging overlap device compute. `pending`
@@ -472,6 +529,14 @@ def run_training(cfg):
     # flushed before any host boundary (eval, save, profile stop, exit).
     pending = [None]
     _t0 = [time.time()]
+    sink.write({
+        "kind": "run_meta", "t": _t0[0], "schema": 1, "iter": iter_num,
+        "model_type": st["model_type"], "n_chips": jax.device_count(),
+        "n_processes": jax.process_count(), "mesh": dict(mesh.shape),
+        "tokens_per_iter": tokens_per_iter, "block_size": block_size,
+        "global_micro_batch": global_micro_batch, "grad_accum": grad_accum,
+        "setup_ms": round((time.time() - _t_entry) * 1e3, 3),
+    })
     window_times = []  # (start_iter, K, dt_per_iter) per flushed window —
     # returned for bench.py's --form=loop arm (the shipped trainer IS the
     # headline measurement, VERDICT r4 item 4)
@@ -486,11 +551,24 @@ def run_training(cfg):
 
     def _log_window(start, Kp, m):
         nonlocal running_mfu
-        losses_np = np.asarray(m["loss"]).reshape(-1)  # ONE stacked D2H
+        _tf0 = time.time()
+        # ONE stacked D2H for loss AND grad_norm (the estimate_loss
+        # discipline: a second sequential fetch would bill another full
+        # tunnel RTT to every window's dt)
+        both = np.asarray(jnp.stack([jnp.ravel(m["loss"]),
+                                     jnp.ravel(m["grad_norm"])]))
+        losses_np, grad_norms_np = both[0], both[1]
         t1 = time.time()
+        reg.counter("d2h_fence_ms").add((t1 - _tf0) * 1e3)
         dt = (t1 - _t0[0]) / Kp  # per-iter wall time, window-amortized
         _t0[0] = t1
         window_times.append((start, Kp, dt))
+        # goodput accounting: the window's wall time (staging + dispatch +
+        # fence, compile already excluded) and the per-iter dt histogram
+        reg.counter("step_window_ms").add(dt * Kp * 1e3)
+        reg.hist("window_dt_ms").observe(dt * 1e3)
+        if wd is not None:
+            wd.notify(window_secs=dt * Kp, iter_num=start + Kp)
         # every process checks (loss is a global value, identical on all
         # of them): a master-only raise would leave the other processes
         # blocked in the next collective on a pod
@@ -505,6 +583,7 @@ def run_training(cfg):
             )
         if not master:
             return
+        tok_per_sec = tokens_per_iter / dt
         for j in range(Kp):
             if (start + j) % cfg["log_interval"] != 0:
                 continue
@@ -517,6 +596,26 @@ def run_training(cfg):
                 running_mfu = mfu if running_mfu == -1.0 else 0.9 * running_mfu + 0.1 * mfu
             print(f"iter {start + j}: loss {lossf:.4f}, "
                   f"time {dt * 1000:.2f}ms, mfu {running_mfu * 100:.2f}%")
+            gnf = float(grad_norms_np[j])
+            # the lr iter start+j actually ran under — the loop-level `lr`
+            # is already the NEXT window's rate by flush time (one-window
+            # lag). Scalar schedule call: the shape was warmed at setup,
+            # so this is eager-dispatch cheap, and only at log cadence.
+            lr_j = (float(lr_schedule(start + j)) if cfg["decay_lr"]
+                    else cfg["learning_rate"])
+            reg.gauge("loss").set(lossf)
+            reg.gauge("grad_norm").set(gnf)
+            reg.gauge("iter_dt_ms").set(dt * 1e3)
+            reg.gauge("tokens_per_sec").set(tok_per_sec)
+            reg.gauge("mfu").set(running_mfu)
+            reg.gauge("lr").set(lr_j)
+            sink.write({
+                "kind": "iter", "t": t1, "iter": start + j, "loss": lossf,
+                "grad_norm": gnf, "dt_ms": round(dt * 1e3, 4),
+                "mfu": round(running_mfu, 6),
+                "tok_per_sec": round(tok_per_sec, 2), "lr": lr_j,
+                "counters": reg.counters(),
+            })
 
     iter_start = iter_num  # first iter of this process's run (mfu warmup)
 
@@ -531,8 +630,14 @@ def run_training(cfg):
             # same losses (same global arrays), so the save decision agrees.
             if iter_num % cfg["eval_interval"] == 0:
                 flush_pending()  # iter lines print before the eval line
-                with jax.profiler.TraceAnnotation("eval"):
+                _te0 = time.time()
+                with span("eval"), wd_pause():
                     losses = estimate_loss(params)
+                sink.write({
+                    "kind": "eval", "t": time.time(), "iter": iter_num,
+                    "train_loss": losses["train"], "val_loss": losses["val"],
+                    "dur_ms": round((time.time() - _te0) * 1e3, 3),
+                })
                 if master:
                     print(f"step {iter_num}: train loss {losses['train']:.4f}, "
                           f"val loss {losses['val']:.4f}")
@@ -550,8 +655,7 @@ def run_training(cfg):
                         if master:
                             print(f"saving checkpoint to {cfg['out_dir']}"
                                   + (" (async)" if use_async_ckpt else ""))
-                        with jax.profiler.TraceAnnotation("checkpoint"):
-                            do_save(lr, iter_num)
+                        do_save(lr, iter_num)  # spans itself ("checkpoint")
                 # eval + save are host boundaries, not step throughput:
                 # restart the window timer so their cost doesn't smear
                 # into the next window's K per-iter dt lines
@@ -591,9 +695,16 @@ def run_training(cfg):
                 # device (its metrics are only fetched below, after this
                 # dispatch is enqueued) — the upload and the memmap crops
                 # hide behind device compute
-                with jax.profiler.TraceAnnotation("host_batch"):
+                with span("host_batch", hist="host_batch_dt_ms"):
                     xs, ys = train_loader.get_batch_window("train", K)
-                with jax.profiler.StepTraceAnnotation("train", step_num=iter_num):
+                # a new window LENGTH is about to trace+compile (can run
+                # minutes on big models) — that is a declared boundary,
+                # not a stall; steady-state dispatches stay watched
+                _compile_expected = (
+                    wd_pause() if K not in seen_window_lengths
+                    else nullcontext())
+                with jax.profiler.StepTraceAnnotation("train", step_num=iter_num), \
+                        _compile_expected:
                     _td0 = time.time()
                     params, opt_state, metrics = window_step(
                         params, opt_state, base_rng, iter_num, xs, ys
@@ -612,17 +723,29 @@ def run_training(cfg):
                     # compiles on tiny models (VERDICT r4 weak #4).
                     seen_window_lengths.add(K)
                     _t0[0] += _td
+                    reg.counter("compile_ms").add(_td * 1e3)
+                    sink.write({
+                        "kind": "compile", "t": time.time(),
+                        "iter": iter_num, "window_len": K,
+                        "dur_ms": round(_td * 1e3, 3),
+                    })
                 flush_pending()  # logs the PREVIOUS window (one-window lag)
                 pending[0] = (iter_num, K, metrics)
             else:
                 K = 1
                 step_rng = jax.random.fold_in(base_rng, iter_num)
+                # first step of this run traces+compiles — a declared
+                # boundary for the watchdog, like the windowed path's
+                # first-window-length dispatch
+                _compile_expected = (wd_pause() if iter_num == iter_start
+                                     else nullcontext())
                 # StepTraceAnnotation groups device activity per train step
                 # in XProf/TensorBoard (SURVEY.md §5 "annotate phases")
-                with jax.profiler.StepTraceAnnotation("train", step_num=iter_num):
+                with jax.profiler.StepTraceAnnotation("train", step_num=iter_num), \
+                        _compile_expected:
                     params, opt_state, metrics = train_step(params, opt_state,
                                                             step_rng, x, y)
-                with jax.profiler.TraceAnnotation("host_batch"):
+                with span("host_batch", hist="host_batch_dt_ms"):
                     x, y = train_loader.get_batch("train")  # overlap host sampling w/ device step
                 if cfg["profile"] and iter_num >= 20 and profile_started:
                     jax.block_until_ready(metrics["loss"])
@@ -633,7 +756,18 @@ def run_training(cfg):
                     flush_pending()  # sync point at log cadence (old contract)
                 else:
                     pending[0] = None  # un-logged iter: no fetch at all
-                    _t0[0] = time.time()  # keep per-iter timing (old t0 contract)
+                    _now = time.time()
+                    # un-fetched iters still spent loop wall time (staging
+                    # + dispatch, no fence) — account it, or the goodput
+                    # report under-counts device time by ~(log_interval-1)/
+                    # log_interval in single-dispatch mode; they are also
+                    # watchdog progress, or a healthy loop with a long
+                    # log_interval would read as a stall
+                    reg.counter("step_window_ms").add((_now - _t0[0]) * 1e3)
+                    if wd is not None:
+                        wd.notify(window_secs=_now - _t0[0],
+                                  iter_num=iter_num + 1)
+                    _t0[0] = _now  # keep per-iter timing (old t0 contract)
             iter_num += K
             # coordinated preemption (r5, VERDICT r4 missing #3): SIGTERM
             # lands at different iterations on different processes, so no
@@ -678,18 +812,31 @@ def run_training(cfg):
                     do_save(lr, iter_num, sync=True)
                 break
     finally:
-        # a trace started at iter 10 must not dangle if the loop exits
-        # before the iter-20 stop (short runs, exceptions, eval_only)
-        if profile_started:
-            jax.block_until_ready(metrics["loss"])
-            jax.profiler.stop_trace()
-            profile_started = False
-        # restore the handler FIRST: if the join re-raises a writer
-        # error, the process must not keep the no-op SIGTERM handler
-        if _prev_handler is not None:
-            signal.signal(signal.SIGTERM, _prev_handler)
-        if pending_ckpt[0] is not None:
-            pending_ckpt[0].join()  # never exit with a half-written file
+        try:
+            # a trace started at iter 10 must not dangle if the loop exits
+            # before the iter-20 stop (short runs, exceptions, eval_only)
+            if profile_started:
+                jax.block_until_ready(metrics["loss"])
+                jax.profiler.stop_trace()
+                profile_started = False
+            # restore the handler FIRST: if the join re-raises a writer
+            # error, the process must not keep the no-op SIGTERM handler
+            if _prev_handler is not None:
+                signal.signal(signal.SIGTERM, _prev_handler)
+            if pending_ckpt[0] is not None:
+                pending_ckpt[0].join()  # never exit with a half-written file
+        finally:
+            # the run log must close cleanly even when the joins above
+            # re-raise; run_end carries the final counter snapshot (incl.
+            # any async-writer time the join just accounted)
+            if wd is not None:
+                wd.stop()
+            snap = reg.snapshot()
+            sink.write({
+                "kind": "run_end", "t": time.time(), "iter": iter_num,
+                "best_val_loss": float(best_val_loss), **snap,
+            })
+            sink.close()
 
     return {
         "iter_num": iter_num, "best_val_loss": float(best_val_loss),

@@ -8,10 +8,35 @@ shardings of the inputs (params pytree, batch) and XLA SPMD inserts the
 psum / reduce-scatter / all-gather the layout implies (SURVEY.md §1).
 """
 
+import time
+
 import jax
 import jax.numpy as jnp
 import optax
 from flax import nnx
+
+
+def _count_dispatches(fn):
+    """Wrap a jitted step dispatcher so every call lands in the metrics
+    registry (train_dispatches / train_dispatch_ms) — the obs layer's
+    view of dispatch pressure, shared by the trainer loop AND the bench
+    harness's direct-call forms. The dispatch wall time includes
+    trace+compile on the first call of each input shape (the loop
+    separates that out as compile_ms via its seen-window-length
+    accounting). ~µs of overhead per call against ~ms dispatches."""
+    from avenir_tpu.obs.metrics import get_registry
+
+    def wrapped(*args, **kwargs):
+        reg = get_registry()
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            reg.counter("train_dispatches").add(1)
+            reg.counter("train_dispatch_ms").add(
+                (time.perf_counter() - t0) * 1e3)
+
+    return wrapped
 
 
 def make_step_fns(graphdef, *, dropout: float):
@@ -76,7 +101,7 @@ def jit_train_step(train_step, tx):
     def wrapped(params, opt_state, rng, x, y):
         return train_step(params, opt_state, tx, rng, x, y)
 
-    return jax.jit(wrapped, donate_argnums=(0, 1))
+    return _count_dispatches(jax.jit(wrapped, donate_argnums=(0, 1)))
 
 
 def _scan_steps(train_step, tx, step_rngs, params, opt_state, xs, ys):
@@ -115,7 +140,7 @@ def jit_multi_train_step(train_step, tx):
         return _scan_steps(train_step, tx, step_rngs, params, opt_state,
                            xs, ys)
 
-    return jax.jit(wrapped, donate_argnums=(0, 1))
+    return _count_dispatches(jax.jit(wrapped, donate_argnums=(0, 1)))
 
 
 def jit_windowed_train_step(train_step, tx):
@@ -141,4 +166,4 @@ def jit_windowed_train_step(train_step, tx):
         return _scan_steps(train_step, tx, step_rngs, params, opt_state,
                            xs, ys)
 
-    return jax.jit(wrapped, donate_argnums=(0, 1))
+    return _count_dispatches(jax.jit(wrapped, donate_argnums=(0, 1)))

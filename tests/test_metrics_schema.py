@@ -1,0 +1,109 @@
+"""Schema lint (ISSUE 1 satellite): the metrics.jsonl contract lives in
+exactly two places — METRIC_SCHEMA in avenir_tpu/obs/metrics.py (enforced
+at metric creation) and the docs/OBSERVABILITY.md tables (what operators
+read). This fast test pins the two against each other AND walks the
+instrumented source for registry calls, so neither an undocumented metric
+nor a stale doc row can land silently."""
+
+import os
+import re
+
+from avenir_tpu.obs.metrics import METRIC_SCHEMA, MetricsRegistry
+from avenir_tpu.obs.sink import RECORD_KINDS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+
+
+def _doc_table_keys(text, header_key):
+    """Backticked keys from first column of the table whose header row
+    starts with `| header_key |`."""
+    keys = []
+    in_table = False
+    for line in text.splitlines():
+        if line.replace(" ", "").startswith(f"|{header_key}|"):
+            in_table = True
+            continue
+        if in_table:
+            if not line.startswith("|"):
+                break
+            m = re.match(r"\|\s*`([^`]+)`\s*\|", line)
+            if m:
+                keys.append(m.group(1))
+    return keys
+
+
+def test_doc_metric_table_matches_schema():
+    text = open(DOC).read()
+    doc_keys = _doc_table_keys(text, "key")
+    assert doc_keys, "metric-key table not found in docs/OBSERVABILITY.md"
+    assert set(doc_keys) == set(METRIC_SCHEMA), (
+        "docs/OBSERVABILITY.md metric table drifted from METRIC_SCHEMA:\n"
+        f"  undocumented: {sorted(set(METRIC_SCHEMA) - set(doc_keys))}\n"
+        f"  stale doc rows: {sorted(set(doc_keys) - set(METRIC_SCHEMA))}"
+    )
+    assert len(doc_keys) == len(set(doc_keys)), "duplicate doc rows"
+
+
+def test_doc_kind_table_matches_record_kinds():
+    text = open(DOC).read()
+    doc_kinds = _doc_table_keys(text, "kind")
+    assert doc_kinds, "record-kind table not found in docs/OBSERVABILITY.md"
+    assert set(doc_kinds) == RECORD_KINDS, (
+        f"docs kinds {sorted(doc_kinds)} != RECORD_KINDS {sorted(RECORD_KINDS)}"
+    )
+
+
+def test_doc_unit_types_match_schema():
+    """Each doc row's type column must agree with the schema kind."""
+    text = open(DOC).read()
+    rows = re.findall(r"\|\s*`([^`]+)`\s*\|\s*(counter|gauge|hist)\s*\|", text)
+    assert rows
+    for key, kind in rows:
+        assert METRIC_SCHEMA[key][0] == kind, (
+            f"{key}: documented as {kind}, schema says {METRIC_SCHEMA[key][0]}"
+        )
+
+
+_REG_CALL = re.compile(
+    r"""(?:reg|registry|self\._reg|get_registry\(\))\s*
+        \.\s*(counter|gauge|hist)\s*\(\s*(?:f?["']([^"']+)["'])""",
+    re.VERBOSE,
+)
+
+
+def test_source_emits_only_documented_keys():
+    """Every literal metric key the instrumented source passes to
+    registry.counter/gauge/hist must be in METRIC_SCHEMA with the right
+    kind (the registry also enforces this at runtime; here it is caught
+    without running a training loop)."""
+    found = {}
+    for dirpath, _, files in os.walk(os.path.join(REPO, "avenir_tpu")):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            src = open(os.path.join(dirpath, fn)).read()
+            for kind, key in _REG_CALL.findall(src):
+                found.setdefault(key, set()).add(kind)
+    assert found, "no registry calls found — did the instrumentation move?"
+    for key, kinds in sorted(found.items()):
+        assert key in METRIC_SCHEMA, f"undocumented metric key {key!r} in source"
+        for kind in kinds:
+            assert METRIC_SCHEMA[key][0] == kind, (
+                f"{key}: source uses .{kind}(), schema says "
+                f"{METRIC_SCHEMA[key][0]}"
+            )
+
+
+def test_span_counter_keys_resolve():
+    """span() derives `{name}_ms` from the annotation name unless given
+    an explicit counter; both paths must land on schema keys."""
+    reg = MetricsRegistry()
+    from avenir_tpu.obs.spans import span
+
+    for name in ("host_batch", "eval", "checkpoint"):
+        with span(name, registry=reg):
+            pass
+    snap = reg.snapshot()["counters"]
+    for key in ("host_batch_ms", "eval_ms", "checkpoint_ms"):
+        assert key in snap

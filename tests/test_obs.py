@@ -1,0 +1,255 @@
+"""Observability layer tests (ISSUE 1 satellite): registry semantics,
+JSONL sink round-trip, watchdog stall/healthy behavior, and a CPU
+one-process run_training smoke asserting the metrics.jsonl contract."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from avenir_tpu.obs import (
+    METRIC_SCHEMA,
+    JsonlSink,
+    MetricsRegistry,
+    NullSink,
+    StallWatchdog,
+    reset_registry,
+)
+
+
+# ---- registry ----
+
+def test_registry_counter_gauge_histogram_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("compile_ms")
+    c.add(10)
+    c.add(2.5)
+    assert c.total == 12.5 and c.events == 2
+    assert reg.counter("compile_ms") is c  # get-or-create
+
+    g = reg.gauge("loss")
+    assert g.value is None
+    g.set(3.0)
+    g.set(2.5)
+    assert g.value == 2.5
+
+    h = reg.hist("window_dt_ms")
+    for v in [5.0, 1.0, 3.0, 2.0, 4.0]:
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 5 and s["sum"] == 15.0
+    assert s["min"] == 1.0 and s["max"] == 5.0
+    assert s["p50"] == 3.0
+
+    snap = reg.snapshot()
+    assert snap["counters"]["compile_ms"] == 12.5
+    assert snap["gauges"]["loss"] == 2.5
+    assert snap["hists"]["window_dt_ms"]["count"] == 5
+    json.dumps(snap)  # snapshot must be JSON-serializable
+
+
+def test_registry_rejects_undocumented_keys():
+    reg = MetricsRegistry()
+    with pytest.raises(AssertionError):
+        reg.counter("not_a_documented_metric")
+    with pytest.raises(AssertionError):
+        reg.gauge("also_not_documented")
+    # kind mismatch is as much schema drift as a missing key
+    with pytest.raises(AssertionError):
+        reg.gauge("compile_ms")  # declared as a counter
+
+
+def test_registry_histogram_ring_bounds_memory():
+    reg = MetricsRegistry()
+    h = reg.hist("window_dt_ms")
+    for i in range(5000):
+        h.observe(float(i))
+    assert len(h._ring) <= h.RING
+    s = h.summary()
+    assert s["count"] == 5000 and s["min"] == 0.0 and s["max"] == 4999.0
+
+
+def test_registry_thread_safety():
+    reg = MetricsRegistry()
+    c = reg.counter("data_batches")
+
+    def hammer():
+        for _ in range(1000):
+            c.add(1)
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.total == 8000
+
+
+# ---- sink ----
+
+def test_jsonl_sink_round_trip(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    sink = JsonlSink(str(path))
+    recs = [
+        {"kind": "run_meta", "t": 1.0, "schema": 1, "iter": 0},
+        {"kind": "iter", "t": 2.0, "iter": 0, "loss": 3.25,
+         "counters": {"compile_ms": 12.0}},
+        {"kind": "run_end", "t": 3.0, "iter": 5, "counters": {}},
+    ]
+    for r in recs:
+        sink.write(r)
+    sink.close()
+    sink.write({"kind": "iter", "t": 9.0})  # post-close write: dropped, no raise
+    back = [json.loads(line) for line in open(path)]
+    assert back == recs  # every record parses, keys stable
+
+    with pytest.raises(AssertionError):
+        JsonlSink(str(tmp_path / "x.jsonl")).write({"kind": "nonsense"})
+
+    ns = NullSink()  # the non-coordinator interface
+    ns.write({"kind": "iter"})
+    ns.close()
+
+
+# ---- watchdog ----
+
+def test_watchdog_fires_on_artificial_stall(capsys):
+    reg = MetricsRegistry()
+    wd = StallWatchdog(floor_secs=0.08, factor=2.0, poll_secs=0.02,
+                       registry=reg, dump_stacks=False)
+    try:
+        wd.notify(window_secs=0.01, iter_num=3)
+        time.sleep(0.5)  # no progress: well past the 0.08s floor
+    finally:
+        wd.stop()
+    assert reg.counter("watchdog_stalls").total >= 1
+    out = capsys.readouterr().out
+    assert "no training window completed" in out
+    assert "iter 3" in out
+
+
+def test_watchdog_silent_on_healthy_loop(capsys):
+    reg = MetricsRegistry()
+    wd = StallWatchdog(floor_secs=0.2, factor=10.0, poll_secs=0.02,
+                       registry=reg, dump_stacks=False)
+    try:
+        for i in range(20):
+            wd.notify(window_secs=0.01, iter_num=i)
+            time.sleep(0.02)
+    finally:
+        wd.stop()
+    assert reg.counter("watchdog_stalls").total == 0
+    assert "no training window" not in capsys.readouterr().out
+
+
+def test_watchdog_pause_suppresses_firing_during_boundaries():
+    """Declared host boundaries (eval, sync saves, expected compiles)
+    must not fire the watchdog; a stall after the boundary still does."""
+    reg = MetricsRegistry()
+    wd = StallWatchdog(floor_secs=0.05, factor=2.0, poll_secs=0.01,
+                       registry=reg, dump_stacks=False)
+    try:
+        wd.notify(window_secs=0.01, iter_num=1)
+        with wd.pause():
+            time.sleep(0.3)  # would fire several times without the pause
+        assert reg.counter("watchdog_stalls").total == 0
+        time.sleep(0.3)  # a real stall, outside any boundary
+        assert reg.counter("watchdog_stalls").total >= 1
+    finally:
+        wd.stop()
+
+
+def test_watchdog_threshold_tracks_median():
+    wd = StallWatchdog(floor_secs=1.0, factor=10.0, poll_secs=10.0,
+                       dump_stacks=False)
+    try:
+        assert wd.threshold_secs() == 1.0  # floor until windows land
+        for _ in range(9):
+            wd.notify(window_secs=2.0)
+        assert wd.threshold_secs() == pytest.approx(20.0)  # 10x median
+    finally:
+        wd.stop()
+
+
+# ---- training smoke: the metrics.jsonl contract ----
+
+def _smoke_cfg(data_dir, out_dir, **over):
+    cfg = dict(
+        out_dir=str(out_dir), eval_interval=50, log_interval=1, eval_iters=2,
+        eval_only=False, always_save_checkpoint=True, init_from="scratch",
+        wandb_log=False, wandb_project="t", wandb_run_name="t",
+        dataset=str(data_dir), gradient_accumulation_steps=8, batch_size=4,
+        block_size=32, model_type="gpt", n_layer=2, n_head=2, n_embd=32,
+        dropout=0.0, bias=False, n_kv_head=0, ffn_hidden=0,
+        rope_theta=10000.0, n_experts=8, n_experts_per_tok=2,
+        capacity_factor=1.25,
+        learning_rate=1e-3, max_iters=15, weight_decay=0.1, beta1=0.9,
+        beta2=0.95, grad_clip=1.0, decay_lr=True, warmup_iters=2,
+        lr_decay_iters=15, min_lr=1e-4, backend="tpu", device="cpu",
+        dtype="float32", compile=False, seed=1337, mesh_shape="data:1",
+        remat=False, scan_layers=False, use_pallas=False, fused_adamw=False,
+        profile=False, allow_unsharded_fallback=True,
+        metrics_log=True, watchdog_secs=60.0,
+    )
+    cfg.update(over)
+    return cfg
+
+
+def test_run_training_writes_metrics_jsonl(char_dataset, tmp_path):
+    """Acceptance: a CPU run with --metrics_log=True produces a parseable
+    metrics.jsonl whose iter records exactly match loss_history, and the
+    goodput components sum to within 5% of loop wall time."""
+    from avenir_tpu.obs.report import format_report, load_records, summarize
+    from avenir_tpu.train.loop import run_training
+
+    reset_registry()  # counters from other tests must not leak in
+    out = tmp_path / "out"
+    res = run_training(_smoke_cfg(char_dataset["dir"], out))
+
+    path = out / "metrics.jsonl"
+    assert path.exists()
+    records = load_records(str(path))
+    kinds = [r["kind"] for r in records]
+    assert kinds[0] == "run_meta" and kinds[-1] == "run_end"
+
+    iters = [r for r in records if r["kind"] == "iter"]
+    it_nums = [r["iter"] for r in iters]
+    assert it_nums == sorted(it_nums) and len(set(it_nums)) == len(it_nums)
+    assert all(np.isfinite(r["loss"]) for r in iters)
+    # per-iter loss values EXACTLY match the returned loss_history
+    assert [(r["iter"], r["loss"]) for r in iters] == res["loss_history"]
+    # cumulative counters ride along on every iter record
+    assert all("counters" in r for r in iters)
+
+    s = summarize(records)
+    report = format_report(s)
+    assert "goodput" in report and "device" in report
+    # the acceptance bound: tracked components sum to within 5% of total
+    assert s["coverage"] is not None
+    assert abs(s["tracked_ms"] - s["total_ms"]) <= 0.05 * s["total_ms"], (
+        f"goodput components cover {100 * s['coverage']:.1f}% of wall time: "
+        f"{s['components']} vs total {s['total_ms']:.1f}ms"
+    )
+    # healthy run: the watchdog stayed silent
+    assert not [r for r in records if r["kind"] == "stall"]
+
+
+def test_metrics_log_off_writes_nothing(char_dataset, tmp_path):
+    from avenir_tpu.train.loop import run_training
+
+    out = tmp_path / "out"
+    run_training(_smoke_cfg(char_dataset["dir"], out, max_iters=3,
+                            metrics_log=False, watchdog_secs=0.0))
+    assert not (out / "metrics.jsonl").exists()
+
+
+def test_loader_rejects_oversized_vocab(char_dataset):
+    """ADVICE r5: a Llama-3-sized 128k vocab must fail loud at loader
+    construction, not wrap token ids modulo 65536 on the uint16 wire."""
+    from avenir_tpu.data.loader import DataLoader
+
+    DataLoader(char_dataset["dir"], 32, 4, vocab_size=65536)  # fits
+    with pytest.raises(AssertionError, match="wire"):
+        DataLoader(char_dataset["dir"], 32, 4, vocab_size=128_256)

@@ -1,0 +1,19 @@
+"""CLI wrapper: metrics.jsonl -> human-readable goodput/timing summary.
+
+Usage:
+    python tools/obs_report.py out/metrics.jsonl
+
+All logic lives in avenir_tpu/obs/report.py (importable for tests and
+notebooks); this file only handles being run from the repo root or from
+tools/.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from avenir_tpu.obs.report import main  # noqa: E402
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

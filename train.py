@@ -120,6 +120,15 @@ async_checkpoint = False
 # accept silent replication of param dims the mesh doesn't divide (e.g. an
 # unpadded char vocab on tensor:2); default is a hard error (fail-loud)
 allow_unsharded_fallback = False
+# structured run telemetry (avenir_tpu/obs, tpu backend): write
+# out_dir/metrics.jsonl — per-iter loss/dt/MFU/tokens-per-sec records plus
+# goodput counters (docs/OBSERVABILITY.md; tools/obs_report.py summarizes)
+metrics_log = True
+# stall watchdog floor in seconds; 0 disables. When >0, a daemon thread
+# warns (and dumps Python stacks) if no training window completes within
+# max(watchdog_secs, 10x median window time) — hung pod collectives freeze
+# silently otherwise (avenir_tpu/obs/watchdog.py)
+watchdog_secs = 0.0
 # -----------------------------------------------------------------------------
 from configurator import configure
 
