@@ -17,6 +17,24 @@ Design (TPU-first: everything static-shaped, one compile per
     token-for-token identical to the recompute-full-prefix path
     (tests/test_decode.py asserts this).
 
+ISSUE 2 additions:
+  - prompt-length bucketing: prompts right-pad to power-of-2 buckets
+    and the cache width rounds up to a 64 quantum (`width_bucket` —
+    coarse enough to bound decode compiles, fine enough that the
+    per-step attention overshoot is capped at 63 positions), so nearby
+    lengths share ONE prefill + ONE decode compile (padding is masked
+    out of attention and overwritten before it can be attended).
+  - stop tokens: `stop_tokens=` decodes through a while_loop with a
+    done-mask that exits the moment every row stops; emitted prefixes
+    are unchanged vs no-stop decoding (`first_stop_index` is the shared
+    truncation rule with the serve engine).
+  - per-row rng: pass a (B,) key vector and each row samples from its
+    own key with bits identical to a B=1 run — sample.py's batched
+    samples and the serve engine's parity contract.
+  - batched positions: `_forward_cached`/`_attend_cached`/`_write_cache`
+    accept a (B,) per-row position vector — the serve slot pool, where
+    every slot sits at its own depth (avenir_tpu/serve/).
+
 Works for GPT (learned pos emb, MHA), Llama (RoPE, GQA) and Mixtral (MoE
 layers), in both layer layouts (python-loop modules and scan-stacked
 `*_scan` modules).
@@ -32,9 +50,59 @@ import jax.numpy as jnp
 from flax import nnx
 
 # jitted prefill/step closures cached per live model object: repeated
-# generate_cached calls (sample.py's num_samples loop) must reuse ONE
-# compile per (B, prompt_len, max_t) instead of retracing fresh closures
+# generate_cached calls (sample.py's batched call) must reuse ONE
+# compile per (B, prompt_bucket, width_bucket) instead of retracing
+# fresh closures
 _DECODE_CACHE = weakref.WeakKeyDictionary()
+
+# One entry per TRACE of a decode-path jit (tracing happens exactly once
+# per compiled specialization, so len() counts compiles without touching
+# private jit internals). Tests pin compile budgets against this; the
+# serve engine keeps its own per-engine ledger the same way.
+_trace_events = []
+
+
+def trace_count():
+    """Number of decode-path traces (== XLA compiles) so far."""
+    return len(_trace_events)
+
+
+def prompt_bucket(n, cap, floor=8):
+    """Pad target for a length-n prompt: the smallest power of two >=
+    max(n, floor), clamped to cap. Bucketing bounds the number of
+    prefill compiles at O(log cap) instead of one per prompt length
+    (tests pin the count); prompts are right-padded to the bucket and
+    the real last-token logits are read at a *traced* index, so padding
+    never retraces."""
+    assert n <= cap, f"prompt length {n} > cap {cap}"
+    b = floor
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+def bucket_ladder(cap, floor=8):
+    """Every value prompt_bucket(-, cap) can return, ascending. The
+    serve scheduler asserts its prefill compiles stay within this
+    ladder (the 'number of prefill compiles is bounded' contract)."""
+    out = []
+    b = floor
+    while b < cap:
+        out.append(b)
+        b *= 2
+    out.append(cap)
+    return tuple(out)
+
+
+def width_bucket(n, cap, quantum=64):
+    """KV-cache width for a total length n: n rounded up to a multiple
+    of `quantum`, clamped to cap. Coarser than exact (bounds decode
+    compiles at cap/quantum variants instead of one per length) but much
+    finer than power-of-2 (a too-wide cache is pure waste EVERY decode
+    step — attention reads the full width — so the overshoot is capped
+    at quantum-1 positions, not ~n)."""
+    assert n <= cap
+    return min(cap, -(-n // quantum) * quantum)
 
 
 class KVCache(NamedTuple):
@@ -48,7 +116,9 @@ def init_cache(*, n_layer, batch, max_t, n_kv_head, head_dim, dtype):
 
 
 def _attend_cached(q, kc, vc, q_pos):
-    """q: (B, T, H, D) at absolute positions q_pos (T,); kc/vc the full
+    """q: (B, T, H, D) at absolute positions q_pos — (T,) shared across
+    the batch (one-shot decode) or (B, T) per-row (the serve engine's
+    slot pool, where every slot sits at its own depth); kc/vc the full
     (B, T_max, H_kv, D) cache. Each query attends to cached positions
     <= its own. fp32 softmax, mirrors ops.causal_attention_reference.
 
@@ -65,8 +135,12 @@ def _attend_cached(q, kc, vc, q_pos):
                    preferred_element_type=jnp.float32)
     s = s.reshape(B, H, T, Tm) * (1.0 / math.sqrt(D))
     k_idx = jnp.arange(Tm)
-    mask = k_idx[None, :] <= q_pos[:, None]  # (T, T_max)
-    s = jnp.where(mask[None, None], s, float("-inf"))
+    if q_pos.ndim == 2:
+        mask = k_idx[None, None, :] <= q_pos[:, :, None]  # (B, T, T_max)
+        s = jnp.where(mask[:, None], s, float("-inf"))
+    else:
+        mask = k_idx[None, :] <= q_pos[:, None]  # (T, T_max)
+        s = jnp.where(mask[None, None], s, float("-inf"))
     p = jax.nn.softmax(s, axis=-1).astype(vc.dtype)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", p.reshape(B, Hkv, G, T, Tm), vc,
                      preferred_element_type=jnp.float32)
@@ -74,7 +148,18 @@ def _attend_cached(q, kc, vc, q_pos):
 
 
 def _write_cache(kc, vc, k, v, pos):
-    """Write (B, T, H_kv, D) new keys/values at absolute position pos."""
+    """Write (B, T, H_kv, D) new keys/values at absolute position pos —
+    a scalar shared by the batch, or a (B,) vector of per-row positions
+    (vmapped per-row writes, the slot-pool case)."""
+    if getattr(pos, "ndim", 0) == 1:
+        def row(kc_r, vc_r, k_r, v_r, p):
+            kc_r = jax.lax.dynamic_update_slice(
+                kc_r, k_r.astype(kc_r.dtype), (p, 0, 0))
+            vc_r = jax.lax.dynamic_update_slice(
+                vc_r, v_r.astype(vc_r.dtype), (p, 0, 0))
+            return kc_r, vc_r
+
+        return jax.vmap(row)(kc, vc, k, v, pos)
     kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, pos, 0, 0))
     vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, pos, 0, 0))
     return kc, vc
@@ -109,7 +194,7 @@ def _llama_layer_step(lyr, x, kc, vc, pos, q_pos, cos, sin):
     q = attn.q_proj(h).reshape(B, T, attn.n_head, attn.head_dim)
     k = attn.k_proj(h).reshape(B, T, attn.n_kv_head, attn.head_dim)
     v = attn.v_proj(h).reshape(B, T, attn.n_kv_head, attn.head_dim)
-    positions = jnp.broadcast_to(q_pos[None], (B, T))
+    positions = q_pos if q_pos.ndim == 2 else jnp.broadcast_to(q_pos[None], (B, T))
     q = apply_rope(q, cos, sin, positions=positions)
     k = apply_rope(k, cos, sin, positions=positions)
     kc, vc = _write_cache(kc, vc, k, v, pos)
@@ -151,15 +236,30 @@ def _run_layers(model, x, cache, pos, q_pos, layer_step):
     return x, KVCache(jnp.stack(ks), jnp.stack(vs))
 
 
-def _forward_cached(model, idx, cache, pos):
-    """Forward `idx` (B, T) at absolute start position `pos`, reading and
-    writing the cache. Returns (last-position fp32 logits, new cache)."""
+def _take_last(x, last_index):
+    """(B, T, C) -> (B, 1, C) at `last_index` (traced; None = T-1). A
+    traced index is what lets right-padded prompts (bucketing) read the
+    real last-token logits without a retrace per prompt length."""
+    if last_index is None:
+        return x[:, -1:]
+    return jax.lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)
+
+
+def _forward_cached(model, idx, cache, pos, last_index=None):
+    """Forward `idx` (B, T) at absolute start position `pos` — a scalar
+    shared by the batch, or a (B,) vector of per-row positions (serve
+    slot pool) — reading and writing the cache. Returns (fp32 logits at
+    `last_index` (default: the last position), new cache)."""
     B, T = idx.shape
-    q_pos = pos + jnp.arange(T)
+    if getattr(pos, "ndim", 0) == 1:
+        q_pos = pos[:, None] + jnp.arange(T)[None]  # (B, T)
+    else:
+        q_pos = pos + jnp.arange(T)  # (T,)
     if hasattr(model, "wte"):  # GPT
-        x = model.wte(idx) + model.wpe(q_pos)[None]
+        wpe = model.wpe(q_pos)
+        x = model.wte(idx) + (wpe if q_pos.ndim == 2 else wpe[None])
         x, cache = _run_layers(model, x, cache, pos, q_pos, _gpt_block_step)
-        x = model.ln_f(x[:, -1:]).astype(x.dtype)
+        x = model.ln_f(_take_last(x, last_index)).astype(x.dtype)
         logits = model.wte.attend(x)
     else:  # Llama / Mixtral
         from avenir_tpu.ops import rope_frequencies
@@ -174,7 +274,7 @@ def _forward_cached(model, idx, cache, pos):
             lambda lyr, h, kc, vc, p, qp: _llama_layer_step(
                 lyr, h, kc, vc, p, qp, cos, sin),
         )
-        x = model.norm(x[:, -1:]).astype(x.dtype)
+        x = model.norm(_take_last(x, last_index)).astype(x.dtype)
         logits = model.lm_head(x)
     return logits[:, -1].astype(jnp.float32), cache
 
@@ -189,11 +289,98 @@ def _sample(rng, logits, temperature, top_k):
     return rng, jax.random.categorical(sub, logits, axis=-1)
 
 
+def _sample_rows(keys, logits, temperature, top_k=None):
+    """Per-row sampling: row r consumes ONLY its own key (keys: (B,)
+    typed key array), with the same op sequence as `_sample` on a
+    (1, V) batch — so each row's token stream is bit-identical to
+    decoding that row alone at B=1 regardless of what shares the batch.
+    (vmap of jax's counter-mode PRNG reproduces the unbatched bits;
+    the serve engine's parity contract and sample.py's batched samples
+    both rest on this.) temperature/top_k are per-row arrays; top_k == V
+    means "no top-k" and its mask is an exact no-op — a STATIC None
+    skips the per-token full-vocab sort entirely (same bits: an all-V
+    mask never changes a logit)."""
+    V = logits.shape[-1]
+
+    def one(key, row, temp, k):
+        l = (row / temp)[None]  # (1, V): same aval as a B=1 _sample
+        if k is not None:
+            kth = jnp.sort(l, axis=-1)[0, V - k]
+            l = jnp.where(l < kth, -jnp.inf, l)
+        key, sub = jax.random.split(key)
+        return key, jax.random.categorical(sub, l, axis=-1)[0]
+
+    if top_k is None:
+        return jax.vmap(lambda ky, r, t: one(ky, r, t, None))(
+            keys, logits, temperature)
+    return jax.vmap(one)(keys, logits, temperature, top_k)
+
+
+def _sample_any(rng, logits, temperature, top_k):
+    """Dispatch on the rng form: one shared key -> the classic batched
+    categorical; a (B,) key vector -> per-row sampling (each row
+    bit-identical to its own B=1 run)."""
+    if getattr(rng, "ndim", 0) == 1:
+        B, V = logits.shape
+        ks = None
+        if top_k is not None:
+            k_eff = max(1, min(int(top_k), V))
+            ks = jnp.full((B,), k_eff, jnp.int32)
+        return _sample_rows(
+            rng, logits, jnp.full((B,), temperature, jnp.float32), ks)
+    return _sample(rng, logits, temperature, top_k)
+
+
+def _normalize_stop(stop_tokens):
+    """None | int | iterable -> None or a sorted tuple of ints (part of
+    the decode compile key, so a set and a list of the same ids share
+    one compile)."""
+    if stop_tokens is None:
+        return None
+    import numbers
+
+    if isinstance(stop_tokens, numbers.Integral):  # incl. numpy scalars
+        return (int(stop_tokens),)
+    stop = tuple(sorted(int(t) for t in stop_tokens))
+    return stop or None
+
+
+def first_stop_index(tokens, stop_tokens):
+    """Index just past the first stop token in a 1-D token sequence, or
+    len(tokens) if none occurs — the shared truncation rule between the
+    one-shot done-mask output and the serve engine's per-request
+    retirement."""
+    stop = set(_normalize_stop(stop_tokens) or ())
+    for i, t in enumerate(tokens):
+        if int(t) in stop:
+            return i + 1
+    return len(tokens)
+
+
 def generate_cached(model, rng, idx, max_new_tokens, temperature=1.0,
-                    top_k=None):
+                    top_k=None, stop_tokens=None, pad_id=None):
     """Drop-in replacement for model.generate: same outputs, one jitted
     single-token dispatch per new token instead of a full-prefix recompute.
-    Total length must fit the model's position table (block_size)."""
+    Total length must fit the model's position table (block_size).
+
+    rng: one key (classic batched sampling), or a (B,) key vector —
+    per-row sampling where row r's stream is bit-identical to decoding
+    it alone at B=1 (sample.py's batched samples; the serve engine's
+    parity reference).
+
+    stop_tokens: optional id or iterable of ids. Once a row emits one,
+    its remaining positions are `pad_id` (default: the first stop id)
+    and the decode while-loop exits as soon as EVERY row is done — the
+    cheap early exit; the emitted prefix is unchanged vs no-stop
+    decoding (tests pin this). `first_stop_index` gives the shared
+    truncation rule.
+
+    Prompt-length bucketing: the prompt is right-padded to a power-of-2
+    bucket and the KV width rounds up to a 64 quantum, so nearby
+    (prompt, budget) pairs reuse ONE prefill + ONE decode compile
+    (padding is masked out of attention and overwritten before it ever
+    becomes attendable; the real last-prompt logits are read at a
+    traced index)."""
     cfg = model.config
     B, T0 = idx.shape
     max_t = T0 + max_new_tokens
@@ -201,11 +388,22 @@ def generate_cached(model, rng, idx, max_new_tokens, temperature=1.0,
         f"cache decoding needs prompt+new <= block_size "
         f"({max_t} > {cfg.block_size})"
     )
+    t_pad = prompt_bucket(T0, cfg.block_size)
+    # width must cover the padded prompt (prefill writes t_pad rows)
+    width = max(width_bucket(max_t, cfg.block_size), t_pad)
+    stop = _normalize_stop(stop_tokens)
+    pad = int(pad_id) if pad_id is not None else (stop[0] if stop else 0)
+    rng_rows = getattr(rng, "ndim", 0) == 1
+    if rng_rows:
+        assert rng.shape[0] == B, (
+            f"per-row rng wants one key per row ({rng.shape[0]} keys, "
+            f"batch {B})"
+        )
     n_kv = getattr(cfg, "n_kv_head", cfg.n_head)
     from avenir_tpu.models.common import resolve_dtype
 
     cache = init_cache(
-        n_layer=cfg.n_layer, batch=B, max_t=max_t, n_kv_head=n_kv,
+        n_layer=cfg.n_layer, batch=B, max_t=width, n_kv_head=n_kv,
         head_dim=cfg.n_embd // cfg.n_head,
         dtype=resolve_dtype(cfg.compute_dtype),
     )
@@ -213,60 +411,107 @@ def generate_cached(model, rng, idx, max_new_tokens, temperature=1.0,
         per_model = _DECODE_CACHE.setdefault(model, {})
     except TypeError:  # model not weakref-able: still works, just retraces
         per_model = {}
-    # two-level cache: prefill depends only on shapes; the scanned loop
-    # additionally bakes in max_new_tokens and the sampling params — a
-    # temperature sweep must not recompile the (expensive) prefill
-    pre_key = ("prefill", B, T0, max_t)
-    key = (B, T0, max_t, max_new_tokens, float(temperature), top_k)
+    # two-level cache: prefill depends only on (bucketed) shapes; the
+    # scanned loop additionally bakes in max_new_tokens and the sampling
+    # params — a temperature sweep must not recompile the (expensive)
+    # prefill
+    pre_key = ("prefill", B, t_pad, width)
+    key = (B, width, max_new_tokens, float(temperature), top_k, stop, pad,
+           rng_rows)
     if pre_key not in per_model:
         graphdef, state = nnx.split(model)
 
         @functools.partial(jax.jit, donate_argnums=(2,))
-        def prefill(state, idx, cache):
+        def prefill(state, idx, cache, last_index):
+            _trace_events.append(pre_key)
             m = nnx.merge(graphdef, state)
-            return _forward_cached(m, idx, cache, 0)
+            return _forward_cached(m, idx, cache, 0, last_index=last_index)
 
         per_model[pre_key] = prefill
     if key not in per_model:
         graphdef, state = nnx.split(model)
 
-        # The whole decode loop is ONE dispatch: a lax.scan whose body
+        # The whole decode loop is ONE dispatch: a scan/while whose body
         # samples from the carried logits then runs the cached single-token
         # forward. A host-side loop costs a tunnel/dispatch round-trip per
         # token (measured 102 ms/token for GPT-2-124M on the axon chip —
         # the eager _sample ops and the per-token jnp.int32(pos) H2D each
-        # round-trip); the scan form makes decode latency pure device time.
-        # The rng fold sequence and sampling math are unchanged, so outputs
-        # stay token-for-token identical to GPT.generate (tests/
-        # test_decode.py). The final iteration's forward is wasted work
-        # (its logits are never sampled) but keeps the body uniform; its
-        # cache write at pos = T0+max_new_tokens-1 is in bounds.
-        @functools.partial(jax.jit, donate_argnums=(3,))
-        def decode_loop(state, rng, logits, cache, pos0):
-            m = nnx.merge(graphdef, state)
+        # round-trip); the fused form makes decode latency pure device
+        # time. The rng fold sequence and sampling math are unchanged, so
+        # outputs stay token-for-token identical to GPT.generate (tests/
+        # test_decode.py).
+        if stop is None:
+            # The final iteration's forward is wasted work (its logits are
+            # never sampled) but keeps the body uniform; its cache write at
+            # pos = T0+max_new_tokens-1 is in bounds.
+            @functools.partial(jax.jit, donate_argnums=(3,))
+            def decode_loop(state, rng, logits, cache, pos0):
+                _trace_events.append(key)
+                m = nnx.merge(graphdef, state)
 
-            # nnx.scan (module broadcast via in_axes=None), not raw
-            # lax.scan: the module's Variables belong to the jit trace and
-            # the nnx trace-level guard rejects re-splitting them inside a
-            # plain lax.scan body; nnx.scan lifts the module state through
-            # the scan properly (same mechanism as scan_layer_stack).
-            def body(carry, mm):
-                rng, logits, cache, pos = carry
-                rng, nxt = _sample(rng, logits, temperature, top_k)
-                logits2, cache = _forward_cached(mm, nxt[:, None], cache, pos)
-                return (rng, logits2, cache, pos + 1), nxt
+                # nnx.scan (module broadcast via in_axes=None), not raw
+                # lax.scan: the module's Variables belong to the jit trace
+                # and the nnx trace-level guard rejects re-splitting them
+                # inside a plain lax.scan body; nnx.scan lifts the module
+                # state through the scan properly (same mechanism as
+                # scan_layer_stack).
+                def body(carry, mm):
+                    rng, logits, cache, pos = carry
+                    rng, nxt = _sample_any(rng, logits, temperature, top_k)
+                    logits2, cache = _forward_cached(
+                        mm, nxt[:, None], cache, pos)
+                    return (rng, logits2, cache, pos + 1), nxt
 
-            _, toks = nnx.scan(
-                body, in_axes=(nnx.Carry, None), out_axes=(nnx.Carry, 0),
-                length=max_new_tokens,
-            )((rng, logits, cache, pos0), m)
-            return toks  # (max_new_tokens, B)
+                _, toks = nnx.scan(
+                    body, in_axes=(nnx.Carry, None), out_axes=(nnx.Carry, 0),
+                    length=max_new_tokens,
+                )((rng, logits, cache, pos0), m)
+                return toks  # (max_new_tokens, B)
+
+        else:
+            # Stop-token path: a lax.while_loop that exits the moment
+            # every row is done (the cheap early exit — no dispatch or
+            # device work for the unused tail). The body merges the
+            # module from the closed-over state pytree each iteration
+            # (trace-time only), which is what lets a plain while_loop
+            # host nnx modules. Done rows keep consuming rng and emit
+            # `pad`, so live rows' streams are bit-identical to the
+            # no-stop scan.
+            @functools.partial(jax.jit, donate_argnums=(3,))
+            def decode_loop(state, rng, logits, cache, pos0):
+                _trace_events.append(key)
+                stop_arr = jnp.asarray(stop, jnp.int32)
+
+                def cond(carry):
+                    i, done = carry[0], carry[5]
+                    return jnp.logical_and(i < max_new_tokens,
+                                           ~jnp.all(done))
+
+                def body(carry):
+                    i, rng, logits, cache, pos, done, toks = carry
+                    rng, nxt = _sample_any(rng, logits, temperature, top_k)
+                    nxt = jnp.where(done, jnp.int32(pad), nxt)
+                    done = jnp.logical_or(done, jnp.isin(nxt, stop_arr))
+                    toks = jax.lax.dynamic_update_slice(
+                        toks, nxt[None].astype(jnp.int32), (i, 0))
+                    m = nnx.merge(graphdef, state)
+                    logits2, cache = _forward_cached(
+                        m, nxt[:, None], cache, pos)
+                    return (i + 1, rng, logits2, cache, pos + 1, done, toks)
+
+                carry = (
+                    jnp.int32(0), rng, logits, cache, pos0,
+                    jnp.zeros((B,), bool),
+                    jnp.full((max_new_tokens, B), pad, jnp.int32),
+                )
+                return jax.lax.while_loop(cond, body, carry)[6]
 
         per_model[key] = decode_loop
     prefill, decode_loop = per_model[pre_key], per_model[key]
     # state re-split per call (cheap): picks up in-place weight mutations
     state = nnx.split(model)[1]
 
-    logits, cache = prefill(state, idx, cache)
+    idx_in = idx if T0 == t_pad else jnp.pad(idx, ((0, 0), (0, t_pad - T0)))
+    logits, cache = prefill(state, idx_in, cache, jnp.int32(T0 - 1))
     toks = decode_loop(state, rng, logits, cache, jnp.int32(T0))
-    return jnp.concatenate([idx, toks.T], axis=1)
+    return jnp.concatenate([idx, toks.T.astype(idx.dtype)], axis=1)

@@ -79,6 +79,34 @@ METRIC_SCHEMA = {
     # -- watchdog --
     "watchdog_stalls": (
         "counter", "1", "stall-watchdog warnings fired"),
+    # -- serving engine (avenir_tpu/serve) --
+    "serve_requests": (
+        "counter", "1", "requests completed by the serve engine"),
+    "tokens_out": (
+        "counter", "tok",
+        "tokens emitted by the serve engine (one per live slot per "
+        "decode iteration)"),
+    "serve_prefill_ms": (
+        "counter", "ms",
+        "admission prefill-into-slot dispatch wall time (the "
+        "serve_prefill spans; includes compile on the first prompt of "
+        "each bucket)"),
+    "serve_decode_ms": (
+        "counter", "ms",
+        "batched decode dispatch wall time incl. the per-iteration D2H "
+        "token fetch (the serve_decode spans)"),
+    "queue_depth": (
+        "gauge", "1",
+        "requests waiting for a slot after the last engine event"),
+    "slot_occupancy": (
+        "gauge", "1",
+        "fraction of KV slots live after the last engine step"),
+    "ttft_ms": (
+        "hist", "ms", "submit -> first token, per finished request"),
+    "tpot_ms": (
+        "hist", "ms",
+        "mean inter-token time after the first token, per finished "
+        "request"),
     # -- per-record gauges (latest value at log cadence) --
     "loss": ("gauge", "nats", "train loss at the last logged iter"),
     "lr": ("gauge", "1", "learning rate at the last logged iter"),

@@ -19,6 +19,7 @@ CLI wrapper: tools/obs_report.py. Library entry: summarize(records).
 """
 
 import json
+import math
 import statistics
 
 
@@ -85,7 +86,27 @@ def summarize(records):
     losses = [(r["iter"], r["loss"]) for r in iters]
     dts = [r["dt_ms"] for r in iters if "dt_ms" in r]
     toks = [r["tok_per_sec"] for r in iters if "tok_per_sec" in r]
+    requests = _by_kind(records, "request")
+    serve = None
+    if requests:
+        ttfts = [r["ttft_ms"] for r in requests if "ttft_ms" in r]
+        tpots = [r["tpot_ms"] for r in requests if "tpot_ms" in r]
+        # run_end counters when the run exited cleanly; a torn log (the
+        # exact case load_records tolerates) still has per-request n_out
+        tokens_out = (counters.get("tokens_out")
+                      or float(sum(r.get("n_out", 0) for r in requests)))
+        serve = {
+            "n_requests": len(requests),
+            "tokens_out": tokens_out,
+            "goodput_tok_per_sec": (tokens_out / (total_ms / 1e3)
+                                    if total_ms else None),
+            "ttft_p50_ms": percentile(ttfts, 0.50),
+            "ttft_p99_ms": percentile(ttfts, 0.99),
+            "tpot_p50_ms": percentile(tpots, 0.50),
+            "tpot_p99_ms": percentile(tpots, 0.99),
+        }
     return {
+        "serve": serve,
         "meta": meta,
         "n_segments": n_segments,
         "total_ms": total_ms,
@@ -106,6 +127,16 @@ def summarize(records):
         "restore_ms": counters.get("ckpt_restore_ms", 0.0),
         "restore_bytes": counters.get("ckpt_restore_bytes", 0.0),
     }
+
+
+def percentile(xs, q):
+    """Exact nearest-rank percentile (index ceil(q*n)-1) of a small
+    list (serve benches run tens-to-thousands of requests — no ring
+    needed here). Returns None on empty input."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
 
 
 def _fmt_ms(ms):
@@ -159,6 +190,20 @@ def format_report(s):
     if extras:
         lines.append("")
         lines += ["  " + e for e in extras]
+    sv = s.get("serve")
+    if sv:
+        lines.append("")
+        lines.append("-- serving --")
+        lines.append(f"  requests: {sv['n_requests']}   "
+                     f"tokens out: {sv['tokens_out']:,.0f}"
+                     + (f"   goodput {sv['goodput_tok_per_sec']:,.1f} tok/s"
+                        if sv["goodput_tok_per_sec"] is not None else ""))
+        if sv["ttft_p50_ms"] is not None:
+            lines.append(f"  ttft: p50 {sv['ttft_p50_ms']:.1f} ms  "
+                         f"p99 {sv['ttft_p99_ms']:.1f} ms")
+        if sv["tpot_p50_ms"] is not None:
+            lines.append(f"  tpot: p50 {sv['tpot_p50_ms']:.2f} ms  "
+                         f"p99 {sv['tpot_p99_ms']:.2f} ms")
     return "\n".join(lines)
 
 

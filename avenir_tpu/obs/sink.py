@@ -22,6 +22,7 @@ RECORD_KINDS = {
     "ckpt",       # per checkpoint save decision: duration, async or not
     "compile",    # per first-dispatch of a window length: compile wall
     "stall",      # watchdog warning: seconds since last progress
+    "request",    # per finished serve-engine request: ttft/tpot/tokens
     "run_end",    # one per run, at exit: final counter snapshot
 }
 

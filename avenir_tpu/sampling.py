@@ -68,15 +68,37 @@ def run_sampling(*, out_dir, init_from, start, num_samples, max_new_tokens,
     # jitted KV-cache decoder when the total length fits the position
     # table; recompute-full-prefix (parity path) otherwise
     use_cache = x.shape[1] + max_new_tokens <= model.config.block_size
-    for s in range(num_samples):
-        rng, sub = jax.random.split(rng)
-        if use_cache:
-            from avenir_tpu.infer.decode import generate_cached
+    if use_cache:
+        # Batched calls over the samples (one prefill + one decode
+        # dispatch per CHUNK instead of num_samples of each). The
+        # per-sample keys are the SAME split chain the old sequential
+        # loop produced, and per-row sampling is bit-identical to a B=1
+        # call per row (infer/decode._sample_rows), so the printed
+        # samples are unchanged — tests/test_decode.py pins both
+        # properties, and chunking cannot change them either. The chunk
+        # bounds peak memory: one KV cache ROW per in-flight sample, so
+        # an unbounded num_samples must not scale device memory with it.
+        from avenir_tpu.infer.decode import generate_cached
 
-            y = generate_cached(model, sub, x, max_new_tokens,
-                                temperature=temperature, top_k=top_k)
-        else:
+        chunk = 16
+        subs = []
+        for _ in range(num_samples):
+            rng, sub = jax.random.split(rng)
+            subs.append(sub)
+        for lo in range(0, num_samples, chunk):
+            part = subs[lo:lo + chunk]
+            keys = jax.random.wrap_key_data(
+                jnp.stack([jax.random.key_data(k) for k in part]))
+            y = generate_cached(model, keys, jnp.tile(x, (len(part), 1)),
+                                max_new_tokens, temperature=temperature,
+                                top_k=top_k)
+            for s in range(len(part)):
+                print(decode([int(t) for t in y[s]]))
+                print("---------------")
+    else:
+        for _ in range(num_samples):
+            rng, sub = jax.random.split(rng)
             y = model.generate(sub, x, max_new_tokens,
                                temperature=temperature, top_k=top_k)
-        print(decode([int(t) for t in y[0]]))
-        print("---------------")
+            print(decode([int(t) for t in y[0]]))
+            print("---------------")

@@ -1,0 +1,22 @@
+"""avenir_tpu.serve — slot-based continuous-batching inference engine
+(ISSUE 2).
+
+- slots.py:     fixed (L, n_slots, T_max, H_kv, D) KV slot pool + per-slot
+                decode state, donated through the jitted step
+- scheduler.py: FCFS admission, power-of-2 prompt bucketing (bounded
+                prefill compiles), iteration-level slot recycling
+- engine.py:    submit()/step()/drain() driver over the shared
+                infer/decode.py forward; per-request bit-parity with
+                one-shot generate_cached
+
+See docs/SERVING.md for the design and the parity contract.
+"""
+
+from avenir_tpu.serve.engine import Engine, FinishedRequest
+from avenir_tpu.serve.scheduler import FCFSScheduler, Request
+from avenir_tpu.serve.slots import SlotPool, init_slot_pool
+
+__all__ = [
+    "Engine", "FinishedRequest", "FCFSScheduler", "Request", "SlotPool",
+    "init_slot_pool",
+]

@@ -1,0 +1,329 @@
+"""Slot-based continuous-batching inference engine (ISSUE 2 tentpole,
+part 3).
+
+Synchronous and network-free (the sandbox has no sockets): callers
+drive `Engine.submit()` / `step()` / `drain()` directly — a transport
+in front of this would own no generation logic. One `step()` is one
+scheduler iteration:
+
+  1. admission — for every (queued request, free slot) pair, ONE jitted
+     prefill-into-slot dispatch per request: forward the bucketed
+     prompt through a temp single-sequence cache, then splice K/V, last
+     logits, rng, position and sampling params into the donated pool at
+     a *traced* slot index (no retrace per slot).
+  2. decode — ONE batched dispatch across all slots: per-slot sampling
+     (each slot consumes only its own rng key -> bit-identical to B=1),
+     then the shared `_forward_cached` single-token step at per-slot
+     positions.
+  3. harvest — the per-iteration device-to-host token fetch (the only
+     fence), incremental per-slot detokenization, stop/budget checks,
+     and slot recycling the moment a sequence finishes.
+
+Parity contract (pinned by tests/test_serve.py): every request's token
+stream is bit-identical to `generate_cached(model, req.rng,
+prompt[None], ...)` run alone, regardless of arrival order, co-tenants,
+slot eviction or bucketing. This holds because (a) sampling is per-row
+with per-slot keys, (b) attention over a longer masked cache tail is
+exact on this backend (established by the one-shot parity tests), and
+(c) prefill uses the SAME prompt bucket as the one-shot path — which
+also makes MoE expert-capacity behavior identical at prefill. (c) has
+one clamp-region exception: when max_seq_len < block_size AND a
+prompt's power-of-2 bucket exceeds max_seq_len, the engine pads to
+max_seq_len while one-shot pads wider — harmless for dense models (pad
+rows are masked to exactly-zero weight at any length), but MoE prefill
+capacity counts padded tokens, so Mixtral parity there needs the
+non-binding regime. Which is also the one genuine batching caveat at
+decode: Mixtral with a *binding* capacity (ceil(K*B*cf/E) < B) is
+batch-composition-dependent by construction — the engine warns once;
+with cf*K >= E (capacity >= batch) decode never drops and parity is
+exact (docs/SERVING.md).
+
+Compile budget: one prefill trace per prompt bucket ever seen plus ONE
+decode-step trace for the engine's lifetime, asserted against the
+bucket ladder after every step. Admission and recycling are host-side
+bookkeeping plus traced arguments — occupancy changes never retrace.
+"""
+
+import dataclasses
+import functools
+import time
+import warnings
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import nnx
+
+from avenir_tpu.infer.decode import (
+    KVCache,
+    _forward_cached,
+    _sample_rows,
+    _normalize_stop,
+    init_cache,
+)
+from avenir_tpu.obs import NullSink, get_registry, span
+from avenir_tpu.serve.scheduler import FCFSScheduler, Request
+from avenir_tpu.serve.slots import SlotPool, init_slot_pool
+
+
+@dataclasses.dataclass
+class FinishedRequest:
+    req_id: int
+    tokens: List[int]          # prompt + emitted (stop token included)
+    n_prompt: int
+    n_out: int
+    finish_reason: str         # 'stop' | 'length'
+    text: Optional[str]        # detokenized, when a codec was given
+    ttft_ms: float
+    tpot_ms: float
+
+
+class _Live:
+    """Host-side per-slot record while a request occupies a slot."""
+
+    def __init__(self, req):
+        self.req = req
+        self.emitted = []
+        self.text = "" if req is not None else None
+        self.t_first = None
+        self.t_last = None
+
+
+class Engine:
+    """Continuous-batching driver over the jitted KV-cache decode path.
+
+    Works for GPT / Llama / Mixtral in both layer layouts — everything
+    model-specific lives in `infer.decode._forward_cached`, which the
+    engine reuses rather than forking.
+    """
+
+    def __init__(self, model, *, n_slots=4, max_seq_len=None,
+                 detokenize: Optional[Callable] = None, registry=None,
+                 sink=None, seed=0):
+        cfg = model.config
+        self.model = model
+        self.n_slots = int(n_slots)
+        self.T_max = int(max_seq_len or cfg.block_size)
+        assert self.T_max <= cfg.block_size, (
+            f"max_seq_len {self.T_max} > model block_size {cfg.block_size}"
+        )
+        self.detokenize = detokenize
+        self._reg = registry if registry is not None else get_registry()
+        self.sink = sink if sink is not None else NullSink()
+        self.sched = FCFSScheduler(self.n_slots, self.T_max)
+        self._live = {}  # slot -> _Live
+        self._next_id = 0
+        self._base_rng = jax.random.key(seed)
+        self.traces = {"prefill": [], "step": []}
+
+        n_kv = getattr(cfg, "n_kv_head", cfg.n_head)
+        head_dim = cfg.n_embd // cfg.n_head
+        from avenir_tpu.models.common import resolve_dtype
+
+        kv_dtype = resolve_dtype(cfg.compute_dtype)
+        self.pool = init_slot_pool(
+            n_layer=cfg.n_layer, n_slots=self.n_slots, max_t=self.T_max,
+            n_kv_head=n_kv, head_dim=head_dim, vocab_size=cfg.vocab_size,
+            dtype=kv_dtype,
+        )
+        if getattr(cfg, "n_experts", 0):
+            cap = max(1, int(-(-cfg.n_experts_per_tok * self.n_slots
+                               * cfg.capacity_factor // cfg.n_experts)))
+            if cap < self.n_slots:
+                warnings.warn(
+                    "MoE decode capacity binds at this batch "
+                    f"(capacity {cap} < {self.n_slots} slots): token drops "
+                    "depend on batch composition, so engine output can "
+                    "diverge from one-shot decoding under load "
+                    "(docs/SERVING.md)", stacklevel=2)
+
+        # split ONCE: unlike generate_cached (which re-splits per call to
+        # pick up in-place weight mutations), serving weights are static
+        # for the engine's lifetime — a per-iteration re-split would put
+        # a full parameter-pytree traversal on the per-token hot path.
+        # Call refresh_state() after mutating weights in place.
+        graphdef, self._state = nnx.split(model)
+        traces = self.traces
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _admit(state, pool, idx_pad, slot, last_index, key_data, temp,
+                   top_k):
+            traces["prefill"].append(idx_pad.shape)
+            m = nnx.merge(graphdef, state)
+            L, _, _, Hkv, D = pool.k.shape
+            tmp = init_cache(n_layer=L, batch=1, max_t=idx_pad.shape[1],
+                             n_kv_head=Hkv, head_dim=D, dtype=pool.k.dtype)
+            logits, tmp = _forward_cached(m, idx_pad, tmp, 0,
+                                          last_index=last_index)
+            upd = jax.lax.dynamic_update_slice
+            return SlotPool(
+                k=upd(pool.k, tmp.k, (0, slot, 0, 0, 0)),
+                v=upd(pool.v, tmp.v, (0, slot, 0, 0, 0)),
+                logits=upd(pool.logits, logits, (slot, 0)),
+                rng=upd(pool.rng, key_data[None], (slot, 0)),
+                pos=upd(pool.pos, (last_index + 1)[None].astype(jnp.int32),
+                        (slot,)),
+                temperature=upd(pool.temperature, temp[None], (slot,)),
+                top_k=upd(pool.top_k, top_k[None], (slot,)),
+            )
+
+        # ONE step variant on purpose: slots with top_k=None carry k=V,
+        # whose mask is an exact no-op but still pays the per-row sort.
+        # A static no-top-k variant would skip the sort for all-None
+        # batches at the price of a SECOND decode-step compile — and the
+        # engine's compile budget (buckets + 1 decode step, asserted)
+        # is the contract we keep; top-k is the common serving case.
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _step(state, pool, active):
+            traces["step"].append(True)
+            m = nnx.merge(graphdef, state)
+            keys = jax.random.wrap_key_data(pool.rng)
+            keys, toks = _sample_rows(keys, pool.logits, pool.temperature,
+                                      pool.top_k)
+            logits, cache = _forward_cached(m, toks[:, None],
+                                            KVCache(pool.k, pool.v),
+                                            pool.pos)
+            pos = jnp.where(active, pool.pos + 1, pool.pos)
+            return toks, SlotPool(
+                k=cache.k, v=cache.v, logits=logits,
+                rng=jax.random.key_data(keys), pos=pos,
+                temperature=pool.temperature, top_k=pool.top_k,
+            )
+
+        self._admit, self._step_fn = _admit, _step
+
+    # ---- API ----
+
+    def refresh_state(self):
+        """Re-snapshot the model's parameters (after in-place weight
+        mutation, e.g. loading a new checkpoint into the same module)."""
+        self._state = nnx.split(self.model)[1]
+
+    def submit(self, prompt, *, max_new_tokens, temperature=1.0,
+               top_k=None, stop_tokens=(), rng=None):
+        """Enqueue a request; returns its id. `rng` defaults to
+        fold_in(engine seed, id) — pass an explicit key to reproduce a
+        one-shot `generate_cached` run."""
+        prompt = tuple(int(t) for t in prompt)
+        assert prompt, "empty prompt"
+        assert max_new_tokens >= 1
+        if len(prompt) + max_new_tokens > self.T_max:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
+                f"engine max_seq_len {self.T_max}"
+            )
+        rid = self._next_id
+        self._next_id += 1
+        if rng is None:
+            rng = jax.random.fold_in(self._base_rng, rid)
+        req = Request(
+            req_id=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature), top_k=top_k,
+            stop_tokens=_normalize_stop(stop_tokens) or (), rng=rng,
+            submit_t=time.perf_counter(),
+        )
+        self.sched.enqueue(req)
+        self._reg.gauge("queue_depth").set(self.sched.queue_depth)
+        return rid
+
+    def step(self):
+        """One scheduler iteration: admit, one batched decode dispatch,
+        harvest. Returns the requests that finished this iteration."""
+        state = self._state
+        V = self.pool.logits.shape[-1]
+        for req, slot in self.sched.take_admissions():
+            t0 = len(req.prompt)
+            t_pad = self.sched.bucket(t0)
+            idx = np.zeros((1, t_pad), np.int32)
+            idx[0, :t0] = req.prompt
+            k_eff = V if req.top_k is None else max(1, min(int(req.top_k), V))
+            with span("serve_prefill", registry=self._reg):
+                self.pool = self._admit(
+                    state, self.pool, jnp.asarray(idx), jnp.int32(slot),
+                    jnp.int32(t0 - 1), jax.random.key_data(req.rng),
+                    jnp.float32(req.temperature), jnp.int32(k_eff),
+                )
+            self._live[slot] = _Live(req)
+
+        finished = []
+        if self._live:
+            active = np.zeros((self.n_slots,), bool)
+            active[list(self._live)] = True
+            with span("serve_decode", registry=self._reg):
+                toks, self.pool = self._step_fn(state, self.pool,
+                                                jnp.asarray(active))
+                toks = np.asarray(toks)  # the per-iteration D2H fence
+            now = time.perf_counter()
+            self._reg.counter("tokens_out").add(len(self._live))
+            for slot in sorted(self._live):
+                live = self._live[slot]
+                tok = int(toks[slot])
+                live.emitted.append(tok)
+                if live.t_first is None:
+                    live.t_first = now
+                    self._reg.hist("ttft_ms").observe(
+                        (now - live.req.submit_t) * 1e3)
+                live.t_last = now
+                if self.detokenize is not None:
+                    live.text += self.detokenize([tok])
+                hit_stop = tok in live.req.stop_tokens
+                if hit_stop or len(live.emitted) >= live.req.max_new_tokens:
+                    finished.append(self._finish(
+                        slot, live, "stop" if hit_stop else "length"))
+        self._reg.gauge("queue_depth").set(self.sched.queue_depth)
+        self._reg.gauge("slot_occupancy").set(len(self._live) / self.n_slots)
+        assert len(self.traces["prefill"]) <= len(self.sched.ladder), (
+            "prefill compiles escaped the bucket ladder"
+        )
+        assert len(self.traces["step"]) <= 1, (
+            "the decode step retraced — a slot-pool shape leaked"
+        )
+        return finished
+
+    def drain(self):
+        """Run steps until queue and slots are empty; returns every
+        request finished along the way."""
+        bound = 2 + sum(
+            r.max_new_tokens
+            for r in ([lv.req for lv in self._live.values()]
+                      + list(self.sched._queue))
+        ) + self.sched.queue_depth  # admission-wait iterations
+        out = []
+        steps = 0
+        while self.sched.queue_depth or self._live:
+            out.extend(self.step())
+            steps += 1
+            if steps > bound:
+                raise RuntimeError(
+                    f"engine failed to drain within {bound} iterations")
+        return out
+
+    # ---- internals ----
+
+    def _finish(self, slot, live, reason):
+        req = live.req
+        del self._live[slot]
+        self.sched.release(slot)
+        n_out = len(live.emitted)
+        ttft_ms = (live.t_first - req.submit_t) * 1e3
+        tpot_ms = ((live.t_last - live.t_first) / (n_out - 1) * 1e3
+                   if n_out > 1 else 0.0)
+        self._reg.counter("serve_requests").add(1)
+        if n_out > 1:  # tpot is undefined for single-token requests
+            self._reg.hist("tpot_ms").observe(tpot_ms)
+        rec = FinishedRequest(
+            req_id=req.req_id, tokens=list(req.prompt) + live.emitted,
+            n_prompt=len(req.prompt), n_out=n_out, finish_reason=reason,
+            text=live.text if self.detokenize is not None else None,
+            ttft_ms=ttft_ms, tpot_ms=tpot_ms,
+        )
+        record = {
+            "kind": "request", "t": time.time(), "id": req.req_id,
+            "n_prompt": rec.n_prompt, "n_out": n_out,
+            "finish_reason": reason, "ttft_ms": ttft_ms,
+        }
+        if n_out > 1:  # omitted (not 0.0) so report percentiles stay honest
+            record["tpot_ms"] = tpot_ms
+        self.sink.write(record)
+        return rec

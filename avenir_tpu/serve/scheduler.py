@@ -1,0 +1,99 @@
+"""FCFS admission, prompt-length bucketing, slot recycling (ISSUE 2
+tentpole, part 2).
+
+Orca-style iteration-level scheduling: the unit of work is one batched
+decode iteration, not one request. Between iterations the engine asks
+the scheduler for admissions (free slot x queued request, FCFS order)
+and returns slots the moment their occupant hits a stop token or its
+length budget — a finished sequence never pins the batch to its own
+tail the way static batching does.
+
+Buckets come from `infer.decode.prompt_bucket` (the SAME rounding the
+one-shot path uses, which is what makes engine prefill bit-identical to
+one-shot prefill even for MoE models, where expert capacity depends on
+the token count). The possible bucket set is `bucket_ladder(max seq
+len)` — O(log T_max) values — and `seen_buckets` is asserted to stay
+inside it, which bounds the number of prefill compiles for the whole
+lifetime of the engine.
+"""
+
+import dataclasses
+from collections import deque
+from typing import Optional, Tuple
+
+from avenir_tpu.infer.decode import bucket_ladder, prompt_bucket
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One generation request. `rng` is a jax PRNG key — the SAME key
+    passed to a one-shot `generate_cached(model, rng, prompt[None], ...)`
+    reproduces this request's tokens bit-for-bit (the engine's parity
+    contract)."""
+
+    req_id: int
+    prompt: Tuple[int, ...]
+    max_new_tokens: int
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    stop_tokens: Tuple[int, ...] = ()
+    rng: object = None
+    submit_t: float = 0.0
+
+
+class FCFSScheduler:
+    """First-come-first-served queue + free-slot pool. Pure host state:
+    nothing here touches the device, so admission decisions and slot
+    recycling cost no dispatches and no compiles."""
+
+    def __init__(self, n_slots, max_seq_len):
+        assert n_slots >= 1
+        self.n_slots = n_slots
+        self.max_seq_len = max_seq_len
+        self.ladder = bucket_ladder(max_seq_len)
+        self.seen_buckets = set()
+        self._queue = deque()
+        # lowest-index-first keeps slot assignment deterministic for a
+        # given arrival schedule (the parity tests replay schedules)
+        self._free = sorted(range(n_slots))
+        self.n_recycled = 0
+
+    # -- queue --
+
+    def enqueue(self, req: Request):
+        self._queue.append(req)
+
+    @property
+    def queue_depth(self):
+        return len(self._queue)
+
+    @property
+    def free_slots(self):
+        return len(self._free)
+
+    # -- admission / recycling --
+
+    def take_admissions(self):
+        """Pop (request, slot) pairs while both a queued request and a
+        free slot exist. FCFS: no reordering, no lookahead — a too-long
+        request blocks the queue rather than being skipped (documented
+        policy; admission fairness over utilization)."""
+        out = []
+        while self._queue and self._free:
+            out.append((self._queue.popleft(), self._free.pop(0)))
+        return out
+
+    def release(self, slot):
+        assert 0 <= slot < self.n_slots and slot not in self._free
+        self._free.append(slot)
+        self._free.sort()
+        self.n_recycled += 1
+
+    def bucket(self, prompt_len):
+        """Pad target for a prompt, recorded against the ladder bound."""
+        b = prompt_bucket(prompt_len, self.max_seq_len)
+        self.seen_buckets.add(b)
+        assert self.seen_buckets <= set(self.ladder), (
+            f"bucket {b} escaped the ladder {self.ladder}"
+        )
+        return b

@@ -1,0 +1,57 @@
+"""Fixed-shape KV slot pool (ISSUE 2 tentpole, part 1).
+
+The static-shape analogue of vLLM's paged KV blocks, shaped for TPU jit:
+ONE pool of `n_slots` sequence slots, each a full-width KV column plus
+the per-slot decode state (last logits, raw rng key data, position,
+sampling params). The whole pool is a NamedTuple pytree donated through
+the engine's two jitted entry points (admission-prefill and the batched
+decode step), so requests swapping in and out of slots NEVER change a
+shape and NEVER retrace — occupancy is a (B,) mask the host passes as a
+traced argument, not part of any compiled shape.
+
+Slot hygiene invariant (why recycling needs no cache scrub): a cache
+row at position p is only attendable once a query's position reaches p,
+and every code path writes position p (prefill for p < prompt_len, the
+decode step at p == pos) before any query attends that far — so stale
+K/V from a previous occupant is always masked (exactly-zero softmax
+weight) until the moment it is overwritten.
+
+RNG is stored as raw uint32 key data (`jax.random.key_data` layout) and
+wrapped back into typed keys inside the step: raw data indexes/donates
+like any other array, with bit-exact round-tripping.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SlotPool(NamedTuple):
+    k: jax.Array            # (L, B_slots, T_max, H_kv, D)
+    v: jax.Array            # (L, B_slots, T_max, H_kv, D)
+    logits: jax.Array       # (B_slots, V) fp32 — last-position logits
+    rng: jax.Array          # (B_slots, key_words) uint32 raw key data
+    pos: jax.Array          # (B_slots,) int32 — next cache write position
+    temperature: jax.Array  # (B_slots,) f32
+    top_k: jax.Array        # (B_slots,) int32; V means "no top-k"
+
+
+def key_data_width():
+    """Words per raw key under the process default PRNG impl (2 for
+    threefry2x32)."""
+    return jax.random.key_data(jax.random.key(0)).shape[-1]
+
+
+def init_slot_pool(*, n_layer, n_slots, max_t, n_kv_head, head_dim,
+                   vocab_size, dtype):
+    kv_shape = (n_layer, n_slots, max_t, n_kv_head, head_dim)
+    return SlotPool(
+        k=jnp.zeros(kv_shape, dtype),
+        v=jnp.zeros(kv_shape, dtype),
+        logits=jnp.zeros((n_slots, vocab_size), jnp.float32),
+        rng=jnp.zeros((n_slots, key_data_width()), jnp.uint32),
+        pos=jnp.zeros((n_slots,), jnp.int32),
+        temperature=jnp.ones((n_slots,), jnp.float32),
+        top_k=jnp.full((n_slots,), vocab_size, jnp.int32),
+    )

@@ -32,13 +32,26 @@ def _assert_parity(model, prompt_len=5, new_tokens=10, top_k=8):
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
 
 
-def test_gpt_decode_matches_generate():
-    _assert_parity(GPT(GPT_TINY, rngs=nnx.Rngs(0)))
+# module-scoped GPT instances: the stop-token tests reuse the parity
+# tests' (B, prompt, new, sampling) shapes, so their reference calls are
+# compile-cache hits instead of fresh trace+compiles (tier-1 budget)
+@pytest.fixture(scope="module")
+def gpt_model():
+    return GPT(GPT_TINY, rngs=nnx.Rngs(0))
 
 
-def test_gpt_scan_decode_matches_generate():
+@pytest.fixture(scope="module")
+def gpt_scan_model():
     cfg = dataclasses.replace(GPT_TINY, scan_layers=True)
-    _assert_parity(GPT(cfg, rngs=nnx.Rngs(0)))
+    return GPT(cfg, rngs=nnx.Rngs(0))
+
+
+def test_gpt_decode_matches_generate(gpt_model):
+    _assert_parity(gpt_model)
+
+
+def test_gpt_scan_decode_matches_generate(gpt_scan_model):
+    _assert_parity(gpt_scan_model)
 
 
 def test_llama_gqa_decode_matches_generate():
@@ -51,9 +64,9 @@ def test_mixtral_decode_matches_generate():
     _assert_parity(Mixtral(cfg, rngs=nnx.Rngs(0)))
 
 
-def test_decode_single_compile_across_positions():
+def test_decode_single_compile_across_positions(gpt_model):
     """The per-token step must not retrace per position (pos is traced)."""
-    model = GPT(GPT_TINY, rngs=nnx.Rngs(0))
+    model = gpt_model
     idx = jnp.zeros((1, 4), jnp.int32)
     with jax.log_compiles(False):
         pass  # smoke only; real check below via cache size
@@ -66,8 +79,101 @@ def test_decode_single_compile_across_positions():
     assert out.shape == (1, 12)
 
 
-def test_decode_rejects_overlong():
-    model = GPT(GPT_TINY, rngs=nnx.Rngs(0))
+def test_decode_rejects_overlong(gpt_model):
     idx = jnp.zeros((1, 30), jnp.int32)
     with pytest.raises(AssertionError):
-        generate_cached(model, jax.random.key(0), idx, 10)
+        generate_cached(gpt_model, jax.random.key(0), idx, 10)
+
+
+# ---- ISSUE 2 satellites: stop tokens, prompt bucketing, batched rng ----
+
+
+def _prompt(rng, shape):
+    return jnp.asarray(rng.integers(0, 64, shape).astype(np.int32))
+
+
+def test_stop_tokens_match_no_stop_prefix(gpt_model):
+    """With a stop token, the emitted prefix (through the first stop) is
+    unchanged vs no-stop decoding; everything after is pad. Shapes match
+    _assert_parity's so the no-stop reference is a compile-cache hit."""
+    from avenir_tpu.infer.decode import first_stop_index
+
+    idx = _prompt(np.random.default_rng(0), (2, 5))
+    key = jax.random.key(3)
+    ref = np.asarray(generate_cached(gpt_model, key, idx, 10,
+                                     temperature=0.9, top_k=8))
+    # pick a stop token that actually fires mid-stream for row 0
+    stop = int(ref[0, 5 + 3])
+    got = np.asarray(generate_cached(gpt_model, key, idx, 10,
+                                     temperature=0.9, top_k=8,
+                                     stop_tokens=(stop,)))
+    for r in range(2):
+        n = first_stop_index(ref[r, 5:], (stop,))
+        np.testing.assert_array_equal(ref[r, :5 + n], got[r, :5 + n])
+        assert (got[r, 5 + n:] == stop).all()  # pad defaults to stop id
+
+
+def test_stop_tokens_parity_vs_generate(gpt_model):
+    """Stop-path decode still matches the recompute-full-prefix path on
+    the emitted prefix (the satellite's parity requirement)."""
+    from avenir_tpu.infer.decode import first_stop_index
+
+    idx = _prompt(np.random.default_rng(0), (2, 5))
+    key = jax.random.key(3)
+    ref = np.asarray(gpt_model.generate(key, idx, 10, temperature=0.9,
+                                        top_k=8))
+    stop = int(ref[0, 5 + 2])
+    got = np.asarray(generate_cached(gpt_model, key, idx, 10,
+                                     temperature=0.9, top_k=8,
+                                     stop_tokens=stop))
+    n = first_stop_index(ref[0, 5:], (stop,))
+    np.testing.assert_array_equal(ref[0, :5 + n], got[0, :5 + n])
+
+
+def test_stop_on_scan_layout(gpt_scan_model):
+    idx = _prompt(np.random.default_rng(0), (2, 5))
+    key = jax.random.key(3)
+    ref = np.asarray(generate_cached(gpt_scan_model, key, idx, 10,
+                                     temperature=0.9, top_k=8))
+    stop = int(ref[0, 5])  # first emitted token of row 0: stops at once
+    got = np.asarray(generate_cached(gpt_scan_model, key, idx, 10,
+                                     temperature=0.9, top_k=8,
+                                     stop_tokens=[stop]))
+    assert got[0, 5] == stop and (got[0, 6:] == stop).all()
+
+
+def test_prompt_bucket_bounds_compiles():
+    """Nearby prompt lengths share one prefill + one decode compile
+    (pad-to-bucket); the trace ledger pins the count."""
+    from avenir_tpu.infer import decode
+
+    model = GPT(GPT_TINY, rngs=nnx.Rngs(0))
+    rng = np.random.default_rng(4)
+    n0 = decode.trace_count()
+    for t0 in (5, 6, 8):  # all bucket to 8; width buckets to 16
+        generate_cached(model, jax.random.key(t0), _prompt(rng, (1, t0)),
+                        8, top_k=8)
+    assert decode.trace_count() - n0 == 2, (
+        "expected exactly one prefill + one decode trace across prompt "
+        "lengths 5/6/8"
+    )
+
+
+def test_batched_rng_rows_match_sequential():
+    """A (N,) key vector decodes each row bit-identically to N separate
+    B=1 calls with those keys (sample.py's batched path), in 2 compiles."""
+    from avenir_tpu.infer import decode
+
+    model = GPT(GPT_TINY, rngs=nnx.Rngs(0))
+    prompt = _prompt(np.random.default_rng(5), (1, 5))
+    keys = [jax.random.key(100 + s) for s in range(3)]
+    seq = [np.asarray(generate_cached(model, k, prompt, 8, temperature=0.8,
+                                      top_k=8))[0] for k in keys]
+    kvec = jax.random.wrap_key_data(
+        jnp.stack([jax.random.key_data(k) for k in keys]))
+    n0 = decode.trace_count()
+    got = np.asarray(generate_cached(model, kvec, jnp.tile(prompt, (3, 1)),
+                                     8, temperature=0.8, top_k=8))
+    assert decode.trace_count() - n0 == 2, "batched call must be 2 traces"
+    for s in range(3):
+        np.testing.assert_array_equal(seq[s], got[s])

@@ -1,0 +1,138 @@
+"""Seeded-Poisson load generator for the serve engine (ISSUE 2).
+
+Drives avenir_tpu/serve.Engine with exponential interarrivals on the
+wall clock and reports TTFT / TPOT p50/p99 plus engine goodput. The
+request mix (prompt lengths, budgets, arrival times) is fully
+determined by --seed; by default the model is a tiny random-init GPT so
+the bench runs anywhere (pass --out_dir to serve a trained ckpt.pt).
+
+    python tools/serve_bench.py --n_requests=64 --rate=20 --n_slots=4 \
+        --max_new_tokens=32 --metrics_log=/tmp/serve/metrics.jsonl
+
+--metrics_log writes an obs JSONL (run_meta / request / run_end) that
+`python tools/obs_report.py <log>` summarizes.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from avenir_tpu.platform import honor_jax_platforms_env  # noqa: E402
+
+honor_jax_platforms_env()
+
+import numpy as np  # noqa: E402
+
+from avenir_tpu.obs.report import percentile  # noqa: E402
+
+
+def _pct(xs, q):
+    """percentile, rendered as nan on an empty list for the f-strings."""
+    p = percentile(xs, q)
+    return float("nan") if p is None else p
+
+
+def main():
+    args = {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
+            for a in sys.argv[1:]}
+    n_requests = int(args.get("n_requests", 32))
+    rate = float(args.get("rate", 16.0))  # mean arrivals per second
+    n_slots = int(args.get("n_slots", 4))
+    max_new = int(args.get("max_new_tokens", 32))
+    max_prompt = int(args.get("max_prompt", 48))
+    seed = int(args.get("seed", 0))
+    top_k = int(args.get("top_k", 50))
+    out_dir = args.get("out_dir")
+    metrics_log = args.get("metrics_log")
+
+    from flax import nnx
+
+    from avenir_tpu.obs import JsonlSink, NullSink, reset_registry
+    from avenir_tpu.serve import Engine
+
+    if out_dir:
+        from avenir_tpu.checkpoint.io import load_checkpoint
+        from avenir_tpu.sampling import model_from_checkpoint
+
+        model, family = model_from_checkpoint(load_checkpoint(out_dir))
+        print(f"serving {family} checkpoint from {out_dir}")
+    else:
+        from avenir_tpu.models.gpt import GPT, GPTConfig
+
+        model = GPT(GPTConfig(
+            block_size=int(args.get("block_size", 128)),
+            vocab_size=int(args.get("vocab_size", 256)),
+            n_layer=int(args.get("n_layer", 2)),
+            n_head=int(args.get("n_head", 2)),
+            n_embd=int(args.get("n_embd", 64)),
+            dropout=0.0, bias=True, attn_impl="xla",
+        ), rngs=nnx.Rngs(seed))
+        print("serving a random-init tiny GPT (pass --out_dir for a ckpt)")
+
+    cfg = model.config
+    assert max_prompt + max_new <= cfg.block_size, (
+        f"--max_prompt + --max_new_tokens must fit block_size "
+        f"({max_prompt}+{max_new} > {cfg.block_size})"
+    )
+
+    reg = reset_registry()
+    sink = NullSink()
+    if metrics_log:
+        os.makedirs(os.path.dirname(os.path.abspath(metrics_log)),
+                    exist_ok=True)
+        sink = JsonlSink(metrics_log)
+    engine = Engine(model, n_slots=n_slots, registry=reg, sink=sink,
+                    seed=seed)
+
+    load_rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(load_rng.exponential(1.0 / rate, n_requests))
+    prompts = [
+        [int(t) for t in load_rng.integers(0, cfg.vocab_size,
+                                           int(load_rng.integers(2, max_prompt + 1)))]
+        for _ in range(n_requests)
+    ]
+
+    sink.write({"kind": "run_meta", "t": time.time(), "model_type":
+                type(model).__name__.lower(), "n_slots": n_slots,
+                "rate": rate, "n_requests": n_requests, "seed": seed})
+    t0 = time.perf_counter()
+    submitted = 0
+    done = []
+    while len(done) < n_requests:
+        now = time.perf_counter() - t0
+        while submitted < n_requests and arrivals[submitted] <= now:
+            engine.submit(prompts[submitted], max_new_tokens=max_new,
+                          temperature=1.0, top_k=top_k)
+            submitted += 1
+        if engine.sched.queue_depth or engine._live:
+            done.extend(engine.step())
+        elif submitted < n_requests:
+            time.sleep(min(0.005, arrivals[submitted] - now))
+    wall = time.perf_counter() - t0
+    sink.write({"kind": "run_end", "t": time.time(),
+                "counters": reg.snapshot()["counters"]})
+    sink.close()
+
+    ttfts = [f.ttft_ms for f in done]
+    tpots = [f.tpot_ms for f in done if f.n_out > 1]
+    tokens_out = reg.snapshot()["counters"]["tokens_out"]
+    print(f"requests: {n_requests} at {rate:.1f} req/s (seed {seed}), "
+          f"{n_slots} slots, wall {wall:.2f}s")
+    print(f"ttft: p50 {_pct(ttfts, 0.50):.1f} ms  "
+          f"p99 {_pct(ttfts, 0.99):.1f} ms")
+    print(f"tpot: p50 {_pct(tpots, 0.50):.2f} ms  "
+          f"p99 {_pct(tpots, 0.99):.2f} ms")
+    print(f"goodput: {tokens_out / wall:,.1f} tok/s out "
+          f"({tokens_out:.0f} tokens), "
+          f"{len(done) / wall:.2f} req/s completed")
+    print(f"compiles: {len(engine.traces['prefill'])} prefill bucket(s) "
+          f"+ {len(engine.traces['step'])} decode step")
+    if metrics_log:
+        print(f"metrics: {metrics_log} "
+              f"(summarize: python tools/obs_report.py {metrics_log})")
+
+
+if __name__ == "__main__":
+    main()
