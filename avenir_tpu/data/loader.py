@@ -13,6 +13,7 @@ the np.memmap leak (train.py:145-147).
 """
 
 import os
+import threading
 import time
 
 import jax
@@ -58,6 +59,18 @@ class DataLoader:
         self.local_batch = batch_size // n_proc
         # disjoint per-process stream
         self.rng = np.random.default_rng(seed + 1000 * jax.process_index())
+        # background prefetch (ISSUE 3 satellite): after each window the
+        # loader stages the NEXT window's memmap crops on a daemon
+        # thread, so the fancy-indexing overlaps device compute instead
+        # of running on the dispatch edge. The buffer is FIFO and every
+        # _sample_local draw happens in consumption order (the thread is
+        # joined before any pop), so the rng stream a run CONSUMES is
+        # bit-identical to the unprefetched loader's — pinned by
+        # tests/test_loader.py::test_prefetch_preserves_stream_order.
+        self._buf = []  # staged (x, y) micro batches, oldest first
+        self._buf_split = None
+        self._prefetch_thread = None
+        self._prefetch_error = None
 
     def _sample_local(self, split):
         arr = np.memmap(
@@ -87,9 +100,86 @@ class DataLoader:
         self._reg.counter("data_batches").add(1)
         self._reg.counter("data_tokens").add(int(np.prod(x.shape)))
 
+    def _join_prefetch(self):
+        """Wait out an in-flight background stage (counting the blocked
+        time — a nonzero data_prefetch_wait_ms means the window finished
+        before the host did). After the join only the calling thread
+        touches the buffer/rng. A stage() failure re-raises HERE: the
+        thread has already advanced the rng for its partial draws, so
+        continuing would silently desync the bit-identical-stream
+        contract — fail loud instead."""
+        t = self._prefetch_thread
+        if t is None:
+            return
+        t0 = time.perf_counter()
+        was_running = t.is_alive()
+        t.join()
+        self._prefetch_thread = None
+        if was_running:
+            self._reg.counter("data_prefetch_wait_ms").add(
+                (time.perf_counter() - t0) * 1e3)
+        if self._prefetch_error is not None:
+            err, self._prefetch_error = self._prefetch_error, None
+            raise RuntimeError(
+                "background batch prefetch failed (rng draws for the "
+                "staged window are already consumed, so the stream "
+                "cannot be resumed consistently)"
+            ) from err
+
+    def _take(self, split, k, count_hit=True):
+        """Pop `k` staged batches (topping up synchronously on a miss) in
+        strict FIFO order. `split` must match what was staged — one
+        DataLoader serves one split once prefetch is engaged (the loop's
+        train/eval loaders are separate instances). `count_hit=False` for
+        non-window callers: data_prefetch_hit counts whole WINDOWS served
+        from the buffer (the METRIC_SCHEMA contract), not stray
+        single-batch drains."""
+        self._join_prefetch()
+        if self._buf:
+            assert self._buf_split == split, (
+                f"prefetch buffer holds {self._buf_split!r} batches but "
+                f"{split!r} was requested — a prefetching DataLoader "
+                "serves a single split (use a second loader)"
+            )
+        if count_hit and len(self._buf) >= k:
+            self._reg.counter("data_prefetch_hit").add(1)
+        while len(self._buf) < k:
+            self._buf.append(self._sample_local(split))
+        out, self._buf = self._buf[:k], self._buf[k:]
+        return out
+
+    def _spawn_prefetch(self, split, k):
+        """Stage the next `k` batches in the background (double buffer:
+        at most one window in flight). The thread's sampling time lands
+        in data_stage_ms (thread-safe counter) so the memmap cost stays
+        visible even though it no longer blocks the loop; its exceptions
+        are re-raised by the next _join_prefetch."""
+
+        def stage():
+            t0 = time.perf_counter()
+            try:
+                for _ in range(k):
+                    self._buf.append(self._sample_local(split))
+            except BaseException as e:  # surfaced at the next join
+                self._prefetch_error = e
+            finally:
+                self._reg.counter("data_stage_ms").add(
+                    (time.perf_counter() - t0) * 1e3)
+
+        self._buf_split = split
+        self._prefetch_error = None
+        self._prefetch_thread = threading.Thread(
+            target=stage, name="avenir-data-prefetch", daemon=True)
+        self._prefetch_thread.start()
+
     def get_batch(self, split):
         t0 = time.perf_counter()
-        x, y = self._sample_local(split)
+        if self._buf or self._prefetch_thread is not None:
+            # a windowed caller left staged batches behind: consume them
+            # in order so the stream stays bit-identical
+            x, y = self._take(split, 1, count_hit=False)[0]
+        else:
+            x, y = self._sample_local(split)
         if self.sharding is None:
             out = jax.numpy.asarray(x), jax.numpy.asarray(y)
             self._count(x, t0)
@@ -111,7 +201,10 @@ class DataLoader:
         calls yield the identical batch sequence."""
         assert not self.flat, "windowed batches are a train-path concept"
         t0 = time.perf_counter()
-        xs, ys = zip(*(self._sample_local(split) for _ in range(k)))
+        xs, ys = zip(*self._take(split, k))
+        # double-buffer: stage the NEXT window on a background thread
+        # while this one's device window runs
+        self._spawn_prefetch(split, k)
         x, y = np.stack(xs), np.stack(ys)
         if self.sharding is None:
             out = jax.numpy.asarray(x), jax.numpy.asarray(y)

@@ -100,11 +100,19 @@ def head_major_merge(y, kernel, bias):
 
 def cross_entropy_loss(logits, targets, ignore_index=-1):
     """Mean token cross-entropy in fp32, skipping `ignore_index` positions —
-    mirrors `F.cross_entropy(..., ignore_index=-1)` in model.py:190-192."""
-    logits = logits.astype(jnp.float32)
+    mirrors `F.cross_entropy(..., ignore_index=-1)` in model.py:190-192.
+
+    The row max is taken and subtracted in the INPUT dtype before the
+    fp32 upcast: shift-invariant (and exactly so through the VJP — the
+    max is stop_gradient'ed), bit-identical for fp32 inputs (optax
+    subtracts the max internally anyway; ours is then 0), and it halves
+    the fp32 footprint of the (B, T, V) intermediate for bf16 logits on
+    the path that remains the fused tail's oracle (ops/fused_ce.py)."""
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    z = (logits - m).astype(jnp.float32)
     valid = targets != ignore_index
     safe_targets = jnp.where(valid, targets, 0)
-    losses = optax.softmax_cross_entropy_with_integer_labels(logits, safe_targets)
+    losses = optax.softmax_cross_entropy_with_integer_labels(z, safe_targets)
     losses = jnp.where(valid, losses, 0.0)
     return losses.sum() / jnp.maximum(valid.sum(), 1).astype(jnp.float32)
 

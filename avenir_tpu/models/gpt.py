@@ -70,6 +70,14 @@ class GPTConfig:
     # scan) or 'remat' (reverse-tick stage-input stash — the 1F1B
     # activation-memory class; parallel/pipeline.py)
     pipeline_schedule: str = "gpipe"
+    # loss tail: 'reference' (full (B, T, V) logits + cross_entropy_loss),
+    # 'blocked' (chunked lax.scan tail), 'pallas' (fused TPU kernel), or
+    # 'auto' (pallas on TPU, blocked elsewhere) — ops/fused_ce.py. The
+    # fused impls never materialize the logits, so __call__ returns
+    # logits=None when they run with targets.
+    loss_impl: str = "reference"
+    # time-chunk of the blocked loss tail; 0 = default (128 rows)
+    loss_chunk: int = 0
 
 
 class CausalSelfAttention(nnx.Module):
@@ -264,8 +272,25 @@ class GPT(nnx.Module):
         x = self.ln_f(x).astype(self._cdtype)
 
         if targets is not None:
-            logits = self.wte.attend(x)  # tied weights (model.py:149-151)
-            loss = cross_entropy_loss(logits, targets, ignore_index=-1)
+            from avenir_tpu.ops.fused_ce import (
+                fused_cross_entropy,
+                resolve_loss_impl,
+            )
+
+            loss_impl = resolve_loss_impl(self.config.loss_impl)
+            if loss_impl == "reference":
+                logits = self.wte.attend(x)  # tied weights (model.py:149-151)
+                loss = cross_entropy_loss(logits, targets, ignore_index=-1)
+            else:
+                # fused chunked tail: the (B, T, V) logits never exist;
+                # w_layout='vc' consumes the tied embedding in place and
+                # its dw lands as the tied-wte gradient contribution
+                emb = self.wte.embedding.get_value().astype(self._cdtype)
+                loss = fused_cross_entropy(
+                    x, emb, targets, ignore_index=-1, impl=loss_impl,
+                    w_layout="vc", t_chunk=self.config.loss_chunk,
+                )
+                logits = None
         else:
             logits = self.wte.attend(x[:, -1:, :])
             loss = None

@@ -62,6 +62,11 @@ class LlamaConfig:
     # scan_layers; parallel/pipeline.py). 0 = auto (2x the pipe size).
     pipeline_microbatches: int = 0
     pipeline_schedule: str = "gpipe"  # see GPTConfig/parallel.pipeline
+    # loss tail: 'reference' | 'blocked' | 'pallas' | 'auto' — see
+    # GPTConfig.loss_impl / ops/fused_ce.py. Fused impls return
+    # logits=None when targets are given.
+    loss_impl: str = "reference"
+    loss_chunk: int = 0  # blocked-tail time chunk; 0 = default
 
     @classmethod
     def from_train_config(cls, cfg, model_args):
@@ -80,6 +85,8 @@ class LlamaConfig:
             scan_layers=cfg.get("scan_layers", False),
             pipeline_microbatches=cfg.get("pipeline_microbatches", 0),
             pipeline_schedule=cfg.get("pipeline_schedule", "gpipe"),
+            loss_impl=cfg.get("loss_impl", "") or "reference",
+            loss_chunk=cfg.get("loss_chunk", 0),
         )
 
 
@@ -244,8 +251,24 @@ class Llama(nnx.Module):
                 stats_sum = jax.tree.map(jnp.add, stats_sum, s)
         x = self.norm(x).astype(self._cdtype)
         if targets is not None:
-            logits = self.lm_head(x)
-            loss = cross_entropy_loss(logits, targets, ignore_index=-1)
+            from avenir_tpu.ops.fused_ce import (
+                fused_cross_entropy,
+                resolve_loss_impl,
+            )
+
+            loss_impl = resolve_loss_impl(self.config.loss_impl)
+            if loss_impl == "reference":
+                logits = self.lm_head(x)
+                loss = cross_entropy_loss(logits, targets, ignore_index=-1)
+            else:
+                # fused chunked tail (ops/fused_ce.py): w_layout='cv'
+                # consumes the untied lm_head kernel in place
+                w = self.lm_head.kernel.get_value().astype(self._cdtype)
+                loss = fused_cross_entropy(
+                    x, w, targets, ignore_index=-1, impl=loss_impl,
+                    w_layout="cv", t_chunk=self.config.loss_chunk,
+                )
+                logits = None
             coef = getattr(self.config, "router_aux_loss_coef", 0.0)
             if coef:
                 loss = loss + coef * self._router_aux_loss(stats_sum)

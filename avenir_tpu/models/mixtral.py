@@ -17,6 +17,14 @@ TPU-first dispatch (GShard/Mesh-TF shape, static everywhere):
     all-gather); tests assert the collective appears in HLO
   - routing follows HF Mixtral: full softmax over E, top-k, renormalize
     over the selected k (parity-tested vs MixtralForCausalLM)
+
+The fused loss tail (`loss_impl` in {'blocked','pallas','auto'}, see
+ops/fused_ce.py) rides in through the inherited Llama.__call__ and
+MixtralConfig.from_train_config's base-field copy: the router aux loss
+is added ON TOP of the fused CE exactly as on the reference path, and
+the chunked tail never sees the router stats (they live in the scan
+carry, not in the logits). Parity incl. the aux term is pinned by
+tests/test_fused_ce.py.
 """
 
 from dataclasses import dataclass

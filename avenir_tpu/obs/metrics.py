@@ -52,12 +52,22 @@ METRIC_SCHEMA = {
     # -- data loader --
     "data_stage_ms": (
         "counter", "ms",
-        "loader-side sampling + global-array assembly (subset of "
-        "host_batch_ms when called from the loop)"),
+        "loader-side sampling + global-array assembly, incl. the "
+        "background prefetch thread's sampling (recorded from its "
+        "thread — with prefetch engaged this counter can exceed the "
+        "loop-blocking host_batch_ms)"),
     "data_batches": (
         "counter", "1", "batch stacks staged by the loader"),
     "data_tokens": (
         "counter", "tok", "input tokens staged by the loader (x only)"),
+    "data_prefetch_hit": (
+        "counter", "1",
+        "batch windows served entirely from the loader's background-"
+        "staged buffer (the double-buffered prefetch path)"),
+    "data_prefetch_wait_ms": (
+        "counter", "ms",
+        "time the loop blocked joining an in-flight loader prefetch "
+        "thread (nonzero means device windows outpace host staging)"),
     # -- checkpoint io --
     "ckpt_saves": ("counter", "1", "checkpoint saves started"),
     "ckpt_save_ms": (

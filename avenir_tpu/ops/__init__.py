@@ -8,6 +8,7 @@ BASELINE.json:5 ("fused attention + AdamW hot path as Pallas kernels").
 """
 
 from avenir_tpu.ops.attention import causal_attention
+from avenir_tpu.ops.fused_ce import fused_cross_entropy, resolve_loss_impl
 from avenir_tpu.ops.rmsnorm import rmsnorm
 from avenir_tpu.ops.rope import apply_rope, rope_frequencies
 from avenir_tpu.ops.swiglu import swiglu

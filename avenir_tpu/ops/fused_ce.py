@@ -1,0 +1,199 @@
+"""Fused chunked lm-head + cross-entropy tail (ISSUE 3 tentpole).
+
+The loss tail is the last big HBM sink in the train step: the reference
+path materializes the full (B, T, V) logits — 3.3 GB of fp32 at the
+GPT-2 bench config (16x1024x50257x4B) — writes it, reads it back for the
+softmax, and saves it as the residual for the backward. But cross-entropy
+only ever needs per-ROW statistics of the logits (the logsumexp and the
+target logit), and the same online-softmax recurrence that powers the
+Pallas flash attention applies verbatim to the vocabulary axis
+(Liger-Kernel-style fused linear+CE, Hsu et al. 2024): stream the logits
+in chunks, carry (running max m, running normalizer l) per row, and the
+full logits array never exists in HBM in either pass.
+
+Two interchangeable implementations behind ONE entry point
+(`fused_cross_entropy`), selected by the models' `loss_impl` config knob
+(plumbed exactly like `attn_impl`):
+
+  - "blocked": pure XLA — `lax.scan` over T-chunks with `jax.checkpoint`
+    around the chunk body, so the backward recomputes each chunk's
+    logits instead of saving them (without the checkpoint the scan would
+    stack per-chunk logits residuals and quietly rebuild the full
+    (B, T, V) array). Works everywhere, composes with every mesh the
+    same way the reference path does (plain jnp ops: vocab stays
+    tensor-sharded inside each chunk and GSPMD inserts the psum over
+    'tensor' for the row reductions — chunk over time, psum over
+    tensor), and is the CPU-testable counterpart of the Pallas kernel.
+  - "pallas": the TPU kernel (ops/pallas/fused_ce.py) — grid over
+    (T-blocks, V-blocks), fp32 running max/normalizer in VMEM scratch,
+    bf16 MXU matmuls, custom VJP emitting dx and the (tied) projection
+    weight's gradient one block at a time.
+
+  - "reference" resolves to the models' original
+    full-logits + models/common.cross_entropy_loss path (the oracle).
+
+Weight layout: `w_layout="cv"` takes the projection as (C, V) — the
+Llama/Mixtral `lm_head.kernel` orientation; `w_layout="vc"` takes
+(V, C) — the GPT tied `wte.embedding`. Both are consumed through
+dot_general contraction dims, so neither family pays a transposed copy
+of the (V, C)-sized weight, and the "vc" gradient lands directly in the
+embedding's own layout (the tied-wte gradient contribution).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+# Default time-chunk: (B, t_chunk, V) fp32 is the largest live logits
+# slab — 128 rows x 50304 vocab x 16 batch ~= 412 MB at the bench rung,
+# an 8x cut vs the full tail, while each chunk's matmul still feeds the
+# MXU (B*t_chunk) rows at a time.
+_DEFAULT_T_CHUNK = 128
+
+# One entry per TRACE of the fused tail (appends happen at trace time
+# only, so len() counts retraces without touching jit internals) — the
+# same ledger idiom as infer/decode. Tests pin that the chunked scan
+# traces once per compiled train step, not once per step.
+_trace_events = []
+
+
+def trace_count():
+    """Number of fused-loss-tail traces (== appearances in XLA compiles)."""
+    return len(_trace_events)
+
+
+def _tp_mesh_active():
+    """True when the ambient mesh has a tensor axis > 1 — there the
+    blocked tail keeps the vocab sharded while the pallas wrap would
+    all-gather the full projection weight over 'tensor' every step
+    (docs/PERFORMANCE.md "The loss tail")."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return False
+    from avenir_tpu.parallel.partition import TP_AXIS
+
+    return dict(mesh.shape).get(TP_AXIS, 1) > 1
+
+
+def resolve_loss_impl(impl):
+    """Resolve the config knob to the concrete impl that will run —
+    mirrors ops.attention.resolve_attention_impl so the training loop's
+    startup log can print the truth (a silent fallback must be visible).
+
+    '' / None / 'reference' -> 'reference'; 'auto' -> 'pallas' on TPU
+    when the kernel imports AND the mesh has no tensor axis > 1 (the
+    pallas wrap replicates the weight over 'tensor' — on TP meshes
+    'auto' picks 'blocked', which keeps the vocab sharded), else
+    'blocked'. An explicit 'pallas' is honored anywhere (tests force it
+    through interpret mode; a TP operator who accepts the all-gather
+    can too)."""
+    if impl in (None, "", "reference"):
+        return "reference"
+    if impl == "auto":
+        from avenir_tpu.ops.attention import _on_tpu
+
+        if _on_tpu() and not _tp_mesh_active():
+            try:
+                from avenir_tpu.ops.pallas import fused_ce  # noqa: F401
+
+                return "pallas"
+            except ImportError:
+                return "blocked"
+        return "blocked"
+    assert impl in ("blocked", "pallas"), (
+        f"unknown loss_impl {impl!r}; one of "
+        "['reference', 'blocked', 'pallas', 'auto']"
+    )
+    return impl
+
+
+def _logits_chunk(xc, w, w_layout):
+    """(B, tc, C) @ w -> (B, tc, V) with fp32 MXU accumulation. The
+    contraction dims consume either weight orientation in place — no
+    transposed (V, C)-sized copy for either family."""
+    eq = "btc,cv->btv" if w_layout == "cv" else "btc,vc->btv"
+    return jnp.einsum(eq, xc, w, preferred_element_type=jnp.float32)
+
+
+def _chunk_loss_terms(xc, w, yc, *, ignore_index, w_layout):
+    """One chunk's (loss_sum, valid_count). Max-subtraction before the
+    exp (shift-invariant, so stop_gradient keeps the VJP exact); invalid
+    rows (ignore_index) contribute 0 to both terms."""
+    z = _logits_chunk(xc, w, w_layout)
+    m = jax.lax.stop_gradient(jnp.max(z, axis=-1, keepdims=True))
+    z = z - m
+    lse = jnp.log(jnp.sum(jnp.exp(z), axis=-1))
+    valid = yc != ignore_index
+    safe = jnp.where(valid, yc, 0)
+    tgt = jnp.take_along_axis(z, safe[..., None], axis=-1)[..., 0]
+    losses = jnp.where(valid, lse - tgt, 0.0)
+    return losses.sum(), valid.sum()
+
+
+def _blocked_ce(x, w, targets, *, ignore_index, w_layout, t_chunk):
+    """lax.scan over T-chunks; jax.checkpoint on the chunk body so the
+    backward recomputes each chunk's logits (the scan would otherwise
+    stack them into the full (B, T, V) as residuals). dx is scattered
+    back chunk-by-chunk through the dynamic_slice transpose; dw
+    accumulates across scan steps — neither pass holds more than one
+    (B, t_chunk, V) slab."""
+    B, T, C = x.shape
+    tc = min(t_chunk or _DEFAULT_T_CHUNK, T)
+    nc = -(-T // tc)
+    Tp = nc * tc
+    if Tp != T:
+        # non-divisible edge: pad with ignore_index rows (zero loss/grad)
+        x = jnp.pad(x, ((0, 0), (0, Tp - T), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, Tp - T)),
+                          constant_values=ignore_index)
+
+    chunk = jax.checkpoint(
+        lambda xc, yc: _chunk_loss_terms(
+            xc, w, yc, ignore_index=ignore_index, w_layout=w_layout)
+    )
+
+    def body(carry, i):
+        ls, nv = carry
+        xc = jax.lax.dynamic_slice_in_dim(x, i * tc, tc, axis=1)
+        yc = jax.lax.dynamic_slice_in_dim(targets, i * tc, tc, axis=1)
+        l, v = chunk(xc, yc)
+        return (ls + l, nv + v), None
+
+    (ls, nv), _ = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.int32(0)), jnp.arange(nc)
+    )
+    return ls / jnp.maximum(nv, 1).astype(jnp.float32)
+
+
+def fused_cross_entropy(x, w, targets, *, ignore_index=-1, impl="blocked",
+                        w_layout="cv", t_chunk=0):
+    """Mean token cross-entropy of `x @ w` over non-ignored targets,
+    without materializing the (B, T, V) logits.
+
+      x: (B, T, C) final hidden states (compute dtype)
+      w: lm-head projection — (C, V) for w_layout='cv' (Llama lm_head
+         kernel), (V, C) for 'vc' (GPT tied wte embedding)
+      targets: (B, T) int token ids; `ignore_index` rows are skipped
+
+    Semantics match models/common.cross_entropy_loss(x @ w, targets)
+    within fp32 tolerance (the fused paths accumulate logits in fp32
+    where the reference round-trips them through the compute dtype).
+    `impl` must already be resolved ('blocked' | 'pallas' | 'auto');
+    'reference' is the callers' own full-logits branch, not ours."""
+    impl = resolve_loss_impl(impl)
+    assert impl in ("blocked", "pallas"), (
+        "fused_cross_entropy handles the fused impls; the 'reference' "
+        "path is the caller's full-logits branch"
+    )
+    assert w_layout in ("cv", "vc"), f"unknown w_layout {w_layout!r}"
+    _trace_events.append((impl, x.shape, w.shape))
+    if impl == "pallas":
+        from avenir_tpu.ops.attention import _on_tpu
+        from avenir_tpu.ops.pallas.fused_ce import fused_ce_pallas
+
+        return fused_ce_pallas(
+            x, w, targets, ignore_index=ignore_index, w_layout=w_layout,
+            interpret=not _on_tpu(),
+        )
+    return _blocked_ce(x, w, targets, ignore_index=ignore_index,
+                       w_layout=w_layout, t_chunk=t_chunk)

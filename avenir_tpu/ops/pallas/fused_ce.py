@@ -1,0 +1,421 @@
+"""Fused lm-head + cross-entropy Pallas kernels (ISSUE 3 tentpole).
+
+The vocabulary-axis analogue of the flash-attention recurrence, and it
+follows that file's design conventions:
+
+  - grid (T-blocks, V-blocks) with the vocab index innermost
+    ("arbitrary"), so each (C, block_v) weight stripe arrives as its own
+    BlockSpec slice and Mosaic double-buffers the HBM->VMEM DMAs
+  - online logsumexp carried in fp32 VMEM scratch across the vocab grid
+    steps (running max m, normalizer l, plus the target-column logit t —
+    each row's target lands in exactly one vocab block)
+  - MXU matmuls take the input dtype (bf16 on TPU) with
+    preferred_element_type=fp32
+  - `ignore_index` rows are masked IN-KERNEL: they contribute zero loss
+    and zero gradient, so padded rows ride the same mechanism
+  - backward = two kernels, both recomputing the score block from
+    (x, w, lse) like the blocked flash backward: dx gridded
+    (T-blocks, V-blocks) accumulating ds @ w^T in a (block_t, C) fp32
+    scratch, dw gridded (V-blocks, T-blocks) accumulating x^T @ ds in a
+    (C, block_v) scratch — the (N, V) probability matrix never exists
+    in HBM in either pass.
+
+Weight layouts: 'cv' (C, V) — Llama/Mixtral lm_head.kernel; 'vc'
+(V, C) — the GPT tied wte embedding. Both are consumed via dot_general
+contraction dims (no transposed copy), and dw is emitted in the same
+layout, so the tied-embedding gradient lands directly.
+
+Under SPMD the public entry wraps the kernels in jax.shard_map over the
+free batch-like mesh axes (rows sharded, weight replicated, dw psum'd
+over the batch axes inside the HAND-WRITTEN backward — the custom_vjp
+sits OUTSIDE the shard_maps, so jax never transposes them and the
+replicated-cotangent hazard documented in partition.free_axis_names
+cannot arise). The weight is all-gathered over 'tensor' inside the wrap;
+on tensor-parallel meshes prefer loss_impl='blocked', which keeps the
+vocab sharded (docs/PERFORMANCE.md "The loss tail").
+"""
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from avenir_tpu.ops.pallas.flash_attention import (
+    _LANES,
+    _compiler_params,
+    NEG_INF,
+)
+
+# Default (block_t, block_v); AVENIR_CE_BLOCKS="bt,bv" overrides for
+# sweeps (tools/loss_tail_bench.py). 256x512 keeps the dw scratch
+# (C, block_v) fp32 at 1.5MB for GPT-2 and 8MB for Llama-3 C=4096 —
+# comfortably under the 64MB scoped-VMEM limit with double buffering.
+_ENV_CE_BLOCKS = os.environ.get("AVENIR_CE_BLOCKS") or None
+_DEFAULT_CE_BLOCKS = tuple(
+    int(s) for s in (_ENV_CE_BLOCKS or "256,512").split(",")
+)
+assert len(_DEFAULT_CE_BLOCKS) == 2, (
+    f"AVENIR_CE_BLOCKS must be 'block_t,block_v', got {_ENV_CE_BLOCKS!r}"
+)
+
+
+def _dot(a, b, contract, preferred=jnp.float32):
+    return jax.lax.dot_general(
+        a, b, (contract, ((), ())), preferred_element_type=preferred
+    )
+
+
+def _scores(x, w, j, block_v, vocab, w_layout):
+    """One (block_t, block_v) logits block in fp32, padded vocab columns
+    masked to NEG_INF (finite, like the attention kernels' padding)."""
+    if w_layout == "cv":
+        s = _dot(x, w, (((1,), (0,))))  # (bt, C) @ (C, bv)
+    else:
+        s = _dot(x, w, (((1,), (1,))))  # (bt, C) @ (bv, C)^T
+    bt, bv = s.shape
+    col = j * block_v + jax.lax.broadcasted_iota(jnp.int32, (bt, bv), 1)
+    return jnp.where(col < vocab, s, NEG_INF), col
+
+
+def _fwd_kernel(x_ref, w_ref, y_ref, rows_ref, lse_ref, m_ref, l_ref, t_ref,
+                *, block_v, vocab, ignore_index, w_layout):
+    j = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        t_ref[...] = jnp.zeros_like(t_ref)
+
+    s, col = _scores(x_ref[...], w_ref[...], j, block_v, vocab, w_layout)
+    y = y_ref[...]  # (bt, 1) int32
+    m_prev = m_ref[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_ref[:, :1] * alpha + jnp.sum(jnp.exp(s - m_new), axis=-1,
+                                           keepdims=True)
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+    # target-column logit: exactly one hit across the vocab sweep
+    # (ignore_index rows never hit — col is always >= 0)
+    tgt = jnp.sum(jnp.where(col == y, s, 0.0), axis=-1, keepdims=True)
+    t_ref[...] = t_ref[...] + jnp.broadcast_to(tgt, t_ref.shape)
+
+    @pl.when(j == nv - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        lse = m_ref[:, :1] + jnp.log(l)
+        valid = y != ignore_index
+        rows_ref[...] = jnp.where(valid, lse - t_ref[:, :1], 0.0)
+        lse_ref[...] = lse
+
+
+def _ds_block(x, w, y, lse, g, j, *, block_v, vocab, ignore_index, w_layout):
+    """d loss_sum / d scores for one block: g * valid * (softmax - onehot),
+    recomputed from (x, w, lse) exactly like the flash backward rebuilds
+    p from its saved logsumexp. Masked vocab columns give p = 0."""
+    s, col = _scores(x, w, j, block_v, vocab, w_layout)
+    p = jnp.exp(s - lse)  # (bt, bv); lse (bt, 1)
+    onehot = (col == y).astype(jnp.float32)
+    valid = (y != ignore_index).astype(jnp.float32)  # (bt, 1)
+    return (p - onehot) * (g * valid)
+
+
+def _dx_kernel(x_ref, w_ref, y_ref, lse_ref, g_ref, dx_ref, dx_acc, *,
+               block_v, vocab, ignore_index, w_layout):
+    j = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        dx_acc[...] = jnp.zeros_like(dx_acc)
+
+    w = w_ref[...]
+    ds = _ds_block(x_ref[...], w, y_ref[...], lse_ref[...], g_ref[0, 0], j,
+                   block_v=block_v, vocab=vocab, ignore_index=ignore_index,
+                   w_layout=w_layout)
+    if w_layout == "cv":  # (bt, bv) @ (C, bv)^T -> (bt, C)
+        dx_acc[...] += _dot(ds.astype(w.dtype), w, (((1,), (1,))))
+    else:  # (bt, bv) @ (bv, C) -> (bt, C)
+        dx_acc[...] += _dot(ds.astype(w.dtype), w, (((1,), (0,))))
+
+    @pl.when(j == nv - 1)
+    def _flush():
+        dx_ref[...] = dx_acc[...].astype(dx_ref.dtype)
+
+
+def _dw_kernel(x_ref, w_ref, y_ref, lse_ref, g_ref, dw_ref, dw_acc, *,
+               block_v, vocab, ignore_index, w_layout):
+    # grid (nv, nt): the row index is innermost so one (C, block_v)
+    # stripe of dw accumulates over every row block before ONE flush
+    j, i = pl.program_id(0), pl.program_id(1)
+    nt = pl.num_programs(1)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_acc[...] = jnp.zeros_like(dw_acc)
+
+    x = x_ref[...]
+    ds = _ds_block(x, w_ref[...], y_ref[...], lse_ref[...], g_ref[0, 0], j,
+                   block_v=block_v, vocab=vocab, ignore_index=ignore_index,
+                   w_layout=w_layout)
+    if w_layout == "cv":  # (bt, C)^T @ (bt, bv) -> (C, bv)
+        dw_acc[...] += _dot(x, ds.astype(x.dtype), (((0,), (0,))))
+    else:  # (bt, bv)^T @ (bt, C) -> (bv, C)
+        dw_acc[...] += _dot(ds.astype(x.dtype), x, (((0,), (0,))))
+
+    @pl.when(i == nt - 1)
+    def _flush():
+        dw_ref[...] = dw_acc[...].astype(dw_ref.dtype)
+
+
+def _pow2_ceil(n):
+    return 1 << max(n - 1, 1).bit_length()
+
+
+def pick_ce_blocks(n_rows, vocab, block_t=None, block_v=None):
+    """(block_t, block_v) for these shapes. block_v prefers a divisor of
+    the vocab (50304 and 128256 both take 384) so the weight is consumed
+    in place — a non-dividing block_v forces a padded COPY of the whole
+    (V, C)-sized weight every step. Both clamp to the next power of two
+    of their dim so tiny test shapes stay one block."""
+    bt = block_t or _DEFAULT_CE_BLOCKS[0]
+    bv = block_v or _DEFAULT_CE_BLOCKS[1]
+    bt = min(bt, _pow2_ceil(n_rows))
+    if vocab % bv:
+        for cand in (448, 384, 320, 256, 192, 128, 64):
+            if cand <= bv and vocab % cand == 0:
+                bv = cand
+                break
+        else:
+            bv = min(bv, _pow2_ceil(vocab))
+    return bt, bv
+
+
+def _pad_rows(a, n_target, fill=0):
+    pad = n_target - a.shape[0]
+    if pad == 0:
+        return a
+    widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+    return jnp.pad(a, widths, constant_values=fill)
+
+
+def _pad_vocab(w, v_target, w_layout):
+    axis = 1 if w_layout == "cv" else 0
+    pad = v_target - w.shape[axis]
+    if pad == 0:
+        return w
+    widths = [(0, 0)] * w.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(w, widths)
+
+
+def _ce_shard_axes(n_rows):
+    """Free batch-like mesh axes that divide the row count, or None when
+    no wrap is needed (no mesh / nothing to shard over). The rule set
+    follows ops.attention._flash_shard_specs: GSPMD has no partitioning
+    rule for a pallas_call, so left alone it replicates every operand."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return None
+    from avenir_tpu.parallel.partition import BATCH_AXES, free_axis_names
+
+    names = free_axis_names(mesh)
+    sizes = dict(mesh.shape)
+    free = {n: s for n, s in sizes.items() if n in names and s > 1}
+    if not free:
+        return None
+    batch_axes = [a for a in BATCH_AXES if a in free]
+    while batch_axes and n_rows % math.prod(free[a] for a in batch_axes):
+        batch_axes.pop()
+    if not batch_axes:
+        return None
+    return tuple(batch_axes), names
+
+
+@functools.lru_cache(maxsize=64)
+def _build_fused_ce(vocab, n_embd, w_layout, ignore_index, block_t, block_v,
+                    interpret):
+    """custom_vjp over (x2, w, y2) -> scalar loss SUM (the mean's divide
+    lives in the caller, so the upstream cotangent already carries the
+    1/n_valid factor). One build per static config, lru-cached like
+    flash_attention._build_flash."""
+    nv = -(-vocab // block_v)
+    vp = nv * block_v
+    kw = dict(block_v=block_v, vocab=vocab, ignore_index=ignore_index,
+              w_layout=w_layout)
+    if w_layout == "cv":
+        w_block, w_index = (n_embd, block_v), lambda i, j: (0, j)
+        w_block_jt, w_index_jt = (n_embd, block_v), lambda j, i: (0, j)
+    else:
+        w_block, w_index = (block_v, n_embd), lambda i, j: (j, 0)
+        w_block_jt, w_index_jt = (block_v, n_embd), lambda j, i: (j, 0)
+    row_spec = pl.BlockSpec((block_t, 1), lambda i, j: (i, 0))
+    g_spec = lambda ix: pl.BlockSpec((1, 1), ix, memory_space=pltpu.SMEM)
+
+    def _kernel_fwd(x2, w, y2):
+        """(rows (Np, 1), lse (Np, 1)) on padded rows."""
+        np_, _ = x2.shape
+        nt = np_ // block_t
+        return pl.pallas_call(
+            functools.partial(_fwd_kernel, **kw),
+            grid=(nt, nv),
+            in_specs=[
+                pl.BlockSpec((block_t, n_embd), lambda i, j: (i, 0)),
+                pl.BlockSpec(w_block, w_index),
+                row_spec,
+            ],
+            out_specs=[row_spec, row_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((np_, 1), jnp.float32),
+                jax.ShapeDtypeStruct((np_, 1), jnp.float32),
+            ],
+            scratch_shapes=[pltpu.VMEM((block_t, _LANES), jnp.float32)] * 3,
+            compiler_params=_compiler_params(1, 1),
+            interpret=interpret,
+        )(x2, _pad_vocab(w, vp, w_layout), y2)
+
+    def _kernel_bwd(x2, w, y2, lse, g):
+        np_, _ = x2.shape
+        nt = np_ // block_t
+        wp = _pad_vocab(w, vp, w_layout)
+        g2 = jnp.reshape(g.astype(jnp.float32), (1, 1))
+        dx = pl.pallas_call(
+            functools.partial(_dx_kernel, **kw),
+            grid=(nt, nv),
+            in_specs=[
+                pl.BlockSpec((block_t, n_embd), lambda i, j: (i, 0)),
+                pl.BlockSpec(w_block, w_index),
+                row_spec, row_spec,
+                g_spec(lambda i, j: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((block_t, n_embd), lambda i, j: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((np_, n_embd), x2.dtype),
+            scratch_shapes=[pltpu.VMEM((block_t, n_embd), jnp.float32)],
+            compiler_params=_compiler_params(1, 1),
+            interpret=interpret,
+        )(x2, wp, y2, lse, g2)
+        dwp = pl.pallas_call(
+            functools.partial(_dw_kernel, **kw),
+            grid=(nv, nt),
+            in_specs=[
+                pl.BlockSpec((block_t, n_embd), lambda j, i: (i, 0)),
+                pl.BlockSpec(w_block_jt, w_index_jt),
+                pl.BlockSpec((block_t, 1), lambda j, i: (i, 0)),
+                pl.BlockSpec((block_t, 1), lambda j, i: (i, 0)),
+                g_spec(lambda j, i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec(w_block_jt, w_index_jt),
+            out_shape=jax.ShapeDtypeStruct(
+                (n_embd, vp) if w_layout == "cv" else (vp, n_embd), w.dtype
+            ),
+            scratch_shapes=[pltpu.VMEM(w_block, jnp.float32)],
+            compiler_params=_compiler_params(1, 1),
+            interpret=interpret,
+        )(x2, wp, y2, lse, g2)
+        if vp != vocab:
+            dwp = (dwp[:, :vocab] if w_layout == "cv" else dwp[:vocab])
+        return dx, dwp
+
+    def _fwd_local(x2, w, y2):
+        n = x2.shape[0]
+        np_ = -(-n // block_t) * block_t
+        rows, lse = _kernel_fwd(
+            _pad_rows(x2, np_),
+            w,
+            _pad_rows(y2.reshape(n, 1), np_, fill=ignore_index),
+        )
+        # padded rows carry ignore_index -> zero loss rows; lse sliced
+        # back to the real rows (pad lse is never consumed: ds == 0)
+        return jnp.sum(rows), lse[:n]
+
+    def _bwd_local(x2, w, y2, lse, g):
+        n = x2.shape[0]
+        np_ = -(-n // block_t) * block_t
+        dx, dw = _kernel_bwd(
+            _pad_rows(x2, np_),
+            w,
+            _pad_rows(y2.reshape(n, 1), np_, fill=ignore_index),
+            _pad_rows(lse, np_),
+            g,
+        )
+        return dx[:n], dw
+
+    def _fwd_dispatch(x2, w, y2):
+        sn = _ce_shard_axes(x2.shape[0])
+        if sn is None:
+            return _fwd_local(x2, w, y2)
+        batch_axes, names = sn
+        from jax.sharding import PartitionSpec as P
+
+        def body(xl, wl, yl):
+            part, lse = _fwd_local(xl, wl, yl)
+            return jax.lax.psum(part, batch_axes), lse
+
+        return jax.shard_map(
+            body,
+            in_specs=(P(batch_axes, None), P(None, None), P(batch_axes)),
+            out_specs=(P(), P(batch_axes, None)),
+            check_vma=False, axis_names=names,
+        )(x2, w, y2)
+
+    def _bwd_dispatch(x2, w, y2, lse, g):
+        sn = _ce_shard_axes(x2.shape[0])
+        if sn is None:
+            return _bwd_local(x2, w, y2, lse, g)
+        batch_axes, names = sn
+        from jax.sharding import PartitionSpec as P
+
+        def body(xl, wl, yl, lsel, gl):
+            dxl, dwl = _bwd_local(xl, wl, yl, lsel, gl)
+            # each shard's dw covers only its rows: sum over batch axes
+            # HERE (hand-written backward — no shard_map transpose runs)
+            return dxl, jax.lax.psum(dwl, batch_axes)
+
+        return jax.shard_map(
+            body,
+            in_specs=(P(batch_axes, None), P(None, None), P(batch_axes),
+                      P(batch_axes, None), P()),
+            out_specs=(P(batch_axes, None), P(None, None)),
+            check_vma=False, axis_names=names,
+        )(x2, w, y2, lse, g)
+
+    @jax.custom_vjp
+    def f(x2, w, y2):
+        loss_sum, _ = _fwd_dispatch(x2, w, y2)
+        return loss_sum
+
+    def f_fwd(x2, w, y2):
+        loss_sum, lse = _fwd_dispatch(x2, w, y2)
+        return loss_sum, (x2, w, y2, lse)
+
+    def f_bwd(res, g):
+        x2, w, y2, lse = res
+        dx, dw = _bwd_dispatch(x2, w, y2, lse, g)
+        return dx, dw, np.zeros(y2.shape, jax.dtypes.float0)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def fused_ce_pallas(x, w, targets, *, ignore_index=-1, w_layout="cv",
+                    block_t=None, block_v=None, interpret=False):
+    """Mean token cross-entropy of x @ w without materializing (B, T, V).
+    Same contract as ops.fused_ce.fused_cross_entropy (which dispatches
+    here for impl='pallas')."""
+    assert w_layout in ("cv", "vc"), f"unknown w_layout {w_layout!r}"
+    B, T, C = x.shape
+    V = w.shape[1] if w_layout == "cv" else w.shape[0]
+    bt, bv = pick_ce_blocks(B * T, V, block_t, block_v)
+    f = _build_fused_ce(V, C, w_layout, int(ignore_index), bt, bv,
+                        bool(interpret))
+    loss_sum = f(x.reshape(B * T, C), w,
+                 targets.reshape(B * T).astype(jnp.int32))
+    n_valid = jnp.sum(targets != ignore_index)
+    return loss_sum / jnp.maximum(n_valid, 1).astype(jnp.float32)

@@ -105,6 +105,8 @@ def build_model_factory(cfg, model_args, mesh=None):
             scan_layers=cfg.get("scan_layers", False),
             pipeline_microbatches=cfg.get("pipeline_microbatches", 0),
             pipeline_schedule=cfg.get("pipeline_schedule", "gpipe"),
+            loss_impl=cfg.get("loss_impl", "") or "reference",
+            loss_chunk=cfg.get("loss_chunk", 0),
         )
         return mt, gcfg, (lambda seed: GPT(gcfg, rngs=nnx.Rngs(seed)))
     if mt == "llama":
@@ -305,12 +307,16 @@ def run_training(cfg):
         # print the RESOLVED hot-path impls — a silent fallback to the slow
         # path on a misconfigured pod must be visible at startup
         from avenir_tpu.ops.attention import resolve_attention_impl
+        from avenir_tpu.ops.fused_ce import resolve_loss_impl
 
         attn_resolved = resolve_attention_impl(
             getattr(st["model_config"], "attn_impl", "auto"),
             use_dropout=model_args["dropout"] > 0,
         )
-        print(f"[tpu] attention={attn_resolved} optimizer=optax_adamw "
+        loss_resolved = resolve_loss_impl(
+            getattr(st["model_config"], "loss_impl", "reference"))
+        print(f"[tpu] attention={attn_resolved} loss={loss_resolved} "
+              f"optimizer=optax_adamw "
               f"scan_layers={cfg.get('scan_layers', False)} "
               f"remat={cfg.get('remat', False)}")
 

@@ -52,6 +52,12 @@ def make_step_fns(graphdef, *, dropout: float):
         return t.astype(jnp.int32) if t.dtype != jnp.int32 else t
 
     def micro_loss(params, x, y, step_rng):
+        # the model computes its own loss tail (the config's `loss_impl`
+        # knob: reference full-logits CE or the fused chunked tail,
+        # ops/fused_ce.py) — the step only consumes the scalar, so the
+        # same micro_loss/eval_step serve every tail impl, and with a
+        # fused impl no (B, T, V) logits array exists anywhere in this
+        # jaxpr (pinned by tests/test_fused_ce.py's shape scan)
         model = nnx.merge(graphdef, params)
         rngs = nnx.Rngs(dropout=step_rng) if dropout > 0.0 else None
         _, loss = model(_i32(x), _i32(y), deterministic=dropout == 0.0,

@@ -39,3 +39,21 @@ def median_low(xs):
     """Lower median — discards the exposed-fence last round at even n."""
     s = sorted(xs)
     return s[(len(s) - 1) // 2]
+
+
+def peak_hbm_bytes():
+    """Peak device-memory bytes of device 0 via PJRT memory_stats —
+    None-tolerant (CPU/interpret backends return None or {}), so bench
+    JSON always carries the field. NB this is a PROCESS-LIFETIME
+    high-water mark: PJRT never resets it, so per-variant A/Bs must run
+    each variant in its own process (tools/loss_tail_bench.py does)."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    peak = stats.get("peak_bytes_in_use")
+    return int(peak) if peak else None

@@ -39,6 +39,15 @@ import time
 A100_BASELINE_TOKENS_PER_SEC_PER_CHIP = 132_500.0
 
 
+def _peak_hbm_bytes():
+    """ONE home: avenir_tpu.utils.benching.peak_hbm_bytes (None-tolerant
+    off-TPU) — recorded in `extra` so the BENCH_* trajectory can track
+    the loss-tail memory wins (ISSUE 3)."""
+    from avenir_tpu.utils.benching import peak_hbm_bytes
+
+    return peak_hbm_bytes()
+
+
 def _gpt_mfu(value, *, n_layer, n_head, n_embd, block):
     """tokens/sec/chip → MFU for a GPT at these dims. ONE home for the
     param-count/flops accounting so the loop and step forms can never
@@ -67,7 +76,7 @@ def _gpt_mfu(value, *, n_layer, n_head, n_embd, block):
 
 
 def _loop_form(args, *, attn_impl, on_tpu, block, batch, scan=False,
-               remat=False):
+               remat=False, loss_impl="auto"):
     """Measure through the shipped training loop. Builds a synthetic
     uint16 token memmap (the loader's real path; content is irrelevant to
     throughput), runs run_training for 5 full 32-step dispatch windows,
@@ -109,6 +118,7 @@ def _loop_form(args, *, attn_impl, on_tpu, block, batch, scan=False,
             dtype="bfloat16" if on_tpu else "float32", compile=False,
             seed=1337, mesh_shape="", remat=remat, scan_layers=scan,
             use_pallas=attn_impl == "pallas", attn_impl=attn_impl,
+            loss_impl=loss_impl, loss_chunk=0,
             fused_adamw=False, profile=False,
             allow_unsharded_fallback=False,
         )
@@ -149,6 +159,8 @@ def _loop_form(args, *, attn_impl, on_tpu, block, batch, scan=False,
             for k in ("step_window", "host_batch", "eval", "compile",
                       "train_dispatch")
         }
+        from avenir_tpu.ops.fused_ce import resolve_loss_impl
+
         return value, mfu, {
             "batch_per_chip": cfg["batch_size"] // n_chips,
             "block_size": cfg["block_size"], "n_chips": n_chips,
@@ -157,6 +169,10 @@ def _loop_form(args, *, attn_impl, on_tpu, block, batch, scan=False,
             "min_window_ms": round(dt_min * 1000, 2),
             "median_window_ms": round(dt_med * 1000, 2),
             "goodput_ms": goodput_ms,
+            # record what actually ran (auto resolves per platform) plus
+            # the run's peak HBM — the loss-tail memory win's ledger
+            "loss_impl": resolve_loss_impl(cfg["loss_impl"]),
+            "peak_hbm_bytes": _peak_hbm_bytes(),
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
@@ -224,6 +240,13 @@ def main():
     )
     scan = args.get("scan", "") in ("1", "True", "true")
     remat = args.get("remat", "") in ("1", "True", "true")
+    # the bench model defaults to the FUSED loss tail (ISSUE 3: pallas on
+    # TPU, blocked elsewhere); --loss_impl=reference restores the full-
+    # logits tail for A/B
+    loss_impl = args.get("loss_impl", "auto")
+    from avenir_tpu.ops.fused_ce import resolve_loss_impl
+
+    resolve_loss_impl(loss_impl)  # validate before burning chip time
     if form == "loop":
         # --dispatch selects the step harness's dispatcher; the loop form
         # always uses the trainer's windowed dispatch — reject rather than
@@ -235,6 +258,7 @@ def main():
         value, mfu, extra = _loop_form(
             args, attn_impl=attn_impl, on_tpu=on_tpu, block=block,
             batch=batch_candidates[0], scan=scan, remat=remat,
+            loss_impl=loss_impl,
         )
         result = {
             "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
@@ -259,6 +283,7 @@ def main():
         attn_impl=attn_impl,
         remat=remat,
         scan_layers=scan,
+        loss_impl=loss_impl,
     )
     mesh = make_mesh("")  # all chips on 'data'
     n_chips = int(np.prod(list(mesh.shape.values())))
@@ -386,6 +411,8 @@ def main():
             "timing": "pipelined" if multi else "fenced",
             "remat": cfg.remat,
             "scan_layers": cfg.scan_layers,
+            "loss_impl": resolve_loss_impl(cfg.loss_impl),
+            "peak_hbm_bytes": _peak_hbm_bytes(),
         },
     }
     print(json.dumps(result))

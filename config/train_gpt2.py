@@ -17,6 +17,12 @@ n_embd = 768
 
 max_iters = 600000
 lr_decay_iters = 600000
+
+# fused loss tail by default on the bench model (tpu backend): the
+# (B, T, V) logits — the last big HBM sink at this shape — are never
+# materialized (pallas kernel on TPU, blocked scan elsewhere;
+# avenir_tpu/ops/fused_ce.py)
+loss_impl = "auto"
 eval_interval = 1000
 eval_iters = 200
 log_interval = 10

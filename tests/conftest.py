@@ -43,6 +43,20 @@ def pytest_collection_modifyitems(config, items):
     items.sort(key=lambda it: it.fspath.basename in _HEAVY_FILES)
 
 
+# call-phase wall time of every completed non-slow test, keyed by nodeid
+# — consumed by tests/test_zz_slow_guard.py (which sorts after every
+# normal file and before the _HEAVY_FILES block) to assert that new
+# >5s cases carry the `slow` mark, so the 870s tier-1 budget survives
+# the growing suite (ISSUE 3 satellite).
+TEST_DURATIONS = {}
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call" and report.passed:
+        TEST_DURATIONS[report.nodeid] = (
+            report.duration, "slow" in report.keywords)
+
+
 from avenir_tpu.compat import get_mesh, install_jax_compat, set_mesh  # noqa: E402
 
 install_jax_compat()  # legacy runtimes: give tests the modern jax.set_mesh API

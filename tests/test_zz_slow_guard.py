@@ -1,0 +1,125 @@
+"""Tier-1 budget guard (ISSUE 3 satellite): the 870s tier-1 window must
+survive the growing suite, so every multi-second test case has to carry
+the `slow` mark (excluded from tier-1) instead of silently eating the
+budget of the files that sort after it.
+
+Mechanics: conftest records the call-phase wall time of every completed
+test (TEST_DURATIONS); this file's `zz` name sorts it after every normal
+test file but BEFORE the conftest._HEAVY_FILES block (which conftest
+pushes to the very end precisely because it is known-heavy and runs in
+whatever budget remains), so by the time the guard runs it has seen the
+whole broad suite. Any unmarked case over the budget that is not in the
+measured seed-era grandfather set fails the guard with its duration —
+add `@pytest.mark.slow` to the offender, don't grow the list.
+"""
+
+BUDGET_SECS = 5.0
+
+# Pre-existing cases measured >= ~3.5s on the 8-virtual-CPU tier-1
+# harness in the per-file duration survey that landed with this guard
+# (seed era — everything here predates it; CI load can inflate a wall
+# time ~2-3x, hence listing the near-budget ones too). Matched by nodeid
+# prefix so parametrized ids stay covered. NEW tests do not belong here:
+# mark them `slow` or split them instead.
+GRANDFATHERED = (
+    "tests/test_dcn_mesh.py::test_dcn_training_trajectory_matches_single_device",
+    "tests/test_decode.py::test_batched_rng_rows_match_sequential",
+    "tests/test_decode.py::test_decode_single_compile_across_positions",
+    "tests/test_decode.py::test_gpt_decode_matches_generate",
+    "tests/test_decode.py::test_gpt_scan_decode_matches_generate",
+    "tests/test_decode.py::test_llama_gqa_decode_matches_generate",
+    "tests/test_decode.py::test_mixtral_decode_matches_generate",
+    "tests/test_decode.py::test_prompt_bucket_bounds_compiles",
+    "tests/test_decode.py::test_stop_tokens_parity_vs_generate",
+    "tests/test_gpt_parity.py::test_export_round_trip",
+    "tests/test_gpt_parity.py::test_grad_flow_through_tied_embedding",
+    "tests/test_gpt_parity.py::test_inference_path_last_position_only",
+    "tests/test_gpt_parity.py::test_logits_and_loss_parity",
+    "tests/test_graft_entry.py::test_dryrun_multichip_8",
+    "tests/test_graft_entry.py::test_entry_is_jittable_tiny",
+    "tests/test_hardening.py::test_async_checkpoint_resumable",
+    "tests/test_hardening.py::test_checkify_train_step_clean",
+    "tests/test_hardening.py::test_loop_raises_on_nonfinite_loss",
+    "tests/test_hardening.py::test_profile_trace_stopped_on_early_exit",
+    "tests/test_hardening.py::test_profile_trace_window",
+    "tests/test_hardening.py::test_sigterm_graceful_save_and_resume",
+    "tests/test_hf_export.py::test_gpt_roundtrip_through_importer",
+    "tests/test_hf_export.py::test_gpt_transformers_from_pretrained",
+    "tests/test_hf_export.py::test_llama_roundtrip_both_consumers",
+    "tests/test_hf_export.py::test_mixtral_roundtrip_both_consumers",
+    "tests/test_hf_import.py::test_finetune_init_from_gpt2_offline",
+    "tests/test_hf_import.py::test_gpt2_from_hf_reaches_weight_load_or_skips",
+    "tests/test_hf_import.py::test_hf_import_logits_match_torch",
+    "tests/test_hf_import.py::test_llama_from_hf_dir_logits_parity",
+    "tests/test_hf_import.py::test_mixtral_from_hf_dir_logits_parity",
+    "tests/test_hf_import.py::test_train_loop_gpt2_init_crops_block_size",
+    "tests/test_hf_import.py::test_train_loop_init_from_gpt2",
+    "tests/test_llama.py::test_llama_trains_end_to_end",
+    "tests/test_llama.py::test_logits_parity_with_hf_llama",
+    "tests/test_mixtral.py::test_ep_hlo_contains_all_to_all",
+    "tests/test_mixtral.py::test_ep_trajectory_matches_and_hlo_has_all_to_all",
+    "tests/test_mixtral.py::test_expert_opt_state_sharded",
+    "tests/test_mixtral.py::test_logits_parity_no_drop",
+    "tests/test_mixtral.py::test_mixtral_trains_and_resumes",
+    "tests/test_obs.py::test_metrics_log_off_writes_nothing",
+    "tests/test_obs.py::test_run_training_writes_metrics_jsonl",
+    "tests/test_pallas_kernels.py::test_flash_attention_gqa_unrepeated_kv",
+    "tests/test_pallas_kernels.py::test_flash_attention_grads",
+    "tests/test_pallas_kernels.py::test_rmsnorm_forward_and_grads",
+    "tests/test_ring_attention.py::test_ring_matches_dense",
+    "tests/test_ring_attention.py::test_ring_trajectory_matches_single_device",
+    "tests/test_sampling_cli.py::",
+    "tests/test_scan_layers.py::test_gpt_scan_logits_match_loop",
+    "tests/test_scan_layers.py::test_gpt_scan_remat_matches",
+    "tests/test_scan_layers.py::test_gpt_scan_training_trajectory_matches_loop",
+    "tests/test_scan_layers.py::test_llama_family_scan_matches_loop",
+    "tests/test_scan_layers.py::test_remat_policy_dots_matches_nothing",
+    "tests/test_scan_layers.py::test_scan_checkpoint_roundtrip",
+    "tests/test_serve.py::test_engine_parity_families",
+    "tests/test_sharded_ckpt.py::test_lazy_load_roundtrip_matches_eager",
+    "tests/test_sharded_ckpt.py::test_sharded_async_save_load_roundtrip",
+    "tests/test_sharded_ckpt.py::test_streamed_pt_matches_eager_pt_and_torch_reads_it",
+    "tests/test_sharded_ckpt.py::test_streaming_restore_peak_memory",
+    "tests/test_sharded_ckpt.py::test_streaming_save_peak_memory",
+    "tests/test_torch_model.py::test_optimizer_decay_split",
+    "tests/test_train_tpu.py::test_fsdp_hlo_contains_collectives",
+    "tests/test_train_tpu.py::test_multi_step_dispatch_matches_single_steps",
+    "tests/test_train_tpu.py::test_optimizer_matches_torch_adamw",
+    "tests/test_train_tpu.py::test_resume_restores_schedule_count",
+    "tests/test_train_tpu.py::test_single_device_training_reduces_loss",
+    "tests/test_train_tpu.py::test_spmd_trajectory_matches_single_device",
+    "tests/test_train_tpu.py::test_windowed_loop_matches_single_dispatch",
+    "tests/test_ulysses.py::test_ulysses_trajectory_matches_single_device",
+)
+
+
+def test_every_slow_case_is_marked():
+    import statistics
+
+    from conftest import _HEAVY_FILES, TEST_DURATIONS
+
+    if not TEST_DURATIONS:
+        return  # single-file run of just this guard: nothing to check
+    # CI-load tolerance, same shape as the stall watchdog's threshold
+    # rule: a loaded harness slows EVERY test, so the budget floats with
+    # the run's median before anything is flagged
+    median = statistics.median(d for d, _ in TEST_DURATIONS.values())
+    budget = max(BUDGET_SECS, 3.0 * median)
+    offenders = []
+    for nodeid, (dur, is_slow) in sorted(TEST_DURATIONS.items()):
+        if is_slow or dur <= budget:
+            continue
+        fname = nodeid.split("::")[0].rsplit("/", 1)[-1]
+        if fname in _HEAVY_FILES:
+            continue  # documented end-of-run heavy block (conftest)
+        # nodeids are rootdir-relative; normalize a tests/-cwd run so the
+        # grandfather prefixes match either way
+        nid = nodeid if nodeid.startswith("tests/") else f"tests/{nodeid}"
+        if any(nid.startswith(g) for g in GRANDFATHERED):
+            continue
+        offenders.append(f"  {dur:6.1f}s  {nodeid}")
+    assert not offenders, (
+        f"unmarked tests over the {budget:.1f}s tier-1 slow budget — mark "
+        "them @pytest.mark.slow (or split them) so the 870s window keeps "
+        "covering the whole suite:\n" + "\n".join(offenders)
+    )

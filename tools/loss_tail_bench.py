@@ -1,0 +1,152 @@
+"""Loss-tail microbench (ISSUE 3 satellite): reference vs blocked vs
+pallas fused cross-entropy at real vocab shapes.
+
+Times a jitted value_and_grad of the bare tail — loss(x @ W) plus dx/dW —
+so the A/B isolates exactly the bytes the fused tail removes. Each impl
+runs in its OWN subprocess: PJRT's `peak_bytes_in_use` is a
+process-lifetime high-water mark that never resets, so measuring two
+impls in one process would report the first impl's (largest) peak for
+all of them and hide the exact memory win this tool exists to show.
+The peak field is None-tolerant on CPU, like bench.py's.
+
+    python tools/loss_tail_bench.py --shape=gpt2             # on TPU
+    python tools/loss_tail_bench.py --shape=tiny --steps=3   # anywhere
+
+Shapes: gpt2 (B16 T1024 C768 V50304), llama (B8 T1024 C4096 V128256),
+tiny (CPU smoke). Prints ONE JSON line like serve_bench/bench.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from avenir_tpu.platform import honor_jax_platforms_env  # noqa: E402
+
+honor_jax_platforms_env()
+
+SHAPES = {
+    "gpt2": dict(batch=16, block=1024, n_embd=768, vocab=50304),
+    "llama": dict(batch=8, block=1024, n_embd=4096, vocab=128256),
+    "tiny": dict(batch=2, block=128, n_embd=64, vocab=512),
+}
+
+
+def _parse_args():
+    args = {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
+            for a in sys.argv[1:]}
+    return args
+
+
+def _measure_one(impl, dims, steps, on_tpu):
+    """Run ONE impl in this process and return its result dict — the
+    process boundary is what makes peak_hbm_bytes per-impl truthful."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from avenir_tpu.models.common import cross_entropy_loss
+    from avenir_tpu.ops.fused_ce import fused_cross_entropy
+    from avenir_tpu.utils.benching import median_low, peak_hbm_bytes
+
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    B, T, C, V = (dims["batch"], dims["block"], dims["n_embd"],
+                  dims["vocab"])
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, T, C)).astype(np.float32) * 0.02,
+                    dtype)
+    w = jnp.asarray(rng.normal(size=(C, V)).astype(np.float32) * 0.02, dtype)
+    y = jnp.asarray(rng.integers(0, V, (B, T)).astype(np.int32))
+
+    if impl == "reference":
+        loss_fn = lambda x, w: cross_entropy_loss(
+            jnp.einsum("btc,cv->btv", x, w), y)
+    else:
+        loss_fn = lambda x, w: fused_cross_entropy(
+            x, w, y, impl=impl, w_layout="cv")
+
+    step = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1)))
+    try:
+        l, (dx, dw) = step(x, w)  # trace + compile + warmup
+        float(l)
+    except Exception as e:  # OOM at this shape: record and move on
+        return {"error": str(e).splitlines()[0][:200]}
+    times = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        l, (dx, dw) = step(x, w)
+        float(l)  # D2H fence (the reliable fence on tunneled hosts)
+        times.append((time.perf_counter() - t0) * 1e3)
+    return {
+        "ms_per_step": round(median_low(times), 3),
+        "loss": round(float(l), 5),
+        "peak_hbm_bytes": peak_hbm_bytes(),
+    }
+
+
+def _child(extra_args):
+    """Spawn this file as a child process and parse its one-line JSON."""
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)] + extra_args,
+        capture_output=True, text=True,
+    )
+    try:
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        return {"error": (out.stderr or "no output")
+                .strip().splitlines()[-1][:200]}
+
+
+def main():
+    args = _parse_args()
+    if "impl" in args:
+        # child mode: measure one impl, print its JSON fragment
+        import jax
+
+        on_tpu = jax.default_backend() == "tpu"
+        dims = json.loads(args["dims"])
+        print(json.dumps(_measure_one(args["impl"], dims,
+                                      int(args["steps"]), on_tpu)))
+        return
+    if "probe" in args:
+        # child mode: report the platform without doing any work
+        import jax
+
+        print(json.dumps({"backend": jax.default_backend(),
+                          "device": str(jax.devices()[0].device_kind)}))
+        return
+
+    # The PARENT must never initialize a jax backend: on TPU the libtpu
+    # client is process-exclusive, and a parent holding it would lock
+    # every measurement child out of the chip. Probe via a subprocess.
+    probe = _child(["--probe"])
+    on_tpu = probe.get("backend") == "tpu"
+    shape = args.get("shape", "gpt2" if on_tpu else "tiny")
+    assert shape in SHAPES, f"--shape must be one of {sorted(SHAPES)}"
+    dims = dict(SHAPES[shape])
+    dims["batch"] = int(args.get("batch", dims["batch"]))
+    dims["block"] = int(args.get("block", dims["block"]))
+    steps = int(args.get("steps", 20 if on_tpu else 3))
+    impls = args.get("impls", "reference,blocked,pallas").split(",")
+
+    results = {
+        impl: _child([f"--impl={impl}", f"--dims={json.dumps(dims)}",
+                      f"--steps={steps}"])
+        for impl in impls
+    }
+
+    print(json.dumps({
+        "metric": "loss_tail_fwd_bwd_ms",
+        "unit": "ms/step",
+        "shape": {**dims, "dtype": "bfloat16" if on_tpu else "float32"},
+        "device": probe.get("device", "unknown"),
+        "steps": steps,
+        "results": results,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -107,6 +107,13 @@ use_pallas = True  # pallas flash attention on TPU (auto-falls back off-TPU)
 # "auto" it never falls back silently — the CPU-harness SPMD tests force
 # "pallas" (interpret mode) through the real mesh dispatch with this
 attn_impl = ""
+# loss tail (tpu backend): "" / "reference" = full (B, T, V) logits +
+# cross_entropy_loss (the oracle); "blocked" = chunked lax.scan tail;
+# "pallas" = fused TPU kernel; "auto" = pallas on TPU, blocked elsewhere.
+# The fused impls never materialize the logits (avenir_tpu/ops/fused_ce.py,
+# docs/PERFORMANCE.md "The loss tail")
+loss_impl = ""
+loss_chunk = 0  # blocked-tail time chunk in rows; 0 = default (128)
 fused_adamw = False  # accepted+ignored: XLA-fused optax IS the hot path (BASELINE.md)
 # optimizer steps per XLA dispatch in the tpu loop: 0 = auto (windows of up
 # to 32 steps between eval/log/profile boundaries; identical trajectory,
