@@ -438,18 +438,14 @@ def save_checkpoint_sharded_async(out_dir, *, params, opt_state, hyper,
             t0 = time.perf_counter()
             # TWO pickle records per file: a small header first, then the
             # tensor body — resume can read every file's header (set
-            # validation, iter comparison vs ckpt.pt) without pulling
-            # N× the checkpoint off shared storage
-            header = {
-                "format": "avenir_sharded_v1", "process_index": pid,
-                "process_count": nproc, "iter_num": int(iter_num),
-                "best_val_loss": float(best_val_loss), "count": count,
-                "hyper": hyper, "model_args": model_args, "config": config,
-                "model_family": model_family,
-            }
+            # validation, iter comparison vs ckpt.pt, AND the per-tensor
+            # index ranges the locality filter intersects) without
+            # pulling N× the checkpoint off shared storage
             body = {}
+            index_ranges = {}
             for name in trees:
                 sec = {}
+                rng_sec = {}
                 src = (snap[name] if snap is not None else None)
                 for k in shapes[name]:
                     if src is not None:
@@ -459,7 +455,21 @@ def save_checkpoint_sharded_async(out_dir, *, params, opt_state, hyper,
                                   _local_replica0_shards(copies[name][k])]
                     sec[k] = {"global_shape": shapes[name][k],
                               "dtype": dtypes[name][k], "shards": shards}
+                    rng_sec[k] = [idx for idx, _ in shards]
                 body[name] = sec
+                index_ranges[name] = rng_sec
+            header = {
+                "format": "avenir_sharded_v1", "process_index": pid,
+                "process_count": nproc, "iter_num": int(iter_num),
+                "best_val_loss": float(best_val_loss), "count": count,
+                "hyper": hyper, "model_args": model_args, "config": config,
+                "model_family": model_family,
+                # {tree: {path: [((start, stop) per dim), ...]}} — what
+                # this FILE's body tiles, so a restoring process can skip
+                # files holding none of its addressable index ranges
+                # (load_sharded_checkpoint local_ranges)
+                "index_ranges": index_ranges,
+            }
             os.makedirs(out_dir, exist_ok=True)
             tmp = path + ".part"
             with open(tmp, "wb") as f:
@@ -488,11 +498,68 @@ def save_checkpoint_sharded_async(out_dir, *, params, opt_state, hyper,
     return handle
 
 
-def load_sharded_checkpoint(out_dir, meta_only=False):
+def local_shard_ranges(abs_state, shardings):
+    """{path_str: [((start, stop) per dim), ...]} — the index ranges this
+    process's addressable devices will hold under `shardings`. This is
+    what the locality-aware sharded restore intersects the shard-file
+    headers against: a file whose recorded ranges miss every local range
+    of every tensor never has its body read. Adam mu/nu shard exactly
+    like their params (init_sharded_opt_state pins that), so the PARAM
+    ranges cover all three trees."""
+    from avenir_tpu.parallel.partition import path_str
+
+    out = {}
+    for p, v in abs_state.flat_state():
+        shape = tuple(v.get_value().shape)
+        seen = []
+        for idx in shardings[p].addressable_devices_indices_map(shape).values():
+            tup = tuple(
+                (sl.start or 0, dim if sl.stop is None else sl.stop)
+                for sl, dim in zip(idx, shape)
+            )
+            if tup not in seen:
+                seen.append(tup)
+        out[path_str(p)] = seen
+    return out
+
+
+def _ranges_intersect(a, b):
+    """True when two ((start, stop) per dim) boxes overlap in every dim."""
+    return all(s1 < e2 and s2 < e1 for (s1, e1), (s2, e2) in zip(a, b))
+
+
+def _file_is_local(header, local_ranges):
+    """Does this shard file hold any index range a local device needs?
+    Headers written before the locality format carry no index_ranges —
+    treat those as needed (correct, just unfiltered)."""
+    ranges = header.get("index_ranges")
+    if ranges is None or local_ranges is None:
+        return True
+    for sec in ranges.values():
+        for k, boxes in sec.items():
+            need = local_ranges.get(k)
+            if need is None:
+                # tensor the current model doesn't know: let the
+                # assembler's own missing-path assert speak, not a
+                # silent skip here
+                return True
+            if any(_ranges_intersect(a, b) for a in boxes for b in need):
+                return True
+    return False
+
+
+def load_sharded_checkpoint(out_dir, meta_only=False, local_ranges=None):
     """Read a ckpt-shard-*.pkl set. `meta_only=True` reads just the small
     per-file headers (set validation + iter comparison — what resume
     needs BEFORE deciding this set wins over ckpt.pt); otherwise the
-    tensor bodies are assembled into full host arrays too. Returns
+    tensor bodies are assembled into host arrays. With `local_ranges`
+    (from `local_shard_ranges`) only the files whose header index ranges
+    intersect this process's addressable shards have their bodies read —
+    every process used to read ALL N bodies and assemble the full global
+    tree, an O(N×ckpt) read amplification off shared storage per restore
+    (advisor r5; docs/OPERATIONS.md). The assembled arrays still have
+    global shape, but only locally-needed ranges are filled — exactly
+    the ranges restore's make_array_from_callback will slice. Returns
     {"params": {path: np}, "mu": ..., "nu": ..., iter_num, ...} (tensor
     sections absent under meta_only) or None when the set is absent,
     incomplete, torn (mixed iterations), or not a format this reader
@@ -533,15 +600,27 @@ def load_sharded_checkpoint(out_dir, meta_only=False):
             "config", "model_family")}
     if meta_only:
         return out
-    # NB read amplification (ADVICE r5, docs/OPERATIONS.md): every process
-    # reads ALL N shard bodies off shared storage and assembles the full
-    # global tree (~3x model size host RAM: params+mu+nu). The restore
-    # bytes/duration counters below make that cost visible per run.
+    # Locality (advisor r5): with `local_ranges` only intersecting files
+    # are opened — each process reads ~1/N of the set instead of all N
+    # bodies (the old behavior, still available for whole-tree readers
+    # like tools). Arrays not present in any read file are allocated for
+    # shape fidelity but never filled NOR sliced (restore only asks for
+    # addressable ranges). The restore bytes/duration counters make the
+    # per-process read visible either way.
     t0 = time.perf_counter()
     bytes_read = 0
     for name in ("params", "mu", "nu"):
         out[name] = {}
-    for f, _ in headers:
+    # No placeholder pass for skipped files is needed: the saved shards
+    # tile every tensor fully across the set, so any tensor's local
+    # range intersects SOME file's shard of it — that file is read and
+    # allocates the tensor's global-shape array (restore asserts every
+    # path is present, which this invariant guarantees)
+    n_skipped = 0
+    for f, h in headers:
+        if not _file_is_local(h, local_ranges):
+            n_skipped += 1
+            continue
         with open(f, "rb") as fh:
             pickle.load(fh)  # skip the header record
             body = pickle.load(fh)
@@ -555,6 +634,10 @@ def load_sharded_checkpoint(out_dir, meta_only=False):
                 for idx, arr in ent["shards"]:
                     sl = tuple(slice(a, b) for a, b in idx)
                     sec[k][sl] = arr
+    assert n_skipped < len(headers), (
+        "locality filter skipped every shard file — local_ranges does "
+        "not match the checkpoint's tensors (config mismatch?)"
+    )
     reg = get_registry()
     reg.counter("ckpt_restore_ms").add((time.perf_counter() - t0) * 1e3)
     reg.counter("ckpt_restore_bytes").add(bytes_read)
@@ -562,11 +645,15 @@ def load_sharded_checkpoint(out_dir, meta_only=False):
 
 
 def restore_params_sharded(assembled, abs_state, shardings):
-    """Place load_sharded_checkpoint's raw-path arrays (full global
-    host arrays, identical on every process) onto devices under the
-    current mesh's shardings. Raw nnx paths — no torch bridge: the
-    sharded format is internal, resume-only (ckpt.pt stays the
-    cross-backend artifact)."""
+    """Place load_sharded_checkpoint's raw-path arrays onto devices
+    under the current mesh's shardings. NB the assembled arrays have
+    GLOBAL shape but — when the load used `local_ranges` — are only
+    VALID inside this process's addressable ranges (the rest is
+    unfilled np.empty); that is exactly what make_array_from_callback
+    slices here, but whole-tree readers (tools, checksums) must load
+    WITHOUT local_ranges. Raw nnx paths — no torch bridge: the sharded
+    format is internal, resume-only (ckpt.pt stays the cross-backend
+    artifact)."""
     from avenir_tpu.parallel.partition import path_str
 
     flat = {}

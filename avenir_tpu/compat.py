@@ -189,6 +189,21 @@ def _install_flax_compat():
 
             cls.set_value = _set_value
 
+    if not hasattr(nnx, "to_pure_dict"):
+        # newer flax's State -> plain nested-dict-of-arrays converter
+        # (tests use it to compare grad trees order-independently)
+        def to_pure_dict(state):
+            out = {}
+            for path, v in state.flat_state():
+                d = out
+                for k in path[:-1]:
+                    d = d.setdefault(k, {})
+                d[path[-1]] = (v.get_value()
+                               if hasattr(v, "get_value") else v)
+            return out
+
+        nnx.to_pure_dict = to_pure_dict
+
     _install_none_param_compat()
 
 
@@ -300,6 +315,68 @@ def shard_map(f, *, mesh=None, in_specs, out_specs, check_vma=True,
                             auto=auto)
 
 
+def _install_legacy_shard_map_autodiff_fix():
+    """jax 0.4.x `shard_map(..., auto=...)` names partial-eval RESIDUALS
+    over ALL mesh axes: `_all_mesh_names_except_spmd` drops vmap
+    spmd_axis_names but ignores `auto`, so when a partial-auto wrap is
+    NESTED inside another manual region (the pallas flash / ring /
+    ulysses wraps inside the GPipe/1f1b 'pipe' region — inner auto =
+    {'pipe'}), the residual spec claims the enclosing Manual axis and
+    grad lowering dies with "Axis: pipe of PartitionSpec(...) is also
+    found in manual_axes". Modern jax excludes the auto axes from
+    residual naming (`_all_newly_manual_mesh_names`); reproduce that
+    here by wrapping BOTH partial-eval entry points (the JaxprTrace rule
+    and the jaxpr-custom rule — autodiff reaches shard_map through
+    either, depending on whether the region is linearized inline or via
+    a staged jaxpr) to drop each region's own `auto` set, threaded
+    through a thread-local for the dynamic extent of the rule. Fully
+    manual regions have auto = {} and are untouched."""
+    from jax._src.interpreters import partial_eval as pe
+    from jax.experimental import shard_map as _sm
+
+    if getattr(_sm, "_avenir_residual_fix", False):
+        return
+    orig_names = _sm._all_mesh_names_except_spmd
+
+    def fixed_names(mesh, trace=None):
+        names = orig_names(mesh, trace)
+        drop = getattr(_manual_axes, "res_drop", frozenset())
+        return tuple(n for n in names if n not in drop)
+
+    _sm._all_mesh_names_except_spmd = fixed_names
+
+    def _with_auto_dropped(auto, fn, *args, **kwargs):
+        prev = getattr(_manual_axes, "res_drop", frozenset())
+        _manual_axes.res_drop = frozenset(auto)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _manual_axes.res_drop = prev
+
+    orig_pe = _sm._shard_map_partial_eval
+
+    def pe_fixed(trace, shard_map_p, f, tracers, mesh, in_names,
+                 out_names_thunk, check_rep, rewrite, auto):
+        return _with_auto_dropped(
+            auto, orig_pe, trace, shard_map_p, f, tracers, mesh, in_names,
+            out_names_thunk, check_rep, rewrite, auto)
+
+    orig_custom = _sm._partial_eval_jaxpr_custom_rule
+
+    def custom_fixed(saveable, unks_in, inst_in, eqn):
+        return _with_auto_dropped(
+            eqn.params.get("auto", frozenset()), orig_custom,
+            saveable, unks_in, inst_in, eqn)
+
+    # patch the REGISTRATIONS, not just the module attrs — both rules
+    # were installed into their registries at import time
+    pe.JaxprTrace.process_shard_map = pe_fixed
+    pe.partial_eval_jaxpr_custom_rules[_sm.shard_map_p] = custom_fixed
+    _sm._shard_map_partial_eval = pe_fixed
+    _sm._partial_eval_jaxpr_custom_rule = custom_fixed
+    _sm._avenir_residual_fix = True
+
+
 def install_jax_compat():
     """Patch `jax.set_mesh` / `jax.sharding.get_mesh` onto the jax module
     and the nnx API shims onto flax when this runtime lacks them.
@@ -309,6 +386,14 @@ def install_jax_compat():
     legacy = not hasattr(jax, "set_mesh")  # before any patching below
     if legacy:
         jax.set_mesh = set_mesh
+        # jax 0.4.x defaults jax_threefry_partitionable=False, under
+        # which the SAME seeded draw yields DIFFERENT bits depending on
+        # the output sharding (measured: pipe-sharded layer-stack init
+        # diverges from the single-device init by ~1e-1 per weight,
+        # which silently breaks every cross-mesh trajectory-parity
+        # contract in the suite). Modern jax defaults the flag True;
+        # align the legacy runtime so seeded draws are layout-invariant.
+        jax.config.update("jax_threefry_partitionable", True)
     if not hasattr(jax.sharding, "get_mesh"):
         jax.sharding.get_mesh = get_mesh
     if not hasattr(jax.sharding, "get_abstract_mesh"):
@@ -321,6 +406,7 @@ def install_jax_compat():
         jax.sharding.Mesh.abstract_mesh = property(_abstract_view)
     if not hasattr(jax, "shard_map"):
         jax.shard_map = shard_map
+        _install_legacy_shard_map_autodiff_fix()
     if not hasattr(jax.lax, "axis_size"):
         # psum of a literal 1 is constant-folded to the axis size (no
         # collective is emitted) — the legacy spelling of axis_size

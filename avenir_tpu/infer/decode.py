@@ -298,8 +298,9 @@ def _sample_rows(keys, logits, temperature, top_k=None):
     the serve engine's parity contract and sample.py's batched samples
     both rest on this.) temperature/top_k are per-row arrays; top_k == V
     means "no top-k" and its mask is an exact no-op — a STATIC None
-    skips the per-token full-vocab sort entirely (same bits: an all-V
-    mask never changes a logit)."""
+    skips the per-token full-vocab sort at trace time, and a traced
+    all->=V batch skips it at RUNTIME through a batch-level lax.cond
+    (same bits either way: an all-V mask never changes a logit)."""
     V = logits.shape[-1]
 
     def one(key, row, temp, k):
@@ -313,7 +314,26 @@ def _sample_rows(keys, logits, temperature, top_k=None):
     if top_k is None:
         return jax.vmap(lambda ky, r, t: one(ky, r, t, None))(
             keys, logits, temperature)
-    return jax.vmap(one)(keys, logits, temperature, top_k)
+    # Traced per-row k: a row with k >= V has an exactly-no-op mask (an
+    # all-V mask never changes a logit) but would still pay the per-row
+    # full-vocab SORT every decode step — and in the serve engine that is
+    # every EMPTY/padding slot (pool top_k defaults to V) plus every
+    # no-top-k request. One batch-level lax.cond keeps the single
+    # compiled step (the engine's compile-budget contract) while skipping
+    # the sort branch at RUNTIME whenever no row in the batch needs it;
+    # bits are identical by the no-op-mask argument above. Mixed batches
+    # (any real top-k row) take the full path — per-row skipping under
+    # vmap would lower to select and run both branches anyway.
+    def with_sort(args):
+        ky, lg, tp, k = args
+        return jax.vmap(one)(ky, lg, tp, k)
+
+    def no_sort(args):
+        ky, lg, tp, _ = args
+        return jax.vmap(lambda kk, r, t: one(kk, r, t, None))(ky, lg, tp)
+
+    return jax.lax.cond(jnp.all(top_k >= V), no_sort, with_sort,
+                        (keys, logits, temperature, top_k))
 
 
 def _sample_any(rng, logits, temperature, top_k):

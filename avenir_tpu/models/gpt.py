@@ -66,9 +66,11 @@ class GPTConfig:
     # GPipe microbatches when the mesh has a pipe axis > 1 (requires
     # scan_layers; parallel/pipeline.py). 0 = auto (2x the pipe size).
     pipeline_microbatches: int = 0
-    # pipeline backward schedule: 'gpipe' (autodiff through the tick
-    # scan) or 'remat' (reverse-tick stage-input stash — the 1F1B
-    # activation-memory class; parallel/pipeline.py)
+    # pipeline schedule: 'gpipe' (autodiff through the tick scan),
+    # 'remat' (reverse-tick stage-input stash), or '1f1b' (true
+    # interleaved 1F1B — the loss tail moves INSIDE the pipeline region
+    # and runs the chunked 'blocked' CE per micro on the last stage,
+    # whatever loss_impl says; loss_chunk is honored). parallel/pipeline.py
     pipeline_schedule: str = "gpipe"
     # loss tail: 'reference' (full (B, T, V) logits + cross_entropy_loss),
     # 'blocked' (chunked lax.scan tail), 'pallas' (fused TPU kernel), or
@@ -241,18 +243,60 @@ class GPT(nnx.Module):
                 "scan_layers + dropout rng threading not supported; "
                 "train with dropout=0"
             )
-            from avenir_tpu.parallel.pipeline import layer_stack_dispatch
+            from avenir_tpu.parallel.pipeline import (
+                layer_stack_dispatch,
+                pipeline_1f1b_loss,
+                pipeline_axis_size,
+            )
+
+            block_call = lambda blk, h: blk(h, deterministic=deterministic)
+            schedule = self.config.pipeline_schedule
+            if (schedule == "1f1b" and targets is not None
+                    and pipeline_axis_size() > 1):
+                # true 1F1B: the loss tail (ln_f + tied head + chunked
+                # CE) moves INSIDE the pipeline region and runs per
+                # microbatch on the last stage, so backwards interleave
+                # with later micros' forwards. The tied wte rides in as
+                # an explicit tail param: its tail gradient (dw of the
+                # head) comes back from the region and the embedding-
+                # lookup contribution is added by the outer autodiff —
+                # same tied-weight accounting as the fused tail outside.
+                from avenir_tpu.ops.fused_ce import blocked_ce_terms
+
+                ln_gd, ln_state = nnx.split(self.ln_f)
+                tail_params = {"ln": ln_state,
+                               "w": self.wte.embedding.get_value()}
+                cd = self._cdtype
+                t_chunk = self.config.loss_chunk
+
+                def tail_fn(tp, h, y, stats):
+                    hn = nnx.merge(ln_gd, tp["ln"])(h).astype(cd)
+                    ls, _ = blocked_ce_terms(
+                        hn, tp["w"].astype(cd), y, ignore_index=-1,
+                        w_layout="vc", t_chunk=t_chunk)
+                    return ls, jnp.float32(0.0)
+
+                loss = pipeline_1f1b_loss(
+                    x, self.h_scan, targets, call=block_call,
+                    tail_fn=tail_fn, tail_params=tail_params,
+                    n_valid=jnp.sum(targets != -1),
+                    n_micro=self.config.pipeline_microbatches,
+                    remat=self.config.remat,
+                    remat_policy=self.config.remat_policy,
+                )
+                return None, loss
 
             # GPipe over the 'pipe' mesh axis when the mesh has one
             # (stages own contiguous layer blocks, microbatches ride
-            # ppermute), nnx.scan otherwise — one dispatch helper
+            # ppermute), nnx.scan otherwise — one dispatch helper. A
+            # 1f1b config called WITHOUT targets (generate/logits) runs
+            # the identical gpipe forward: no loss, nothing to interleave
             x = layer_stack_dispatch(
-                x, self.h_scan,
-                call=lambda blk, h: blk(h, deterministic=deterministic),
+                x, self.h_scan, call=block_call,
                 n_micro=self.config.pipeline_microbatches,
                 remat=self.config.remat,
                 remat_policy=self.config.remat_policy,
-                schedule=self.config.pipeline_schedule,
+                schedule="gpipe" if schedule == "1f1b" else schedule,
             )
         else:
             if self.config.remat:

@@ -221,7 +221,50 @@ class Llama(nnx.Module):
 
         stats_sum = self._zero_router_stats()
         if self.config.scan_layers:
-            from avenir_tpu.parallel.pipeline import layer_stack_dispatch
+            from avenir_tpu.parallel.pipeline import (
+                layer_stack_dispatch,
+                pipeline_1f1b_loss,
+                pipeline_axis_size,
+            )
+
+            coef = getattr(self.config, "router_aux_loss_coef", 0.0)
+            schedule = self.config.pipeline_schedule
+            kw = dict(n_micro=self.config.pipeline_microbatches,
+                      remat=self.config.remat,
+                      remat_policy=self.config.remat_policy)
+            if (schedule == "1f1b" and targets is not None
+                    and pipeline_axis_size() > 1):
+                # true 1F1B: final norm + (untied) lm_head + chunked CE
+                # run per micro on the last stage INSIDE the region. MoE
+                # router stats ride the ppermute payload and the aux loss
+                # is computed PER MICRO from each micro's own stats —
+                # the micro-batched-oracle semantics (see
+                # pipeline_1f1b_loss; gpipe keeps aggregate-stats aux).
+                from avenir_tpu.ops.fused_ce import blocked_ce_terms
+
+                norm_gd, norm_state = nnx.split(self.norm)
+                tail_params = {"norm": norm_state,
+                               "w": self.lm_head.kernel.get_value()}
+                cd = self._cdtype
+                t_chunk = self.config.loss_chunk
+
+                def tail_fn(tp, h, y, stats):
+                    hn = nnx.merge(norm_gd, tp["norm"])(h).astype(cd)
+                    ls, _ = blocked_ce_terms(
+                        hn, tp["w"].astype(cd), y, ignore_index=-1,
+                        w_layout="cv", t_chunk=t_chunk)
+                    aux = (coef * self._router_aux_loss(stats) if coef
+                           else jnp.float32(0.0))
+                    return ls, aux
+
+                loss = pipeline_1f1b_loss(
+                    x, self.layers_scan, targets,
+                    call=(apply if coef
+                          else (lambda lyr, h: apply(lyr, h)[0])),
+                    aux0=stats_sum if coef else None,
+                    tail_fn=tail_fn, tail_params=tail_params,
+                    n_valid=jnp.sum(targets != -1), **kw)
+                return None, loss
 
             # router stats ride the shared aux carry: the scan path
             # accumulates them through its carry, a pipe mesh through the
@@ -229,12 +272,11 @@ class Llama(nnx.Module):
             # NB MoE capacity is then computed per MICRObatch — see
             # pipeline_layer_stack). Families with no aux consumer
             # (coef=0: plain Llama) skip the carry entirely — which also
-            # unlocks the aux-free 'remat' pipeline schedule for them
-            kw = dict(n_micro=self.config.pipeline_microbatches,
-                      remat=self.config.remat,
-                      remat_policy=self.config.remat_policy,
-                      schedule=self.config.pipeline_schedule)
-            if getattr(self.config, "router_aux_loss_coef", 0.0):
+            # unlocks the aux-free 'remat' pipeline schedule for them.
+            # 1f1b configs without targets fall back to the identical
+            # gpipe forward (no loss, nothing to interleave).
+            kw["schedule"] = "gpipe" if schedule == "1f1b" else schedule
+            if coef:
                 x, stats_sum = layer_stack_dispatch(
                     x, self.layers_scan, call=apply, aux0=stats_sum, **kw)
             else:

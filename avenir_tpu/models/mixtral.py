@@ -107,7 +107,31 @@ class MixtralSparseMoeBlock(nnx.Module):
     def __call__(self, x):  # (B, T, d)
         from jax.sharding import PartitionSpec as P
 
+        from avenir_tpu import compat
         from avenir_tpu.parallel.partition import constrain
+
+        # legacy-runtime guard (jax 0.4.x compat shard_map): the expert
+        # all-to-all pair that GSPMD emits for the dispatch/combine
+        # constraints below cannot lower inside the pipeline's
+        # partial-auto 'pipe' region — the old SPMD partitioner
+        # CHECK-aborts the whole process (no catchable exception).
+        # ring/ulysses sidestep their analogous breakage with a psum
+        # emulation because they own a shard_map body; this dispatch is
+        # GSPMD-constraint-driven, so there is nothing local to swap.
+        # Modern jax composes expert×pipe fine — fail loud here instead
+        # of letting XLA abort the trainer (and every pytest after it).
+        if getattr(jax, "shard_map", None) is compat.shard_map:
+            mesh = jax.sharding.get_abstract_mesh()
+            manual = getattr(compat._manual_axes, "names", frozenset())
+            if ("pipe" in manual and mesh is not None and not mesh.empty
+                    and dict(mesh.shape).get("expert", 1) > 1):
+                raise NotImplementedError(
+                    "expert-parallel MoE dispatch cannot nest inside a "
+                    "pipeline region on the legacy jax runtime (the "
+                    "expert all-to-all CHECK-crashes the old SPMD "
+                    "partitioner); drop the expert axis from pipe "
+                    "meshes, or run on modern jax"
+                )
 
         B, T, d = x.shape
         N = B * T

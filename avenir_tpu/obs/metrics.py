@@ -84,11 +84,29 @@ METRIC_SCHEMA = {
         "counter", "ms", "checkpoint read/assembly wall time on restore"),
     "ckpt_restore_bytes": (
         "counter", "bytes",
-        "checkpoint bytes read on restore (sharded sets: every process "
-        "reads all N shard bodies — docs/OPERATIONS.md read amplification)"),
+        "checkpoint bytes read on restore (sharded sets: only the shard "
+        "files whose header index ranges intersect this process's "
+        "addressable shards — ~1/N of the set per process; "
+        "docs/OPERATIONS.md)"),
     # -- watchdog --
     "watchdog_stalls": (
         "counter", "1", "stall-watchdog warnings fired"),
+    # -- pipeline parallelism (parallel/pipeline.py) --
+    "pp_bubble_frac": (
+        "gauge", "1",
+        "bubble fraction of the last-traced pipeline schedule (bubble "
+        "tick-slots / total tick-slots, counted from _staircase over "
+        "every (tick, stage) slot; 1f1b TRAINING ticks carry an F- and "
+        "a B-slot, its eval trace counts the forward-only staircase)"),
+    "pipe_ticks_real": (
+        "counter", "1",
+        "per-stage pipeline tick-slots that process a real microbatch, "
+        "recorded once per REGION TRACE (schedule utilization is "
+        "shape-static, so per-step counting would only repeat it)"),
+    "pipe_ticks_bubble": (
+        "counter", "1",
+        "per-stage pipeline tick-slots spent in warmup/drain bubbles, "
+        "recorded once per region trace (see pipe_ticks_real)"),
     # -- serving engine (avenir_tpu/serve) --
     "serve_requests": (
         "counter", "1", "requests completed by the serve engine"),

@@ -126,6 +126,8 @@ def summarize(records):
         "ckpt_bytes_written": counters.get("ckpt_bytes_written", 0.0),
         "restore_ms": counters.get("ckpt_restore_ms", 0.0),
         "restore_bytes": counters.get("ckpt_restore_bytes", 0.0),
+        "pipe_ticks_real": counters.get("pipe_ticks_real", 0.0),
+        "pipe_ticks_bubble": counters.get("pipe_ticks_bubble", 0.0),
     }
 
 
@@ -185,6 +187,13 @@ def format_report(s):
     if s["restore_ms"]:
         extras.append(f"restore {s['restore_ms'] / 1e3:.3f}s "
                       f"/ {s['restore_bytes'] / 1e6:.1f} MB read")
+    pp_total = s["pipe_ticks_real"] + s["pipe_ticks_bubble"]
+    if pp_total:
+        extras.append(
+            f"pipeline: {s['pipe_ticks_bubble'] / pp_total:.0%} bubble "
+            f"({s['pipe_ticks_real']:.0f} real / "
+            f"{s['pipe_ticks_bubble']:.0f} bubble tick-slots, summed "
+            "over region traces)")
     if s["n_stalls"]:
         extras.append(f"WATCHDOG STALL WARNINGS: {s['n_stalls']}")
     if extras:

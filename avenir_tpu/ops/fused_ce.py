@@ -130,13 +130,15 @@ def _chunk_loss_terms(xc, w, yc, *, ignore_index, w_layout):
     return losses.sum(), valid.sum()
 
 
-def _blocked_ce(x, w, targets, *, ignore_index, w_layout, t_chunk):
-    """lax.scan over T-chunks; jax.checkpoint on the chunk body so the
-    backward recomputes each chunk's logits (the scan would otherwise
-    stack them into the full (B, T, V) as residuals). dx is scattered
-    back chunk-by-chunk through the dynamic_slice transpose; dw
-    accumulates across scan steps — neither pass holds more than one
-    (B, t_chunk, V) slab."""
+def blocked_ce_terms(x, w, targets, *, ignore_index=-1, w_layout="cv",
+                     t_chunk=0):
+    """(loss_sum, valid_count) of the chunked tail — the un-normalized
+    form the 1f1b pipeline runs per-MICRObatch at the last stage
+    (parallel/pipeline.pipeline_1f1b_loss): callers own the division, so
+    per-micro SUMS reduce to exactly the full-batch mean regardless of
+    how the ignored positions fall across micros. Same chunking,
+    jax.checkpoint and dtype discipline as the `blocked` impl of
+    fused_cross_entropy (which is this divided through)."""
     B, T, C = x.shape
     tc = min(t_chunk or _DEFAULT_T_CHUNK, T)
     nc = -(-T // tc)
@@ -152,6 +154,14 @@ def _blocked_ce(x, w, targets, *, ignore_index, w_layout, t_chunk):
             xc, w, yc, ignore_index=ignore_index, w_layout=w_layout)
     )
 
+    if nc == 1:
+        # single-chunk tail: the scan would be a length-1 loop — call the
+        # chunk directly (saves the scan wrapper; also what lets the
+        # 1f1b per-micro tail run inside the legacy harness's
+        # partial-auto regions, where scans trip the old partitioner)
+        ls, nv = chunk(x, targets)
+        return ls.astype(jnp.float32), nv.astype(jnp.int32)
+
     def body(carry, i):
         ls, nv = carry
         xc = jax.lax.dynamic_slice_in_dim(x, i * tc, tc, axis=1)
@@ -159,9 +169,43 @@ def _blocked_ce(x, w, targets, *, ignore_index, w_layout, t_chunk):
         l, v = chunk(xc, yc)
         return (ls + l, nv + v), None
 
+    from avenir_tpu import compat
+
+    manual = getattr(compat._manual_axes, "names", frozenset())
+    if getattr(jax, "shard_map", None) is compat.shard_map and manual:
+        # legacy harness, nested inside a manual region (the 1f1b tail):
+        # when any NON-manual mesh axis is live the old SPMD partitioner
+        # CHECK-aborts on scans in the partial-auto region (same gate as
+        # pipeline._use_psum_hop, which unrolls its tick/layer scans for
+        # exactly this reason) — unroll the chunk loop; nc is static and
+        # the unrolled sum is the same sequential reduction bit-for-bit
+        mesh = jax.sharding.get_abstract_mesh()
+        auto = 1
+        if mesh is not None and not mesh.empty:
+            for name, sz in dict(mesh.shape).items():
+                if name not in manual:
+                    auto *= sz
+        if auto > 1:
+            carry = (jnp.float32(0.0), jnp.int32(0))
+            for i in range(nc):
+                carry, _ = body(carry, i)
+            return carry
+
     (ls, nv), _ = jax.lax.scan(
         body, (jnp.float32(0.0), jnp.int32(0)), jnp.arange(nc)
     )
+    return ls, nv
+
+
+def _blocked_ce(x, w, targets, *, ignore_index, w_layout, t_chunk):
+    """lax.scan over T-chunks; jax.checkpoint on the chunk body so the
+    backward recomputes each chunk's logits (the scan would otherwise
+    stack them into the full (B, T, V) as residuals). dx is scattered
+    back chunk-by-chunk through the dynamic_slice transpose; dw
+    accumulates across scan steps — neither pass holds more than one
+    (B, t_chunk, V) slab."""
+    ls, nv = blocked_ce_terms(x, w, targets, ignore_index=ignore_index,
+                              w_layout=w_layout, t_chunk=t_chunk)
     return ls / jnp.maximum(nv, 1).astype(jnp.float32)
 
 

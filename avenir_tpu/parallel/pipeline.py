@@ -13,15 +13,21 @@ microbatch t-s (when in range) through its local layer stack, then
 `lax.ppermute`s the activation one hop to stage s+1. Stage p-1 collects
 finished microbatches; a masked psum broadcasts the result back to every
 stage (embeddings/norm/head outside this region are replicated over
-'pipe', so all stages need the block-stack output). TWO backward
-schedules share this forward (`pipeline_schedule`): 'gpipe' is plain
-autodiff (the transpose of ppermute is the reverse ppermute and the
-transpose of the tick scan is the reverse schedule — stash is the
-scan's own per-layer residuals for every in-flight micro), 'remat' is
-a custom-vjp mirrored-tick backward stashing only stage INPUTS with
-just-in-time recompute (the 1F1B activation-stash class; measured
-3.4-6.9× smaller compiled temp memory — BASELINE.md "Pipeline cost
-table"). Per-layer remat composes with both.
+'pipe', so all stages need the block-stack output). THREE schedules
+(`pipeline_schedule`): 'gpipe' is plain autodiff through that forward
+(the transpose of ppermute is the reverse ppermute and the transpose of
+the tick scan is the reverse schedule — stash is the scan's own
+per-layer residuals for every in-flight micro), 'remat' is a custom-vjp
+mirrored-tick backward stashing only stage INPUTS with just-in-time
+recompute (3.4-6.9× smaller compiled temp memory — BASELINE.md
+"Pipeline cost table"), and '1f1b' is the real thing (Narayanan et al.
+PipeDream-Flush / Megatron-LM): the per-micro LOSS TAIL moves inside
+the region (`pipeline_1f1b_loss` — the last stage runs the chunked
+fused CE on each finished microbatch, ops/fused_ce.blocked_ce_terms),
+so each tick carries an activation downstream AND a cotangent upstream
+and the stage-input stash is a fixed 2p-1-slot ring — O(p) in-flight
+micros instead of O(M), M-independent activation memory. Per-layer
+remat composes with all three.
 
 Composition. Because the region is manual only over 'pipe', everything
 else stays GSPMD: batch stays sharded over data/fsdp, weights over
@@ -36,8 +42,10 @@ pipe×context trains sequence-parallel inside the pipeline
 (tests/test_pipeline.py pp-cp-* cases). One residual constraint:
 jax.lax.axis_index cannot lower in a nested shard_map under Shardy —
 ring ships its position in as data instead (ring_attention). Bubble
-fraction is the standard (p-1)/(M+p-1); pick M =
-pipeline_microbatches >= p to amortize (default 2p).
+fraction is (p-1)/(M+p-1) for gpipe/remat and (2p-2)/(M+2p-2) for
+1f1b's combined F+B ticks; pick M = pipeline_microbatches >= p to
+amortize (default 2p; 1f1b's bounded stash is what makes M >> 2p
+affordable — docs/PERFORMANCE.md "The pipeline bubble").
 
 Trajectory equivalence vs the unpipelined model is exact up to fp
 reassociation: the same layers run in the same order per token, only
@@ -57,8 +65,10 @@ PIPE_AXIS = "pipe"
 
 def _staircase(t, s, M):
     """(micro index, is-real) for stage s at tick t — THE schedule math,
-    shared by the gpipe tick body and the remat schedule's forward AND
-    mirrored backward so the three can never drift (review r5)."""
+    shared by the gpipe tick body, the remat schedule's forward AND
+    mirrored backward, and BOTH half-ticks of the 1f1b schedule (its
+    backward staircase is the forward one at the reflected stage index
+    2(p-1)-s), so none of them can drift (review r5)."""
     mi = jnp.clip(t - s, 0, M - 1)
     real = jnp.logical_and(t - s >= 0, t - s < M)
     return mi, real
@@ -70,6 +80,172 @@ def pipeline_axis_size() -> int:
     if mesh is None or mesh.empty:
         return 1
     return dict(mesh.shape).get(PIPE_AXIS, 1)
+
+
+# One entry per TRACE of a pipeline region ((schedule, kind) tuples;
+# appends happen at trace time only) — the same ledger idiom as
+# ops/fused_ce and infer/decode. Tests pin one trace per compiled step.
+_trace_events = []
+
+
+def trace_count(schedule=None):
+    """Number of pipeline-region traces (optionally for one schedule)."""
+    if schedule is None:
+        return len(_trace_events)
+    return sum(1 for s, _ in _trace_events if s == schedule)
+
+
+def _resolve_micro(B, p, n_micro, schedule="gpipe"):
+    """Shared microbatch-count resolution: explicit n_micro, else auto
+    2p clamped to the largest divisor of B (warning when the bubble
+    dominates). All schedules share it, so a schedule A/B at the same
+    config always compares equal M; `schedule` only picks the bubble
+    formula the warning reports (1f1b's combined F+B ticks pay the
+    depth twice: (2p−2)/(M+2p−2) vs the gpipe/remat (p−1)/(M+p−1))."""
+    if n_micro > 0:
+        M = n_micro
+    else:
+        M = min(2 * p, B)
+        while B % M:
+            M -= 1
+        if M < p:
+            import warnings
+
+            drain = 2 * (p - 1) if schedule == "1f1b" else p - 1
+            warnings.warn(
+                f"pipeline auto-microbatching picked M={M} < p={p} stages "
+                f"(batch {B} has no divisor in [p, 2p]); bubble fraction "
+                f"{drain / (M + drain):.0%} — set pipeline_microbatches "
+                "or pick a batch size divisible by a multiple of the "
+                "stage count", stacklevel=3,
+            )
+    assert B % M == 0, (
+        f"global batch {B} must divide into {M} pipeline microbatches "
+        "(set pipeline_microbatches to a divisor)"
+    )
+    return M
+
+
+def _transport_dtype(x):
+    """(transport dtype, compute dtype) for stage hops. XLA:CPU's
+    float-normalization pass CHECK-crashes ("Invalid binary instruction
+    opcode copy", hlo_instruction.cc) on bf16 ppermute/psum inside a
+    partial-manual region (minimal repro in the r4 notes; fp32 compiles
+    fine, and TPU has native bf16 collectives so the pass never fires
+    there). Off-TPU, move activations between stages in fp32 —
+    bf16->fp32->bf16 is exact, so the trajectory is bit-identical; the
+    2x hop bytes only exist on the CPU harness."""
+    f32_transport = (x.dtype == jnp.bfloat16
+                     and jax.default_backend() != "tpu")
+    return (jnp.float32 if f32_transport else x.dtype), x.dtype
+
+
+def _build_apply_layer(graphdef, call, aux0, remat, remat_policy):
+    """Per-layer application shared by every schedule: plain lax.scan +
+    direct module call instead of scan_layer_stack (nnx transforms refuse
+    graph nodes created at an outer trace level, and this sits at
+    shard_map->scan(tick)->scan(layer) depth)."""
+
+    def apply_layer(layer_state, h):
+        blk = nnx.merge(graphdef, layer_state)
+        out = call(blk, h)
+        if aux0 is None:
+            return out, jnp.float32(0.0)
+        return out  # (h, aux) per the aux contract
+
+    if remat:
+        apply_layer = jax.checkpoint(
+            apply_layer, policy=resolve_remat_policy(remat_policy)
+        )
+    return apply_layer
+
+
+def _record_schedule_metrics(p, M, schedule):
+    """Trace-time obs accounting: walk _staircase over every (tick,
+    stage) slot of the schedule about to compile and record real vs
+    bubble tick-slots (counters cumulate once per region TRACE, not per
+    step — steady-state utilization is shape-static) plus the resulting
+    pp_bubble_frac gauge. 1f1b TRAINING ticks carry an F-slot AND a
+    B-slot (the backward staircase is _staircase at the reflected stage
+    2(p-1)-s) — its eval/no-grad trace runs the forward-only staircase
+    instead and must be recorded as such ('1f1b-eval', the else branch);
+    gpipe/remat count the forward staircase (their backward mirrors it,
+    so the fraction is identical). Called from inside each schedule BODY
+    so only the bodies that actually trace are counted."""
+    from avenir_tpu.obs.metrics import get_registry
+
+    # pure-python mirror of _staircase's is-real predicate (this runs
+    # INSIDE a jit trace, where jnp ops would return tracers)
+    is_real = lambda t, s: 0 <= t - s < M
+    real = bubble = 0
+    if schedule == "1f1b":
+        for t in range(M + 2 * p - 2):
+            for s in range(p):
+                f = is_real(t, s)
+                b = is_real(t, 2 * (p - 1) - s)
+                real += int(f) + int(b)
+                bubble += int(not f) + int(not b)
+    else:
+        for t in range(M + p - 1):
+            for s in range(p):
+                real += int(is_real(t, s))
+                bubble += int(not is_real(t, s))
+    reg = get_registry()
+    reg.gauge("pp_bubble_frac").set(bubble / max(1, real + bubble))
+    reg.counter("pipe_ticks_real").add(real)
+    reg.counter("pipe_ticks_bubble").add(bubble)
+
+
+def _use_psum_hop(p):
+    """True when stage hops must avoid lax.ppermute: the legacy
+    (jax 0.4.x) partial-auto shard_map lowering CHECK-crashes XLA's
+    SPMD partitioner on ppermute whenever any non-'pipe' mesh axis is
+    live ("Check failed: target.IsManualSubgroup() ==
+    sharding().IsManualSubgroup()"; minimal repro in the 1f1b PR — psum
+    in the same position lowers fine, as does ppermute on a pure-pipe
+    mesh where the auto product is 1). The psum emulation costs p x the
+    hop bytes and exists ONLY for the legacy compat runtime; modern jax
+    and pure-pipe meshes keep the point-to-point ppermute."""
+    from avenir_tpu import compat
+
+    if getattr(jax, "shard_map", None) is not compat.shard_map:
+        return False
+    mesh = jax.sharding.get_abstract_mesh()
+    other = 1
+    for n, sz in dict(mesh.shape).items():
+        if n != PIPE_AXIS:
+            other *= sz
+    return other > 1
+
+
+def _make_hops(p, s, use_psum):
+    """(hop_down, hop_up): move a per-stage array one stage downstream /
+    upstream, zero-filling the edge stage exactly like the partial
+    ppermute they normally are. `use_psum` (static, from _use_psum_hop)
+    swaps in the masked-psum emulation: all stages contribute their
+    slot of a (p, ...) one-hot expansion, psum makes it whole, and each
+    stage gathers its neighbor's row."""
+    if not use_psum:
+        fwd_perm = [(i, i + 1) for i in range(p - 1)]
+        bwd_perm = [(i + 1, i) for i in range(p - 1)]
+        return (lambda x: jax.lax.ppermute(x, PIPE_AXIS, fwd_perm),
+                lambda x: jax.lax.ppermute(x, PIPE_AXIS, bwd_perm))
+    oh = jnp.arange(p) == s
+
+    def allg(x):
+        return jax.lax.psum(
+            x[None] * oh.reshape((p,) + (1,) * x.ndim).astype(x.dtype),
+            PIPE_AXIS)
+
+    def down(x):
+        r = allg(x)[jnp.clip(s - 1, 0, p - 1)]
+        return jnp.where(s == 0, jnp.zeros_like(r), r)
+
+    def up(x):
+        r = allg(x)[jnp.clip(s + 1, 0, p - 1)]
+        return jnp.where(s == p - 1, jnp.zeros_like(r), r)
+
+    return down, up
 
 
 def layer_stack_dispatch(x, stacked, *, call, n_micro=0, remat=False,
@@ -117,18 +293,18 @@ def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
         ONLY each microbatch's stage INPUT (O(M) single activations per
         stage), and the backward re-runs the local stack per microbatch
         just-in-time in mirrored tick order, so per-layer residuals
-        exist for ONE microbatch at a time. This is the activation-stash
-        class 1F1B targets. What it is NOT: 1F1B's forward/backward
-        INTERLEAVING, which cannot exist under PP-as-pure-layout — the
-        backward of micro m may only start once the loss is known, and
-        the loss lives OUTSIDE this region (after the psum-broadcast,
-        in the model head); interleaving would require the per-micro
-        loss computed at the last stage inside the schedule, i.e. a
-        dedicated pipeline_train_step that owns embeddings/head/loss
-        rather than a layer-stack layout transform. Measured memory in
+        exist for ONE microbatch at a time. Measured memory in
         BASELINE.md "Pipeline cost table". MoE aux stats are gpipe-only
-        (the remat backward would need the aux cotangent threaded
-        through the recompute — fail-loud below).
+        here (the reverse-tick backward would need the aux cotangent
+        threaded through the recompute — fail-loud below).
+      - '1f1b' does NOT run through this function: true forward/backward
+        interleaving needs the per-micro loss computed at the last stage
+        INSIDE the schedule, so the models hand their head+loss tail to
+        `pipeline_1f1b_loss` instead (this layout transform returns
+        activations, which is the wrong boundary for it). Callers that
+        reach here with schedule='1f1b' — e.g. a 1f1b-configured model
+        called WITHOUT targets — should fall back to 'gpipe' (identical
+        forward, and with no loss there is no backward to interleave).
 
     `aux0` (optional, a pytree of fp32 BATCH-MEAN statistics — MoE
     router stats): `call(layer, h)` must then return (h, aux), and the
@@ -161,64 +337,17 @@ def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
         f"n_layer={n_layer} must divide over pipe={p} stages"
     )
     B = x.shape[0]
-    if n_micro > 0:
-        M = n_micro
-    else:
-        # auto: 2p microbatches amortize the (p-1)-tick bubble; clamp to
-        # the largest divisor of B (tiny test batches) — a small M only
-        # costs bubble fraction, never correctness
-        M = min(2 * p, B)
-        while B % M:
-            M -= 1
-        if M < p:
-            # e.g. prime B: auto-selection degraded below p and the
-            # bubble dominates ((p-1)/(M+p-1) >= 50%) — tell the user
-            # instead of silently serializing the pipeline
-            import warnings
-
-            warnings.warn(
-                f"pipeline auto-microbatching picked M={M} < p={p} stages "
-                f"(batch {B} has no divisor in [p, 2p]); bubble fraction "
-                f"{(p - 1) / (M + p - 1):.0%} — set pipeline_microbatches "
-                "or pick a batch size divisible by a multiple of the "
-                "stage count", stacklevel=2,
-            )
-    assert B % M == 0, (
-        f"global batch {B} must divide into {M} pipeline microbatches "
-        "(set pipeline_microbatches to a divisor)"
-    )
+    M = _resolve_micro(B, p, n_micro)
     state_specs = jax.tree.map(
         lambda a: P(PIPE_AXIS, *([None] * (a.ndim - 1))), state
     )
     x_spec = P(*([None] * x.ndim))
-    # XLA:CPU's float-normalization pass CHECK-crashes ("Invalid binary
-    # instruction opcode copy", hlo_instruction.cc) on bf16 ppermute/psum
-    # inside a partial-manual region (minimal repro in the r4 notes;
-    # fp32 compiles fine, and TPU has native bf16 collectives so the
-    # pass never fires there). Off-TPU, move activations between stages
-    # in fp32 — bf16->fp32->bf16 is exact, so the trajectory is
-    # bit-identical; the 2x hop bytes only exist on the CPU harness.
-    f32_transport = (x.dtype == jnp.bfloat16
-                     and jax.default_backend() != "tpu")
-    t_dtype = jnp.float32 if f32_transport else x.dtype
-    c_dtype = x.dtype  # the layers always compute in the original dtype
-
-    def apply_layer(layer_state, h):
-        # plain lax.scan + direct module call instead of scan_layer_stack:
-        # nnx transforms refuse graph nodes created at an outer trace
-        # level, and this sits at shard_map->scan(tick)->scan(layer) depth
-        blk = nnx.merge(graphdef, layer_state)
-        out = call(blk, h)
-        if aux0 is None:
-            return out, jnp.float32(0.0)
-        return out  # (h, aux) per the aux contract
-
-    if remat:
-        apply_layer = jax.checkpoint(
-            apply_layer, policy=resolve_remat_policy(remat_policy)
-        )
+    t_dtype, c_dtype = _transport_dtype(x)
+    apply_layer = _build_apply_layer(graphdef, call, aux0, remat,
+                                     remat_policy)
     aux_zero = (jax.tree.map(lambda a: jnp.zeros_like(a, jnp.float32), aux0)
                 if aux0 is not None else jnp.float32(0.0))
+    use_psum_hop = _use_psum_hop(p)
 
     if schedule == "remat":
         assert aux0 is None, (
@@ -229,18 +358,44 @@ def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
         )
         return _remat_schedule(x, state, p=p, M=M, apply_layer=apply_layer,
                                state_specs=state_specs, x_spec=x_spec,
-                               t_dtype=t_dtype, c_dtype=c_dtype)
+                               t_dtype=t_dtype, c_dtype=c_dtype,
+                               use_psum_hop=use_psum_hop)
     assert schedule == "gpipe", (
-        f"unknown pipeline_schedule {schedule!r}; one of 'gpipe', 'remat'"
+        f"unknown pipeline_schedule {schedule!r} for the layer-stack "
+        "transform; one of 'gpipe', 'remat' ('1f1b' owns the loss tail "
+        "and enters through pipeline_1f1b_loss)"
     )
 
-    def body(state_local, xl):
-        s = jax.lax.axis_index(PIPE_AXIS)
+    n_local = n_layer // p
+
+    def body(state_local, xl, sid):
+        _trace_events.append(("gpipe", "fwd"))
+        _record_schedule_metrics(p, M, schedule)
+        s = sid[0]  # stage index as DATA (in_spec P('pipe')): lax.
+        # axis_index lowers to a PartitionId instruction the legacy
+        # partial-auto lowering cannot SPMD-partition on meshes with
+        # live non-pipe axes — same ship-it-in trick ring_attention
+        # uses for its Shardy nesting limit
         Bg, T, C = xl.shape
         xm = xl.reshape(Bg // M, M, T, C)  # micro m = xm[:, m] (batch
         # dim 0 keeps its data/fsdp sharding; the micro dim is unsharded)
+        hop_down, _ = _make_hops(p, s, use_psum_hop)
 
         def run_local_stack(h):
+            if use_psum_hop:
+                # legacy-mixed harness: autodiff THROUGH a lax.scan
+                # inside a partial-auto region also CHECK-crashes the
+                # old SPMD partitioner (residual hoisting) — unroll the
+                # local layer loop; n_local is small and this path is
+                # CPU-tests-only (see _use_psum_hop)
+                aux_sum = None
+                for i in range(n_local):
+                    lyr = jax.tree.map(lambda a: a[i], state_local)
+                    h, a = apply_layer(lyr, h)
+                    aux_sum = (a if aux_sum is None
+                               else jax.tree.map(jnp.add, aux_sum, a))
+                return h, aux_sum
+
             def layer_body(h, layer_state):
                 h, aux = apply_layer(layer_state, h)
                 return h, aux
@@ -253,10 +408,7 @@ def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
             mi, real = _staircase(t, s, M)
             inp = jnp.where(s == 0, xm[:, mi], recv).astype(c_dtype)
             out, aux_m = run_local_stack(inp)
-            recv_next = jax.lax.ppermute(
-                out.astype(t_dtype), PIPE_AXIS,
-                [(i, i + 1) for i in range(p - 1)]
-            )
+            recv_next = hop_down(out.astype(t_dtype))
             # real: this stage processed a REAL microbatch this tick (not
             # a warmup/drain bubble) — its aux contribution counts
             aux_acc = jax.tree.map(
@@ -267,11 +419,16 @@ def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
                              outs)
             return (outs, recv_next, aux_acc), None
 
-        (outs, _, aux_acc), _ = jax.lax.scan(
-            tick, (jnp.zeros(xm.shape, t_dtype),
-                   jnp.zeros(xm[:, 0].shape, t_dtype), aux_zero),
-            jnp.arange(M + p - 1),
-        )
+        init = (jnp.zeros(xm.shape, t_dtype),
+                jnp.zeros(xm[:, 0].shape, t_dtype), aux_zero)
+        if use_psum_hop:
+            carry = init  # unrolled ticks, same reason as the layer loop
+            for t in range(M + p - 1):
+                carry, _ = tick(carry, t)
+            outs, _, aux_acc = carry
+        else:
+            (outs, _, aux_acc), _ = jax.lax.scan(
+                tick, init, jnp.arange(M + p - 1))
         # only stage p-1 holds real outputs; masked psum broadcasts them.
         # The region returns t_dtype: its replicated-over-pipe output
         # transposes to a psum of the COTANGENT at the boundary, which
@@ -288,12 +445,14 @@ def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
 
     aux_specs = jax.tree.map(lambda a: P(*([None] * a.ndim)), aux_zero)
     f = jax.shard_map(
-        body, in_specs=(state_specs, x_spec), out_specs=(x_spec, aux_specs),
+        body, in_specs=(state_specs, x_spec, P(PIPE_AXIS)),
+        out_specs=(x_spec, aux_specs),
         check_vma=False, axis_names={PIPE_AXIS},
     )
     # also keep the region INPUT in t_dtype: its cotangent rides the
     # reverse boundary the same way
-    out, aux_tot = f(state, x.astype(t_dtype))
+    out, aux_tot = f(state, x.astype(t_dtype),
+                     jnp.arange(p, dtype=jnp.int32))
     out = out.astype(x.dtype)
     if aux0 is None:
         return out
@@ -301,7 +460,7 @@ def pipeline_layer_stack(x, stacked, *, call=None, n_micro=0, remat=False,
 
 
 def _remat_schedule(x, state, *, p, M, apply_layer, state_specs, x_spec,
-                    t_dtype, c_dtype):
+                    t_dtype, c_dtype, use_psum_hop=False):
     """The 'remat' pipeline backward (see pipeline_layer_stack): a
     custom-vjp pair of shard_map regions, both manual only over 'pipe'.
 
@@ -320,10 +479,19 @@ def _remat_schedule(x, state, *, p, M, apply_layer, state_specs, x_spec,
     forward uses, mirrored. Per-layer residuals therefore exist for ONE
     microbatch per stage at any time, instead of for every in-flight
     microbatch across the whole tick scan."""
-    fwd_perm = [(i, i + 1) for i in range(p - 1)]
-    bwd_perm = [(i + 1, i) for i in range(p - 1)]
+
+    n_local = jax.tree.leaves(state)[0].shape[0] // p
 
     def run_local(state_local, h):
+        if use_psum_hop:
+            # legacy-mixed harness: unrolled, like every other schedule
+            # body here (scans in these regions trip the old SPMD
+            # partitioner — see _use_psum_hop)
+            for i in range(n_local):
+                lyr = jax.tree.map(lambda a: a[i], state_local)
+                h, _ = apply_layer(lyr, h)
+            return h
+
         def layer_body(h, layer_state):
             h, _ = apply_layer(layer_state, h)
             return h, None
@@ -331,10 +499,13 @@ def _remat_schedule(x, state, *, p, M, apply_layer, state_specs, x_spec,
         out, _ = jax.lax.scan(layer_body, h, state_local)
         return out
 
-    def fwd_body(state_local, xl):
-        s = jax.lax.axis_index(PIPE_AXIS)
+    def fwd_body(state_local, xl, sid):
+        _trace_events.append(("remat", "fwd"))
+        _record_schedule_metrics(p, M, "remat")
+        s = sid[0]  # stage-as-data, see pipeline_layer_stack body
         Bg, T, C = xl.shape
         xm = xl.reshape(Bg // M, M, T, C)
+        hop_down, _ = _make_hops(p, s, use_psum_hop)
 
         def tick(carry, t):
             outs, recv, stash = carry
@@ -342,7 +513,7 @@ def _remat_schedule(x, state, *, p, M, apply_layer, state_specs, x_spec,
             inp = jnp.where(s == 0, xm[:, mi], recv)
             stash = jnp.where(real, stash.at[mi].set(inp), stash)
             out = run_local(state_local, inp.astype(c_dtype)).astype(t_dtype)
-            recv_next = jax.lax.ppermute(out, PIPE_AXIS, fwd_perm)
+            recv_next = hop_down(out)
             active = jnp.logical_and(s == p - 1, real)
             outs = jnp.where(active, outs.at[:, mi].set(out), outs)
             return (outs, recv_next, stash), None
@@ -351,22 +522,31 @@ def _remat_schedule(x, state, *, p, M, apply_layer, state_specs, x_spec,
         init = (jnp.zeros(xm.shape, t_dtype),
                 jnp.zeros((Bm, T, C), t_dtype),
                 jnp.zeros((M, Bm, T, C), t_dtype))
-        (outs, _, stash), _ = jax.lax.scan(tick, init, jnp.arange(M + p - 1))
+        if use_psum_hop:
+            carry = init  # unrolled ticks (legacy-mixed, _use_psum_hop)
+            for t in range(M + p - 1):
+                carry, _ = tick(carry, t)
+            outs, _, stash = carry
+        else:
+            (outs, _, stash), _ = jax.lax.scan(tick, init,
+                                               jnp.arange(M + p - 1))
         outs = jnp.where(s == p - 1, outs, jnp.zeros_like(outs))
         outs = jax.lax.psum(outs, PIPE_AXIS)
         return outs.reshape(Bg, T, C), stash
 
     stash_spec = P(PIPE_AXIS, *([None] * x.ndim))
+    sid_spec = P(PIPE_AXIS)
     f_fwd = jax.shard_map(
-        fwd_body, in_specs=(state_specs, x_spec),
+        fwd_body, in_specs=(state_specs, x_spec, sid_spec),
         out_specs=(x_spec, stash_spec),
         check_vma=False, axis_names={PIPE_AXIS},
     )
 
-    def bwd_body(state_local, stash_local, dout):
-        s = jax.lax.axis_index(PIPE_AXIS)
+    def bwd_body(state_local, stash_local, dout, sid):
+        s = sid[0]
         Bg, T, C = dout.shape
         dm = dout.reshape(Bg // M, M, T, C)
+        _, hop_up = _make_hops(p, s, use_psum_hop)
 
         def stage_fn(st, h):
             return run_local(st, h.astype(c_dtype)).astype(t_dtype)
@@ -383,37 +563,360 @@ def _remat_schedule(x, state, *, p, M, apply_layer, state_specs, x_spec,
             )
             first = jnp.logical_and(s == 0, real)
             dxm = jnp.where(first, dxm.at[:, mi].set(dinp), dxm)
-            drecv_next = jax.lax.ppermute(dinp, PIPE_AXIS, bwd_perm)
+            drecv_next = hop_up(dinp)
             return (dstate, drecv_next, dxm), None
 
         init = (jax.tree.map(jnp.zeros_like, state_local),
                 jnp.zeros_like(dm[:, 0]), jnp.zeros_like(dm))
-        (dstate, _, dxm), _ = jax.lax.scan(tick, init,
-                                           jnp.arange(M + p - 1))
+        if use_psum_hop:
+            carry = init  # unrolled reverse ticks (legacy-mixed)
+            for tt in range(M + p - 1):
+                carry, _ = tick(carry, tt)
+            dstate, _, dxm = carry
+        else:
+            (dstate, _, dxm), _ = jax.lax.scan(tick, init,
+                                               jnp.arange(M + p - 1))
         dxm = jnp.where(s == 0, dxm, jnp.zeros_like(dxm))
         dxm = jax.lax.psum(dxm, PIPE_AXIS)
         return dstate, dxm.reshape(Bg, T, C)
 
     f_bwd = jax.shard_map(
-        bwd_body, in_specs=(state_specs, stash_spec, x_spec),
+        bwd_body, in_specs=(state_specs, stash_spec, x_spec, sid_spec),
         out_specs=(state_specs, x_spec),
         check_vma=False, axis_names={PIPE_AXIS},
     )
+    sid = jnp.arange(p, dtype=jnp.int32)
 
     @jax.custom_vjp
     def run(state, xl):
-        outs, _ = f_fwd(state, xl)
+        outs, _ = f_fwd(state, xl, sid)
         return outs
 
     def run_fwd(state, xl):
-        outs, stash = f_fwd(state, xl)
+        outs, stash = f_fwd(state, xl, sid)
         return outs, (state, stash)
 
     def run_bwd(res, dout):
         state, stash = res
-        dstate, dx = f_bwd(state, stash, dout.astype(t_dtype))
+        dstate, dx = f_bwd(state, stash, dout.astype(t_dtype), sid)
         return dstate, dx
 
     run.defvjp(run_fwd, run_bwd)
     out = run(state, x.astype(t_dtype))
     return out.astype(x.dtype)
+
+
+def pipeline_1f1b_loss(x, stacked, targets, *, call=None, tail_fn,
+                       tail_params, n_valid, n_micro=0, remat=False,
+                       remat_policy=None, aux0=None):
+    """True 1F1B (PipeDream-Flush): the pipeline region that OWNS the
+    loss tail. Returns the scalar training loss
+        sum_m loss_sum_m / max(n_valid, 1)  +  sum_m aux_m / M
+    where `tail_fn(tail_params, h, y_micro, stats) -> (loss_sum, aux)`
+    is the model's final-norm + head + chunked-CE tail (blocked impl —
+    plain jnp, so inside this manual-over-'pipe' region every other mesh
+    axis stays GSPMD: vocab stays tensor-sharded and the row reductions
+    psum over 'tensor' exactly as outside; nested shard_map wraps (the
+    pallas flash attention, ring/ulysses) keep composing because they
+    name only the free axes — partition.free_axis_names) and `n_valid`
+    is the model-computed global non-ignored target count (the CE
+    normalizer — per-micro loss SUMS therefore reduce to exactly the
+    full-batch mean, bit-honest with grad_accum semantics).
+
+    The schedule: combined F+B ticks t = 0..M+2p-3. Stage s forwards
+    micro t-s (the gpipe staircase: at most the pipeline depth of
+    forwards ahead) and backwards micro t-(2(p-1)-s) — the SAME
+    staircase at the reflected stage index, i.e. the last stage runs
+    the tail and starts micro m's backward in the very tick that
+    finished its forward, then alternates 1 forward / 1 backward per
+    tick while cotangents ride `lax.ppermute` upstream in the same tick
+    activations ride downstream. In-flight micros at stage s are
+    bounded by 2(p-1-s)+1 — the forward-only warmup depth plus the
+    cotangent return trip — so the stage-input stash is a fixed ring of
+    W = min(2p-1, M) slots, INDEPENDENT OF M (gpipe stashes per-layer
+    residuals for all M+p-1 ticks; 'remat' stashes M stage inputs).
+    That bound is what lets M grow far past 2p and shrink the bubble
+    (2p-2)/(M+2p-2) without the activation memory growing with it.
+    Backward ticks recompute the local stack from the stashed input
+    under jax.vjp ('remat'-class FLOPs: one extra stack forward per
+    micro).
+
+    Autodiff wiring: forward AND backward interleave in ONE region, so
+    under jax.grad the region's custom-vjp FORWARD runs the interleaved
+    schedule and computes the gradients as it goes (the cotangent seed
+    of every per-micro contribution is known upfront — 1/n_valid and
+    1/M — and the outer cotangent is a scalar the vjp multiplies in by
+    linearity); the residuals ARE the finished grads. The undifferentiated
+    primal (eval) runs a forward-only staircase instead — same loss
+    value, no backward cost.
+
+    MoE (`aux0` + `call` returning (h, stats)): router stats ride the
+    ppermute payload per-micro and the LAST stage computes micro m's
+    aux loss from its own accumulated stats — per-micro aux semantics,
+    exactly the micro-batched oracle (the mean of M independent B/M
+    strided forwards, aux included), NOT gpipe's aggregate-stats-first
+    aux (the aux is nonlinear in the stats, so the two differ; gpipe
+    keeps the faithful-to-full-batch choice, 1f1b keeps the
+    faithful-to-interleaving one — pinned by
+    test_1f1b_mixtral_matches_microbatched_oracle). Capacity stays
+    per-micro, like every pipeline schedule here."""
+    p = pipeline_axis_size()
+    assert p > 1, "pipeline_1f1b_loss requires a pipe axis > 1"
+    if call is None:
+        call = lambda lyr, h: lyr(h)
+    graphdef, state = nnx.split(stacked)
+    n_layer = jax.tree.leaves(state)[0].shape[0]
+    assert n_layer % p == 0, (
+        f"n_layer={n_layer} must divide over pipe={p} stages"
+    )
+    B, T = targets.shape
+    assert x.shape[0] == B
+    n_local = n_layer // p
+    M = _resolve_micro(B, p, n_micro, schedule="1f1b")
+    W = min(2 * p - 1, M)
+    n_ticks = M + 2 * p - 2
+    inv_M = 1.0 / M
+    state_specs = jax.tree.map(
+        lambda a: P(PIPE_AXIS, *([None] * (a.ndim - 1))), state
+    )
+    x_spec = P(*([None] * x.ndim))
+    y_spec = P(None, None)
+    tp_specs = jax.tree.map(lambda a: P(*([None] * jnp.ndim(a))),
+                            tail_params)
+    t_dtype, c_dtype = _transport_dtype(x)
+    apply_layer = _build_apply_layer(graphdef, call, aux0, remat,
+                                     remat_policy)
+    aux_zero = (jax.tree.map(lambda a: jnp.zeros_like(a, jnp.float32), aux0)
+                if aux0 is not None else jnp.float32(0.0))
+    use_psum_hop = _use_psum_hop(p)
+    tsel = lambda pred, a, b: jax.tree.map(
+        lambda u, v: jnp.where(pred, u, v), a, b)
+
+    def make_tick_fn(s, ym, inv_nv):
+        """One stage's whole tick-slot as ONE differentiable function
+        (state, h_in, stats_in, tail_params) -> (h_out, stats_out,
+        loss-contribution): the local stack, then — masked to the last
+        stage — the loss tail on the finished micro. One jax.vjp of this
+        at the stashed input yields the stage backward AND (on the last
+        stage) the tail backward in the same call; non-last stages' tail
+        work is masked to zero contribution (their tick wall-time is
+        bounded by the last stage's real tail anyway — SPMD lockstep)."""
+
+        def stage_fn(state_local, h_in, st_in):
+            if use_psum_hop:
+                # legacy-mixed harness: scans in this region (even under
+                # the in-region vjp) trip the old SPMD partitioner —
+                # unroll, same as the gpipe body (see _use_psum_hop)
+                h, st = h_in.astype(c_dtype), st_in
+                for i in range(n_local):
+                    lyr = jax.tree.map(lambda a: a[i], state_local)
+                    h, a = apply_layer(lyr, h)
+                    if aux0 is not None:
+                        st = jax.tree.map(jnp.add, st, a)
+                return h.astype(t_dtype), st
+
+            def layer_body(carry, layer_state):
+                h, st = carry
+                h, a = apply_layer(layer_state, h)
+                if aux0 is not None:
+                    st = jax.tree.map(jnp.add, st, a)
+                return (h, st), None
+
+            (h, st), _ = jax.lax.scan(
+                layer_body, (h_in.astype(c_dtype), st_in), state_local)
+            return h.astype(t_dtype), st
+
+        def tick_fn(state_local, h_in, st_in, tp, m):
+            h_out, st_out = stage_fn(state_local, h_in, st_in)
+            y_m = jax.lax.dynamic_index_in_dim(ym, m, axis=1,
+                                               keepdims=False)
+            ls, aux = tail_fn(tp, h_out.astype(c_dtype), y_m, st_out)
+            contrib = ls.astype(jnp.float32) * inv_nv \
+                + aux.astype(jnp.float32) * inv_M
+            return h_out, st_out, jnp.where(s == p - 1, contrib, 0.0)
+
+        return tick_fn
+
+    def _common(xl, yl, n_valid_r, sid):
+        s = sid[0]  # stage-as-data, see pipeline_layer_stack body
+        Bg = xl.shape[0]
+        xm = xl.reshape(Bg // M, M, *xl.shape[1:])
+        ym = yl.reshape(Bg // M, M, T)
+        inv_nv = 1.0 / jnp.maximum(n_valid_r, 1).astype(jnp.float32)
+        hop_down, hop_up = _make_hops(p, s, use_psum_hop)
+        # stats payload hops only exist for aux families — a non-aux
+        # model's stats carry is a constant 0 and never earns a collective
+        if aux0 is not None:
+            st_down = lambda st: jax.tree.map(hop_down, st)
+            st_up = lambda st: jax.tree.map(hop_up, st)
+        else:
+            st_down = st_up = lambda st: st
+        return s, xm, ym, make_tick_fn(s, ym, inv_nv), (hop_down, hop_up,
+                                                        st_down, st_up)
+
+    def fwd_only_body(state_local, xl, yl, tp, n_valid_r, sid):
+        """The undifferentiated primal: plain gpipe staircase + per-micro
+        tail at the last stage — same accumulation order as the
+        interleaved schedule (micro order at stage p-1), no stash, no
+        backward. Eval pays forward-only cost."""
+        _trace_events.append(("1f1b", "fwd_only"))
+        _record_schedule_metrics(p, M, "1f1b-eval")
+        s, xm, ym, tick_fn, hops = _common(xl, yl, n_valid_r, sid)
+        hop_down, _, st_down, _ = hops
+
+        def tick(carry, t):
+            recv_h, recv_st, acc = carry
+            mi, real = _staircase(t, s, M)
+            inp_h = jnp.where(s == 0, xm[:, mi], recv_h)
+            inp_st = tsel(s == 0, aux_zero, recv_st)
+            h_out, st_out, contrib = tick_fn(state_local, inp_h, inp_st,
+                                             tp, mi)
+            acc = acc + jnp.where(real, contrib, 0.0)
+            recv_h = hop_down(h_out)
+            recv_st = st_down(st_out)
+            return (recv_h, recv_st, acc), None
+
+        Bm = xl.shape[0] // M
+        init = (jnp.zeros((Bm,) + xl.shape[1:], t_dtype), aux_zero,
+                jnp.float32(0.0))
+        if use_psum_hop:
+            carry = init  # unrolled ticks (legacy-mixed, _use_psum_hop)
+            for t in range(M + p - 1):
+                carry, _ = tick(carry, t)
+            acc = carry[2]
+        else:
+            (_, _, acc), _ = jax.lax.scan(tick, init,
+                                          jnp.arange(M + p - 1))
+        return jax.lax.psum(acc, PIPE_AXIS)
+
+    def interleaved_body(state_local, xl, yl, tp, n_valid_r, sid):
+        """The 1F1B schedule proper: every tick runs one forward
+        half-slot and one backward half-slot (each masked by its own
+        staircase), hops the activation downstream and the cotangent
+        upstream, and accumulates grads in the carry. Returns the loss
+        AND the finished (dstate, dx, dtail) — gradient-in-forward, see
+        the custom-vjp note in the function docstring."""
+        _trace_events.append(("1f1b", "interleaved"))
+        _record_schedule_metrics(p, M, "1f1b")
+        s, xm, ym, tick_fn, hops = _common(xl, yl, n_valid_r, sid)
+        hop_down, hop_up, st_down, st_up = hops
+        Bm = xl.shape[0] // M
+        h_shape = (Bm,) + xl.shape[1:]
+        refl = 2 * (p - 1) - s  # backward staircase = fwd at reflected s
+
+        def tick(carry, t):
+            (recv_h, recv_st, recv_dh, recv_dst, stash_h, stash_st,
+             dstate, dxm, dtp, acc) = carry
+
+            # ---- forward half-slot: micro t-s ----
+            mf, f_real = _staircase(t, s, M)
+            inp_h = jnp.where(s == 0, xm[:, mf], recv_h)
+            inp_st = tsel(s == 0, aux_zero, recv_st)
+            slot_f = mf % W
+            stash_h = jnp.where(f_real, stash_h.at[slot_f].set(inp_h),
+                                stash_h)
+            stash_st = tsel(f_real,
+                            jax.tree.map(lambda b, v: b.at[slot_f].set(v),
+                                         stash_st, inp_st),
+                            stash_st)
+            h_out, st_out, contrib = tick_fn(state_local, inp_h, inp_st,
+                                             tp, mf)
+            acc = acc + jnp.where(f_real, contrib, 0.0)
+
+            # ---- backward half-slot: micro t-(2(p-1)-s) ----
+            # recompute the stage from its stashed input under jax.vjp;
+            # the contribution seed 1.0 is exact because every micro's
+            # loss contribution enters the total as a plain sum (outer
+            # cotangent scaling happens in run_bwd by linearity). The
+            # last stage's h_out cotangent arrives only THROUGH the tail
+            # (the upstream hop has no source for it: recv_dh is zeros
+            # there by construction, in both hop implementations).
+            mb, b_real = _staircase(t, refl, M)
+            slot_b = mb % W
+            _, vjp_fn = jax.vjp(
+                lambda st_, h_, a_, tp_: tick_fn(st_, h_, a_, tp_, mb),
+                state_local, stash_h[slot_b],
+                jax.tree.map(lambda b: b[slot_b], stash_st), tp)
+            dst_i, dh_i, dsti, dtp_i = vjp_fn(
+                (recv_dh, recv_dst, jnp.float32(1.0)))
+            zero_if_bubble = lambda acc_t, g_t: jax.tree.map(
+                lambda a, g: a + jnp.where(b_real, g, jnp.zeros_like(g)),
+                acc_t, g_t)
+            dstate = zero_if_bubble(dstate, dst_i)
+            dtp = zero_if_bubble(dtp, dtp_i)
+            first = jnp.logical_and(s == 0, b_real)
+            dxm = jnp.where(first, dxm.at[:, mb].set(dh_i), dxm)
+
+            # ---- hops: activation+stats down, cotangents up ----
+            recv_h = hop_down(h_out)
+            recv_st = st_down(st_out)
+            recv_dh = hop_up(dh_i)
+            recv_dst = st_up(dsti)
+            return (recv_h, recv_st, recv_dh, recv_dst, stash_h, stash_st,
+                    dstate, dxm, dtp, acc), None
+
+        stack = lambda tree: jax.tree.map(
+            lambda a: jnp.zeros((W,) + a.shape, a.dtype), tree)
+        init = (
+            jnp.zeros(h_shape, t_dtype), aux_zero,          # fwd payload
+            jnp.zeros(h_shape, t_dtype), aux_zero,          # bwd payload
+            jnp.zeros((W,) + h_shape, t_dtype), stack(aux_zero),  # stash
+            jax.tree.map(jnp.zeros_like, state_local),      # dstate
+            jnp.zeros((Bm, M) + xl.shape[1:], t_dtype),     # dxm
+            jax.tree.map(jnp.zeros_like, tp),               # dtail
+            jnp.float32(0.0),                               # loss acc
+        )
+        if use_psum_hop:
+            carry = init  # unrolled ticks (legacy-mixed, _use_psum_hop)
+            for t in range(n_ticks):
+                carry, _ = tick(carry, t)
+        else:
+            carry, _ = jax.lax.scan(tick, init, jnp.arange(n_ticks))
+        (_, _, _, _, _, _, dstate, dxm, dtp, acc) = carry
+        loss = jax.lax.psum(acc, PIPE_AXIS)
+        dxm = jnp.where(s == 0, dxm, jnp.zeros_like(dxm))
+        dx = jax.lax.psum(dxm, PIPE_AXIS).reshape(xl.shape)
+        # dtail is nonzero only where the masked contrib had gradient
+        # (the last stage); psum replicates it over 'pipe' for export
+        dtp = jax.tree.map(lambda a: jax.lax.psum(a, PIPE_AXIS), dtp)
+        return loss, dstate, dx, dtp
+
+    scalar_spec = P()
+    sid_spec = P(PIPE_AXIS)
+    sid = jnp.arange(p, dtype=jnp.int32)
+    f_primal = jax.shard_map(
+        fwd_only_body,
+        in_specs=(state_specs, x_spec, y_spec, tp_specs, scalar_spec,
+                  sid_spec),
+        out_specs=scalar_spec, check_vma=False, axis_names={PIPE_AXIS},
+    )
+    f_train = jax.shard_map(
+        interleaved_body,
+        in_specs=(state_specs, x_spec, y_spec, tp_specs, scalar_spec,
+                  sid_spec),
+        out_specs=(scalar_spec, state_specs, x_spec, tp_specs),
+        check_vma=False, axis_names={PIPE_AXIS},
+    )
+
+    @jax.custom_vjp
+    def run(state, xl, tp, yl, nv):
+        return f_primal(state, xl, yl, tp, nv, sid)
+
+    def run_fwd(state, xl, tp, yl, nv):
+        loss, dstate, dx, dtp = f_train(state, xl, yl, tp, nv, sid)
+        return loss, (dstate, dx, dtp)
+
+    def run_bwd(res, g):
+        dstate, dx, dtp = res
+        import numpy as np
+
+        scale = lambda t: jax.tree.map(
+            lambda a: (a * g).astype(a.dtype), t)
+        # int inputs (targets, n_valid) have float0 cotangents
+        return (scale(dstate), (dx * g).astype(dx.dtype), scale(dtp),
+                np.zeros((B, T), jax.dtypes.float0),
+                np.zeros((), jax.dtypes.float0))
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(state, x.astype(t_dtype), tail_params,
+               targets.astype(jnp.int32), jnp.asarray(n_valid, jnp.int32))

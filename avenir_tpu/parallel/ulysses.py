@@ -49,24 +49,54 @@ import jax.numpy as jnp
 from avenir_tpu.parallel.ring_attention import context_shard_map
 
 
-def _build_body(axis_name):
-    def body(q, k, v):
-        # local stripes: q (B, T/c, H, D), k/v (B, T/c, H_kv, D)
+def _build_body(axis_name, psum_a2a=False):
+    def body(q, k, v, pos):
+        # local stripes: q (B, T/c, H, D), k/v (B, T/c, H_kv, D). `pos`
+        # is this device's context index shipped in as data (ring-style),
+        # consumed only by the psum-emulated all-to-all below.
         c = jax.lax.axis_size(axis_name)
+        idx = pos[0]
         H, H_kv = q.shape[2], k.shape[2]
         assert H % c == 0, (
             f"ulysses needs context axis ({c}) to divide n_head ({H})"
         )
         assert H_kv % c == 0  # wrapper guarantees (repeats otherwise)
 
+        def _gather(x):
+            # masked-psum all-gather over the context axis: (c, *x.shape)
+            oh = jnp.arange(c) == idx
+            return jax.lax.psum(
+                x[None] * oh.reshape((c,) + (1,) * x.ndim).astype(x.dtype),
+                axis_name)
+
         def seq_to_heads(x):
             # (B, T/c, h, D) -> (B, T, h/c, D)
-            return jax.lax.all_to_all(x, axis_name, split_axis=2,
-                                      concat_axis=1, tiled=True)
+            if not psum_a2a:
+                return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                          concat_axis=1, tiled=True)
+            # legacy harness, nested inside another manual region: the
+            # partial-auto all_to_all cannot lower (same class as the
+            # pipeline/ring ppermute breakage — parallel/pipeline.
+            # _use_psum_hop) — emulate: gather every sender's stripe,
+            # take this device's head chunk of each, concat in sender
+            # order along the sequence (== tiled all_to_all semantics)
+            full = _gather(x)
+            hc = x.shape[2] // c
+            return jnp.concatenate(
+                [jax.lax.dynamic_slice_in_dim(full[j], idx * hc, hc,
+                                              axis=2)
+                 for j in range(c)], axis=1)
 
         def heads_to_seq(x):
-            return jax.lax.all_to_all(x, axis_name, split_axis=1,
-                                      concat_axis=2, tiled=True)
+            if not psum_a2a:
+                return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                          concat_axis=2, tiled=True)
+            full = _gather(x)
+            tl = x.shape[1] // c
+            return jnp.concatenate(
+                [jax.lax.dynamic_slice_in_dim(full[j], idx * tl, tl,
+                                              axis=1)
+                 for j in range(c)], axis=2)
 
         qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
         from avenir_tpu.ops.attention import causal_attention
@@ -105,5 +135,17 @@ def ulysses_causal_attention(q, k, v, *, axis_name="context", mesh=None,
                     if group % r == 0 and (H_kv * r) % c == 0), group)
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    body = _build_body(axis_name)
-    return context_shard_map(body, axis_name=axis_name, mesh=mesh)(q, k, v)
+    from jax.sharding import PartitionSpec as P
+
+    from avenir_tpu import compat
+
+    # nested inside another manual region on the legacy runtime: the
+    # all-to-alls cannot lower there — psum-emulated re-shard instead
+    # (same gate as ring_attention's psum rotation)
+    psum_a2a = (getattr(jax, "shard_map", None) is compat.shard_map
+                and bool(getattr(compat._manual_axes, "names",
+                                 frozenset())))
+    body = _build_body(axis_name, psum_a2a)
+    pos = jnp.arange(c, dtype=jnp.int32)
+    return context_shard_map(body, axis_name=axis_name, mesh=mesh,
+                             extra_in_specs=(P(axis_name),))(q, k, v, pos)

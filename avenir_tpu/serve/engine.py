@@ -168,12 +168,14 @@ class Engine:
                 top_k=upd(pool.top_k, top_k[None], (slot,)),
             )
 
-        # ONE step variant on purpose: slots with top_k=None carry k=V,
-        # whose mask is an exact no-op but still pays the per-row sort.
-        # A static no-top-k variant would skip the sort for all-None
-        # batches at the price of a SECOND decode-step compile — and the
-        # engine's compile budget (buckets + 1 decode step, asserted)
-        # is the contract we keep; top-k is the common serving case.
+        # ONE step variant on purpose: the engine's compile budget
+        # (buckets + 1 decode step, asserted) is the contract we keep.
+        # Slots with top_k=None (and every EMPTY slot — the pool default)
+        # carry k=V, an exactly-no-op mask; _sample_rows now skips the
+        # per-row full-vocab sort at RUNTIME via a batch-level lax.cond
+        # whenever no live row carries a real top-k, inside the same
+        # compiled step — so all-no-top-k batches (and idle padding-only
+        # ones) stop paying the sort without a second compile.
         @functools.partial(jax.jit, donate_argnums=(1,))
         def _step(state, pool, active):
             traces["step"].append(True)
@@ -305,6 +307,15 @@ class Engine:
         req = live.req
         del self._live[slot]
         self.sched.release(slot)
+        # restore the slot's sampling params to the pool default (k=V =
+        # "no top-k") — a recycled-but-empty slot must not keep its last
+        # request's finite k, or the _sample_rows runtime sort-skip
+        # (all rows >= V) would never fire again after the first top-k
+        # request. One tiny host-driven update per FINISHED request,
+        # nowhere near the per-token path.
+        V = self.pool.logits.shape[-1]
+        self.pool = self.pool._replace(
+            top_k=self.pool.top_k.at[slot].set(V))
         n_out = len(live.emitted)
         ttft_ms = (live.t_first - req.submit_t) * 1e3
         tpot_ms = ((live.t_last - live.t_first) / (n_out - 1) * 1e3

@@ -244,6 +244,7 @@ def run_training(cfg):
     best_val_loss = 1e9
     ckpt = None
     ckpt_sharded = None
+    sh_meta = None
     hf_init = None
     if cfg["init_from"] == "scratch":
         model_args["vocab_size"] = meta_vocab_size if meta_vocab_size else 50304
@@ -265,13 +266,16 @@ def run_training(cfg):
                 sh_meta = None
             elif sh_meta is not None:
                 ckpt = None
-        if sh_meta is not None:
-            ckpt_sharded = load_sharded_checkpoint(cfg["out_dir"])
-        assert ckpt is not None or ckpt_sharded is not None, (
+        # NB the sharded BODIES are read only after setup_state below:
+        # the locality-aware loader needs the mesh shardings to read just
+        # the shard files whose index ranges intersect this process's
+        # addressable shards (advisor r5 — kills the O(N×ckpt) read
+        # amplification docs/OPERATIONS.md used to document as a cost)
+        assert ckpt is not None or sh_meta is not None, (
             f"init_from=resume but {cfg['out_dir']} has neither ckpt.pt "
             "nor a complete ckpt-shard-*.pkl set"
         )
-        src = ckpt if ckpt is not None else ckpt_sharded
+        src = ckpt if ckpt is not None else sh_meta
         for k in ("n_layer", "n_head", "n_embd", "block_size", "bias", "vocab_size"):
             model_args[k] = src["model_args"][k]
         # coerce NOW: lazy/tensor scalars must not outlive the ckpt file
@@ -303,6 +307,19 @@ def run_training(cfg):
 
     st = setup_state(cfg, mesh, model_args)
     graphdef, shardings = st["graphdef"], st["shardings"]
+    if cfg["init_from"] == "resume" and sh_meta is not None:
+        # body read, now that the shardings say which index ranges this
+        # process actually hosts — only intersecting files are opened
+        from avenir_tpu.checkpoint.io import local_shard_ranges
+
+        ckpt_sharded = load_sharded_checkpoint(
+            cfg["out_dir"],
+            local_ranges=local_shard_ranges(st["abs_state"], shardings),
+        )
+        assert ckpt_sharded is not None, (
+            f"sharded set in {cfg['out_dir']} disappeared or tore between "
+            "the header check and the body read"
+        )
     if master:
         # print the RESOLVED hot-path impls — a silent fallback to the slow
         # path on a misconfigured pod must be visible at startup
@@ -315,6 +332,16 @@ def run_training(cfg):
         )
         loss_resolved = resolve_loss_impl(
             getattr(st["model_config"], "loss_impl", "reference"))
+        if mesh.shape.get("pipe", 1) > 1:
+            # on a pipe mesh the SCHEDULE decides the train-loss path:
+            # 1f1b runs the blocked tail inside the pipeline region
+            # regardless of loss_impl — say so, same no-silent-fallback
+            # policy as the attn/loss lines
+            sched = cfg.get("pipeline_schedule", "gpipe")
+            if sched == "1f1b":
+                loss_resolved = "blocked (inside 1f1b pipeline region)"
+            print(f"[tpu] pipeline_schedule={sched} "
+                  f"microbatches={cfg.get('pipeline_microbatches', 0) or 'auto'}")
         print(f"[tpu] attention={attn_resolved} loss={loss_resolved} "
               f"optimizer=optax_adamw "
               f"scan_layers={cfg.get('scan_layers', False)} "

@@ -339,3 +339,266 @@ def test_pipeline_save_resume(char_dataset, tmp_path):
     # silent reinit would log its first loss back near the scratch start
     assert abs(l2[0] - l1[-1]) < 0.05, (l1, l2)
     assert l2[-1] < l1[-1], (l1, l2)
+
+
+@pytest.mark.parametrize("mesh_shape,over", [
+    ("pipe:2", {}),
+    ("pipe:4", dict(n_layer=4)),
+    ("data:2,pipe:2", {}),
+    ("pipe:2,tensor:2", {}),
+    ("pipe:2,context:2", {}),
+    ("pipe:2", dict(model_type="llama", n_head=4, n_kv_head=2,
+                    ffn_hidden=64)),
+], ids=["pipe2", "pipe4", "dp-pp", "pp-tp", "pp-cp-ring", "llama"])
+def test_1f1b_trajectory_matches_gpipe(char_dataset, tmp_path, mesh_shape,
+                                       over):
+    """pipeline_schedule='1f1b' (true interleaved 1F1B, loss tail inside
+    the pipeline region — parallel/pipeline.pipeline_1f1b_loss) must
+    reproduce the gpipe trajectory across the composition matrix: pure
+    pipe at both depths, pipe×{data,tensor,context}, and llama GQA.
+    gpipe itself is pinned against the single-device run above, so this
+    chains 1f1b to single-device too; the pipe2 case also re-checks the
+    single-device reference directly (the eval cadence exercises the
+    forward-only no-grad staircase as well). Tolerance covers the fp
+    reassociation of per-micro loss sums + the blocked in-region tail
+    vs the reference full-logits tail."""
+    gp = _run(char_dataset, tmp_path / "o1", mesh_shape, **over)
+    got = _run(char_dataset, tmp_path / "o2", mesh_shape,
+               pipeline_schedule="1f1b", **over)
+    np.testing.assert_allclose(_losses(got), _losses(gp),
+                               atol=3e-4, rtol=3e-4)
+    if mesh_shape == "pipe:2" and not over:
+        ref = _run(char_dataset, tmp_path / "o3", "data:1", **over)
+        np.testing.assert_allclose(_losses(got), _losses(ref),
+                                   atol=3e-4, rtol=3e-4)
+
+
+@pytest.mark.parametrize("pipe", [2, 4])
+def test_1f1b_grad_parity_vs_single_device(pipe):
+    """Direct loss AND parameter-gradient parity of one 1f1b step vs the
+    unpipelined single-device model — every leaf (incl. the tied wte,
+    whose grad is the in-region head dw PLUS the embedding-lookup
+    contribution, and ln_f/wpe through the region's dx) within fp
+    tolerance. pipe:4 uses M=2p=8 > W=7, so the stage-input ring
+    actually wraps."""
+    import jax.numpy as jnp
+    from flax import nnx
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig(block_size=32, vocab_size=96, n_layer=4, n_head=4,
+                    n_embd=32, dropout=0.0, bias=True, attn_impl="xla",
+                    scan_layers=True)
+    B = 16
+    x = jax.random.randint(jax.random.key(1), (B, 32), 0, 96)
+    y = jax.random.randint(jax.random.key(2), (B, 32), 0, 96)
+
+    def loss_fn(params, graphdef):
+        return nnx.merge(graphdef, params)(x, targets=y)[1]
+
+    gd0, p0 = nnx.split(GPT(cfg, rngs=nnx.Rngs(0)), nnx.Param)
+    l_ref, g_ref = jax.jit(jax.value_and_grad(loss_fn),
+                           static_argnums=1)(p0, gd0)
+    with jax.set_mesh(make_mesh(f"pipe:{pipe}")):
+        cfg_p = dataclasses.replace(cfg, pipeline_schedule="1f1b",
+                                    pipeline_microbatches=2 * pipe)
+        gdp, pp_ = nnx.split(GPT(cfg_p, rngs=nnx.Rngs(0)), nnx.Param)
+        l_p, g_p = jax.jit(jax.value_and_grad(loss_fn),
+                           static_argnums=1)(pp_, gdp)
+    np.testing.assert_allclose(float(l_p), float(l_ref), atol=3e-5,
+                               rtol=3e-5)
+    fa, fb = dict(g_p.flat_state()), dict(g_ref.flat_state())
+    for k in fb:
+        a = np.asarray(fa[k].get_value())
+        b = np.asarray(fb[k].get_value())
+        err = np.max(np.abs(a - b)) / (np.max(np.abs(b)) + 1e-8)
+        assert err < 3e-4, (k, err)
+
+
+def test_1f1b_mixtral_matches_microbatched_oracle():
+    """MoE under 1f1b: router stats ride the ppermute payload per-micro
+    and the aux loss is computed PER MICRO at the last stage — so with
+    coef != 0 the pipelined loss/grads equal the micro-batched oracle
+    (mean of M independent strided B/M forwards, aux INCLUDED), which is
+    intentionally NOT gpipe's aggregate-stats aux (nonlinear in the
+    stats; both contracts documented in pipeline_1f1b_loss). Capacity
+    2.0 admits every token so the CE part is drop-free."""
+    import jax.numpy as jnp
+    from flax import nnx
+
+    from avenir_tpu.models.mixtral import Mixtral, MixtralConfig
+
+    cfg = MixtralConfig(block_size=32, vocab_size=96, n_layer=2, n_head=4,
+                        n_kv_head=2, n_embd=32, ffn_hidden=64, n_experts=4,
+                        n_experts_per_tok=2, capacity_factor=2.0,
+                        router_aux_loss_coef=0.02, scan_layers=True)
+    B, M = 8, 2
+    x = jax.random.randint(jax.random.key(1), (B, 32), 0, 96)
+    y = jax.random.randint(jax.random.key(2), (B, 32), 0, 96)
+
+    def loss_fn(params, graphdef, xb, yb):
+        return nnx.merge(graphdef, params)(xb, targets=yb)[1]
+
+    gd0, p0 = nnx.split(Mixtral(cfg, rngs=nnx.Rngs(0)), nnx.Param)
+    oracle_l, oracle_g = 0.0, None
+    for m in range(M):
+        l, g = jax.jit(jax.value_and_grad(loss_fn), static_argnums=1)(
+            p0, gd0, x[m::M], y[m::M])
+        oracle_l += float(l) / M
+        g = jax.tree.map(lambda a: a / M, g)
+        oracle_g = g if oracle_g is None else jax.tree.map(
+            jnp.add, oracle_g, g)
+    with jax.set_mesh(make_mesh("pipe:2")):
+        cfg_p = dataclasses.replace(cfg, pipeline_microbatches=M,
+                                    pipeline_schedule="1f1b")
+        gdp, pp_ = nnx.split(Mixtral(cfg_p, rngs=nnx.Rngs(0)), nnx.Param)
+        l_p, g_p = jax.jit(jax.value_and_grad(loss_fn),
+                           static_argnums=1)(pp_, gdp, x, y)
+    np.testing.assert_allclose(float(l_p), oracle_l, atol=3e-5, rtol=3e-5)
+    fa = dict(g_p.flat_state())
+    fb = dict(oracle_g.flat_state())
+    for k in fb:
+        a = np.asarray(fa[k].get_value())
+        b = np.asarray(fb[k].get_value())
+        err = np.max(np.abs(a - b)) / (np.max(np.abs(b)) + 1e-8)
+        assert err < 3e-4, (k, err)
+
+
+def test_1f1b_save_resume_across_schedules(char_dataset, tmp_path):
+    """Mid-run schedule swap: the checkpoint is schedule-agnostic (same
+    params, moments, rng stream), so a run saved under gpipe resumes
+    under 1f1b and CONTINUES the trajectory — and vice versa."""
+    from tests.test_train_tpu import make_cfg
+
+    from avenir_tpu.train.loop import run_training
+
+    common = dict(gradient_accumulation_steps=4, eval_interval=4,
+                  scan_layers=True, mesh_shape="pipe:2")
+    for first, second in (("gpipe", "1f1b"), ("1f1b", "gpipe")):
+        out = tmp_path / f"{first}-{second}"
+        res = run_training(make_cfg(char_dataset["dir"], out, max_iters=4,
+                                    pipeline_schedule=first, **common))
+        res2 = run_training(make_cfg(char_dataset["dir"], out, max_iters=8,
+                                     init_from="resume",
+                                     pipeline_schedule=second, **common))
+        l1, l2 = _losses(res), _losses(res2)
+        assert res2["iter_num"] >= 8
+        assert abs(l2[0] - l1[-1]) < 0.05, (first, second, l1, l2)
+        assert l2[-1] < l1[-1], (first, second, l1, l2)
+
+
+def test_1f1b_steady_state_never_retraces():
+    """Compile pin: after the first jitted grad step, further same-shape
+    steps add ZERO new traces of the 1f1b region (ledger idiom shared
+    with ops/fused_ce and infer/decode), and the no-grad eval path uses
+    the forward-only body without touching the interleaved one."""
+    from flax import nnx
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+    from avenir_tpu.parallel import pipeline as pp
+
+    cfg = GPTConfig(block_size=32, vocab_size=96, n_layer=2, n_head=2,
+                    n_embd=32, dropout=0.0, bias=False, attn_impl="xla",
+                    scan_layers=True, pipeline_schedule="1f1b")
+    x = jax.random.randint(jax.random.key(1), (4, 32), 0, 96)
+    y = jax.random.randint(jax.random.key(2), (4, 32), 0, 96)
+    with jax.set_mesh(make_mesh("pipe:2")):
+        graphdef, params = nnx.split(GPT(cfg, rngs=nnx.Rngs(0)), nnx.Param)
+
+        def loss_fn(params):
+            return nnx.merge(graphdef, params)(x, targets=y)[1]
+
+        step = jax.jit(jax.value_and_grad(loss_fn))
+        ev = jax.jit(loss_fn)
+        step(params)
+        ev(params)
+        n_inter = pp.trace_count("1f1b")
+        for _ in range(3):
+            step(params)
+            ev(params)
+        assert pp.trace_count("1f1b") == n_inter, (
+            "1f1b region retraced on same-shape steps"
+        )
+
+
+@pytest.mark.slow
+def test_1f1b_memory_bounded_in_M():
+    """The acceptance frontier (BASELINE.md "Pipeline cost table"): at a
+    realistic-vocab tail, 1f1b's compiled temp bytes at M=2p are BELOW
+    remat's at M=2p, and at M=4p they FALL further (M-independent stash,
+    Bm-sized tail slab) while gpipe at M=4p stays several times larger.
+    Measured margins are ~1.6x/5.9x (tools/pipeline_bench.py); asserted
+    with slack for XLA scheduling noise."""
+    from flax import nnx
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+
+    def temp_bytes(schedule, M):
+        cfg = GPTConfig(block_size=128, vocab_size=8192, n_layer=8,
+                        n_head=4, n_embd=128, dropout=0.0, bias=False,
+                        attn_impl="xla", scan_layers=True,
+                        loss_impl="" if schedule == "1f1b" else "blocked",
+                        pipeline_microbatches=M,
+                        pipeline_schedule=schedule)
+        with jax.set_mesh(make_mesh("pipe:2")):
+            graphdef, params = nnx.split(GPT(cfg, rngs=nnx.Rngs(0)),
+                                         nnx.Param)
+            x = jax.random.randint(jax.random.key(1), (16, 128), 0, 8192)
+            y = jax.random.randint(jax.random.key(2), (16, 128), 0, 8192)
+
+            def loss_fn(params):
+                return nnx.merge(graphdef, params)(x, targets=y)[1]
+
+            comp = jax.jit(jax.grad(loss_fn)).lower(params).compile()
+            return comp.memory_analysis().temp_size_in_bytes
+
+    r_2p = temp_bytes("remat", 4)
+    f_2p = temp_bytes("1f1b", 4)
+    f_4p = temp_bytes("1f1b", 8)
+    g_4p = temp_bytes("gpipe", 8)
+    assert f_2p <= r_2p, (f_2p, r_2p)          # acceptance: <= remat @ 2p
+    assert f_4p < 0.8 * f_2p, (f_4p, f_2p)     # memory FALLS with M
+    assert f_4p < 0.33 * g_4p, (f_4p, g_4p)    # gpipe @ 4p can't follow
+
+
+def test_1f1b_multichunk_tail_on_mixed_mesh():
+    """The in-region blocked tail with MULTIPLE T-chunks (nc > 1) on a
+    mesh with a live non-pipe axis: on the legacy harness the chunk
+    loop must unroll instead of lax.scan (fused_ce.blocked_ce_terms,
+    same partial-auto partitioner gate as pipeline._use_psum_hop — a
+    scan there CHECK-aborts the whole process), and loss+grads must
+    still match the unpipelined single-device run. Every other 1f1b
+    case happens to land on nc == 1, which is why this config gets its
+    own pin."""
+    import jax.numpy as jnp
+    from flax import nnx
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig(block_size=64, vocab_size=96, n_layer=2, n_head=2,
+                    n_embd=32, dropout=0.0, bias=False, attn_impl="xla",
+                    scan_layers=True, loss_chunk=16)  # nc = 4 chunks
+    B = 8
+    x = jax.random.randint(jax.random.key(1), (B, 64), 0, 96)
+    y = jax.random.randint(jax.random.key(2), (B, 64), 0, 96)
+
+    def loss_fn(params, graphdef):
+        return nnx.merge(graphdef, params)(x, targets=y)[1]
+
+    gd0, p0 = nnx.split(GPT(cfg, rngs=nnx.Rngs(0)), nnx.Param)
+    l_ref, g_ref = jax.jit(jax.value_and_grad(loss_fn),
+                           static_argnums=1)(p0, gd0)
+    with jax.set_mesh(make_mesh("data:2,pipe:2")):
+        cfg_p = dataclasses.replace(cfg, pipeline_schedule="1f1b",
+                                    pipeline_microbatches=4)
+        gdp, pp_ = nnx.split(GPT(cfg_p, rngs=nnx.Rngs(0)), nnx.Param)
+        l_p, g_p = jax.jit(jax.value_and_grad(loss_fn),
+                           static_argnums=1)(pp_, gdp)
+    np.testing.assert_allclose(float(l_p), float(l_ref), atol=3e-5,
+                               rtol=3e-5)
+    fa, fb = dict(g_p.flat_state()), dict(g_ref.flat_state())
+    for k in fb:
+        a = np.asarray(fa[k].get_value())
+        b = np.asarray(fb[k].get_value())
+        err = np.max(np.abs(a - b)) / (np.max(np.abs(b)) + 1e-8)
+        assert err < 3e-4, (k, err)

@@ -1,0 +1,155 @@
+"""Pipeline-schedule microbench (1f1b satellite, sibling of
+tools/loss_tail_bench.py): per-schedule ms/step + compiled temp-memory
+bytes for `gpipe` / `remat` / `1f1b` at pipe:2 and pipe:4, M = 2p and
+4p. The numbers land in BASELINE.md "Pipeline cost table".
+
+Each (schedule, p, M) cell runs in its OWN subprocess: PJRT's
+`peak_bytes_in_use` is a process-lifetime high-water mark (same reason
+loss_tail_bench forks), and the forced host-device count is baked into
+XLA_FLAGS at interpreter start. The child jits `grad(loss)` of the
+scan-stacked GPT over a `pipe:p` mesh and reports XLA's
+`memory_analysis().temp_size_in_bytes` — the compiled fwd+bwd scratch,
+which is where the schedules differ — plus wall ms/step.
+
+gpipe/remat run the `blocked` loss tail (their production class since
+the fused-CE PR) so the A/B isolates the SCHEDULE; 1f1b's tail is
+always blocked-inside-the-region by construction. A cell that fails to
+compile (OOM on a real chip) records the error and moves on — "M=4p
+does not fit under gpipe but does under 1f1b" is a result, not a
+failure.
+
+    python tools/pipeline_bench.py                  # full grid, one JSON line
+    python tools/pipeline_bench.py --steps=5 --vocab=8192
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# default shape: deep enough for the stash classes to separate (L=8),
+# realistic-vocab tail (the per-micro in-region tail is Bm-sized, the
+# outside tails are B-sized — at tiny vocabs that structural win would
+# be invisible), small enough that 12 CPU-harness compiles stay quick
+SHAPE = dict(batch=16, block=128, n_embd=128, n_head=4, n_layer=8,
+             vocab=8192)
+
+
+def _parse_args():
+    return {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
+            for a in sys.argv[1:]}
+
+
+def _measure_one(schedule, p, M, dims, steps):
+    import jax
+    from flax import nnx
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+    from avenir_tpu.parallel.mesh import make_mesh
+    from avenir_tpu.utils.benching import median_low, peak_hbm_bytes
+
+    cfg = GPTConfig(
+        block_size=dims["block"], vocab_size=dims["vocab"],
+        n_layer=dims["n_layer"], n_head=dims["n_head"],
+        n_embd=dims["n_embd"], dropout=0.0, bias=False, attn_impl="xla",
+        scan_layers=True, pipeline_microbatches=M,
+        pipeline_schedule=schedule,
+        loss_impl="" if schedule == "1f1b" else "blocked",
+    )
+    mesh = make_mesh(f"pipe:{p}")
+    with jax.set_mesh(mesh):
+        graphdef, params = nnx.split(GPT(cfg, rngs=nnx.Rngs(0)), nnx.Param)
+        B = dims["batch"]
+        x = jax.random.randint(jax.random.key(1), (B, dims["block"]), 0,
+                               dims["vocab"])
+        y = jax.random.randint(jax.random.key(2), (B, dims["block"]), 0,
+                               dims["vocab"])
+
+        def loss_fn(params):
+            _, loss = nnx.merge(graphdef, params)(x, targets=y)
+            return loss
+
+        try:
+            comp = jax.jit(jax.grad(loss_fn)).lower(params).compile()
+            temp = comp.memory_analysis().temp_size_in_bytes
+            g = comp(params)
+            jax.block_until_ready(g)
+        except Exception as e:  # OOM at this cell: record and move on
+            return {"error": str(e).splitlines()[0][:200]}
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            g = comp(params)
+            jax.block_until_ready(g)
+            times.append((time.perf_counter() - t0) * 1e3)
+    return {
+        "ms_per_step": round(median_low(times), 3),
+        "temp_bytes": int(temp),
+        "peak_hbm_bytes": peak_hbm_bytes(),
+    }
+
+
+def _child(extra_args, n_devices):
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)] + extra_args,
+        capture_output=True, text=True, env=env,
+    )
+    try:
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        return {"error": (out.stderr or "no output")
+                .strip().splitlines()[-1][:200]}
+
+
+def main():
+    args = _parse_args()
+    if "cell" in args:
+        # child mode: one (schedule, p, M) cell
+        from avenir_tpu.platform import honor_jax_platforms_env
+
+        honor_jax_platforms_env()
+        sched, p, M = args["cell"].split(":")
+        dims = json.loads(args["dims"])
+        print(json.dumps(_measure_one(sched, int(p), int(M), dims,
+                                      int(args["steps"]))))
+        return
+
+    dims = dict(SHAPE)
+    for k in ("batch", "block", "vocab"):
+        if k in args:
+            dims[k] = int(args[k])
+    steps = int(args.get("steps", 3))
+    pipes = [int(v) for v in args.get("pipes", "2,4").split(",")]
+    schedules = args.get("schedules", "gpipe,remat,1f1b").split(",")
+
+    results = {}
+    for p in pipes:
+        for M in (2 * p, 4 * p):
+            for sched in schedules:
+                key = f"{sched}/pipe{p}/M{M}"
+                results[key] = _child(
+                    [f"--cell={sched}:{p}:{M}",
+                     f"--dims={json.dumps(dims)}", f"--steps={steps}"],
+                    n_devices=p,
+                )
+
+    print(json.dumps({
+        "metric": "pipeline_schedule_fwd_bwd",
+        "unit": "ms/step + temp bytes",
+        "shape": dims,
+        "steps": steps,
+        "results": results,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -99,8 +99,9 @@ scan_layers = False  # lax.scan over blocks (fast compiles for deep models)
 # GPipe microbatches for a mesh with pipe:N > 1 (requires scan_layers;
 # avenir_tpu/parallel/pipeline.py). 0 = auto (2x the pipe size)
 pipeline_microbatches = 0
-# pipeline backward schedule: 'gpipe' | 'remat' (reverse-tick
-# stage-input stash, the 1F1B activation-memory class)
+# pipeline schedule: 'gpipe' | 'remat' (reverse-tick stage-input stash)
+# | '1f1b' (true interleaved 1F1B — loss tail inside the pipeline
+# region, O(p) in-flight micros so M can grow well past 2p)
 pipeline_schedule = "gpipe"
 use_pallas = True  # pallas flash attention on TPU (auto-falls back off-TPU)
 # hard attention-impl override ("pallas"/"xla"/...): unlike use_pallas's
