@@ -7,6 +7,7 @@ torch AdamW param grouping we must reproduce).
 """
 
 import collections
+import json
 import os
 import time
 
@@ -22,7 +23,21 @@ from avenir_tpu.checkpoint.bridge import (
     torch_key_to_nnx_path,
     torch_sd_to_flat_paths,
 )
+from avenir_tpu.checkpoint.manifest import (
+    ChecksumReader,
+    ChecksumWriter,
+    CorruptCheckpoint,
+    build_manifest,
+    file_algo,
+    file_checksum,
+    load_manifest,
+    manifest_path,
+    verify_files,
+    write_manifest,
+)
 from avenir_tpu.checkpoint.torch_pt import LazyArray, load_pt, save_pt
+from avenir_tpu.utils.faults import get_injector
+from avenir_tpu.utils.retry import call_with_retry
 
 
 def torch_param_order(sd, model_family="gpt"):
@@ -124,7 +139,8 @@ def _tied(model_family):
 
 
 def save_checkpoint(out_dir, *, params, opt_state, hyper, model_args,
-                    iter_num, best_val_loss, config, model_family="gpt"):
+                    iter_num, best_val_loss, config, model_family="gpt",
+                    keep_checkpoints=2):
     """Write out_dir/ckpt.pt in the torch schema. `params` is the nnx Param
     State; `opt_state` the optax state; `hyper` carries the torch
     param_group hyperparams (lr, betas, eps, weight_decay).
@@ -208,12 +224,327 @@ def save_checkpoint(out_dir, *, params, opt_state, hyper, model_args,
         os.makedirs(out_dir, exist_ok=True)
     save_pt(ckpt, path + ".part", write=write)
     if write:
-        os.replace(path + ".part", path)
+        # commit protocol (ISSUE 5): checksum the streamed .part (one
+        # sequential read, page-cache warm), rename the body, then the
+        # manifest sidecar — restore verifies size+CRC against it, so
+        # bit rot on shared storage is detected instead of loaded.
+        # Idempotent under retry: a rename that landed before a
+        # transient manifest-write failure is not re-attempted.
+        nbytes, crc = file_checksum(path + ".part")
+        man = build_manifest(iter_num=int(iter_num), form="full",
+                             files={"ckpt.pt": (nbytes, crc)})
+
+        def _commit():
+            get_injector().fail("ckpt_write_fail", what=path)
+            if os.path.exists(path + ".part"):
+                # drop the stale sidecar BEFORE the body rename: ckpt.pt
+                # size is iteration-invariant, so a kill between rename
+                # and manifest write would otherwise pair the new body
+                # with the old sidecar and read as "bit corruption" —
+                # rejecting a perfectly good checkpoint. No sidecar =
+                # legacy accept (rename atomicity still holds).
+                try:
+                    os.remove(manifest_path(out_dir, "full"))
+                except FileNotFoundError:
+                    pass
+                os.replace(path + ".part", path)
+            write_manifest(out_dir, man)
+
+        call_with_retry(_commit, what="ckpt.pt commit")
+        record_generation(out_dir, ["ckpt.pt"], manifest=man,
+                          keep=keep_checkpoints)
     reg = get_registry()
     reg.counter("ckpt_saves").add(1)
     reg.counter("ckpt_save_ms").add((time.perf_counter() - t0) * 1e3)
     if write:
         reg.counter("ckpt_bytes_written").add(os.path.getsize(path))
+
+
+# ---- generation ring (ISSUE 5 tentpole, part 2) ----
+#
+# Every committed save is also recorded as a GENERATION under
+# out_dir/ckpt-gens/iter-NNNNNNNN-{full,sharded}/ via hard links: the
+# live artifact's next overwrite (os.replace unlinks the old name)
+# leaves the generation's inodes intact, so the ring costs metadata ops
+# at save time and at most K-1 extra checkpoints of disk. On restore,
+# select_checkpoint_source verifies the newest candidate and walks the
+# ring until one passes — a corrupted or uncommitted newest checkpoint
+# degrades to "resume slightly older" instead of "run dead".
+
+_GEN_DIR = "ckpt-gens"
+
+
+def _link_or_copy(src, dst):
+    """Hard link, falling back to a real copy where links are refused
+    (some network filesystems). Either way dst is immune to a later
+    os.replace of src's name."""
+    try:
+        os.link(src, dst)
+    except OSError:
+        import shutil
+
+        shutil.copy2(src, dst)
+
+
+def record_generation(out_dir, files, *, manifest, keep, echo=print):
+    """Snapshot committed artifact `files` (basenames in out_dir) into a
+    generation directory and prune the ring to `keep` entries. The
+    generation's manifest is written LAST — its rename is the
+    generation's commit, so a crash mid-record leaves an uncommitted
+    directory that listing skips and pruning sweeps. Best-effort: a
+    ring failure must not fail the save that already committed."""
+    if not keep or keep <= 0:
+        return None
+    form = manifest["form"]
+    gen = os.path.join(out_dir, _GEN_DIR,
+                       f"iter-{manifest['iter_num']:08d}-{form}")
+    try:
+        os.makedirs(gen, exist_ok=True)
+        for name in files:
+            dst = os.path.join(gen, name)
+            if os.path.exists(dst):
+                os.remove(dst)  # re-record of the same iter (re-saves)
+            _link_or_copy(os.path.join(out_dir, name), dst)
+        if form == "sharded":
+            # peers only join their OWN previous save, so a peer's NEXT
+            # save can replace its body at the fixed shard path while
+            # this coordinator thread is still linking — capturing bytes
+            # the manifest's CRCs can never verify. The link pins an
+            # inode, so the header's iteration tells which save it is.
+            import pickle
+            import shutil
+
+            for name in files:
+                with open(os.path.join(gen, name), "rb") as fh:
+                    h = pickle.load(fh)
+                if int(h.get("iter_num", -1)) != int(manifest["iter_num"]):
+                    shutil.rmtree(gen, ignore_errors=True)
+                    raise OSError(
+                        f"{name} was replaced by a newer save (iter "
+                        f"{h.get('iter_num')}) before the generation "
+                        "could be recorded"
+                    )
+        write_manifest(gen, manifest)
+        prune_generations(out_dir, keep)
+        return gen
+    except OSError as e:
+        get_registry().counter("ckpt_save_errors").add(1)
+        echo(f"[ckpt] generation ring update failed ({e}); the live "
+             "checkpoint is committed but this save has no fallback copy")
+        return None
+
+
+def list_generations(out_dir):
+    """Committed generations, newest first: [(iter_num, form, path)].
+    A directory without a readable manifest is uncommitted debris (crash
+    mid-record) and is not listed."""
+    root = os.path.join(out_dir, _GEN_DIR)
+    out = []
+    if not os.path.isdir(root):
+        return out
+    for name in os.listdir(root):
+        d = os.path.join(root, name)
+        for form in ("full", "sharded"):
+            m = load_manifest(d, form)
+            if m is not None and m.get("form") == form:
+                out.append((int(m["iter_num"]), form, d))
+    out.sort(key=lambda t: t[0], reverse=True)
+    return out
+
+
+def prune_generations(out_dir, keep):
+    """Drop all but the newest `keep` committed generations, plus any
+    uncommitted debris directories that are not the newest entry.
+    `keep` counts DISTINCT iterations, not directories: the final save
+    of a pod run lands a full ckpt.pt at the same iteration as the
+    eval-cadence sharded set, and counting those two dirs as two ring
+    entries would silently evict every older restore point."""
+    import shutil
+
+    root = os.path.join(out_dir, _GEN_DIR)
+    if not os.path.isdir(root):
+        return
+    gens = list_generations(out_dir)
+    keep_iters = set(sorted({it for it, _, _ in gens}, reverse=True)[:keep])
+    committed = {d for it, _, d in gens if it in keep_iters}
+    for name in sorted(os.listdir(root)):
+        d = os.path.join(root, name)
+        if d not in committed:
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def _verify_full_file(dirpath, *, strict=False, echo=print):
+    """Integrity-check dirpath/ckpt.pt against its manifest sidecar.
+    Returns 'verified' or 'legacy'; raises CorruptCheckpoint on definite
+    corruption. Policy (docstring of checkpoint/manifest.py): no sidecar
+    → legacy-unverified (the torch trainer writes none); size mismatch →
+    a foreign writer replaced the file WHOLE (rename atomicity rules out
+    torn files), accept unverified; size match + CRC fail → bit rot,
+    reject. `strict=True` (generation dirs, which only our committed
+    recorder writes) turns every unverified case into a rejection."""
+    path = os.path.join(dirpath, "ckpt.pt")
+    if not os.path.exists(path):
+        raise CorruptCheckpoint(f"{path}: missing")
+    man = load_manifest(dirpath, "full")
+    if man is None:
+        if strict:
+            raise CorruptCheckpoint(f"{path}: no manifest (uncommitted "
+                                    "generation)")
+        echo(f"[ckpt] {path}: no manifest sidecar — accepting unverified "
+             "(legacy save or foreign writer)")
+        return "legacy"
+    ent = man["files"].get("ckpt.pt")
+    size = os.path.getsize(path)
+    if ent is None or size != ent["bytes"]:
+        if strict:
+            raise CorruptCheckpoint(
+                f"{path}: {size} bytes but the generation manifest says "
+                f"{ent and ent['bytes']}"
+            )
+        echo(f"[ckpt] {path}: size differs from its manifest sidecar — a "
+             "foreign writer replaced it whole (atomic rename rules out a "
+             "torn file); accepting unverified")
+        return "legacy"
+    if os.environ.get("AVENIR_RESTORE_VERIFY", "crc") == "sizes":
+        return "verified"  # size matched above; CRC read waived
+    _, crc = file_checksum(path, algo=file_algo(man, "ckpt.pt"))
+    if crc != ent["crc"]:
+        raise CorruptCheckpoint(
+            f"{path}: CRC {crc:#010x} != manifest {ent['crc']:#010x} "
+            "(bit corruption)"
+        )
+    return "verified"
+
+
+def verify_sharded_set(dirpath, *, echo=print):
+    """Integrity-check a sharded set against its MANIFEST.json. Returns
+    'verified' or 'legacy' (pre-manifest v1 sets); raises
+    CorruptCheckpoint on an uncommitted v2 set or failing checksums.
+    `AVENIR_RESTORE_VERIFY=sizes` relaxes the per-file check to byte
+    sizes only (skips the CRC read of the whole set — for huge pods
+    where every process re-reading N files at restore is too dear;
+    body reads still CRC the files they actually open)."""
+    import glob
+
+    man = load_manifest(dirpath, "sharded")
+    if man is None:
+        v2 = False
+        for f in glob.glob(os.path.join(dirpath, "ckpt-shard-*.pkl")):
+            try:
+                import pickle
+
+                with open(f, "rb") as fh:
+                    h = pickle.load(fh)
+                v2 = v2 or h.get("format") == "avenir_sharded_v2"
+            except Exception:
+                v2 = True  # unreadable header in a manifest-less set
+        if v2:
+            raise CorruptCheckpoint(
+                f"sharded set in {dirpath} has no MANIFEST.json — the "
+                "save never committed (crash mid-save?)"
+            )
+        echo(f"[ckpt] sharded set in {dirpath} predates the manifest "
+             "format — accepting unverified")
+        return "legacy"
+    if os.environ.get("AVENIR_RESTORE_VERIFY", "crc") == "sizes":
+        for name, ent in man["files"].items():
+            p = os.path.join(dirpath, name)
+            if not os.path.exists(p) or os.path.getsize(p) != ent["bytes"]:
+                raise CorruptCheckpoint(
+                    f"{p}: missing or size != manifest (torn set)")
+        return "verified"
+    verify_files(dirpath, man)
+    return "verified"
+
+
+def select_checkpoint_source(out_dir, *, echo=print):
+    """Decide where a resume restores from: the newest artifact — live
+    full ckpt.pt, live sharded set, or a ring generation — that passes
+    integrity verification. Walks candidates newest-first; every
+    candidate refused for corruption/uncommittedness counts
+    `ckpt_corrupt_detected`, and landing on anything but the newest
+    counts `ckpt_fallback` (the run resumed, but older than it should
+    have — page a human about the storage). Raises RuntimeError when
+    nothing survives: resuming from garbage is worse than dying loudly.
+
+    Returns {dir, kind ('full'|'sharded'), iter_num, meta,
+    skipped_bad}: `meta` is the lazily parsed ckpt dict (full) or the
+    sharded header meta — whichever the loop needs next."""
+    import glob
+
+    reg = get_registry()
+    cands = []  # (iter_num, live?, kind, dir, payload)
+    skipped = 0
+    sh_meta = load_sharded_checkpoint(out_dir, meta_only=True)
+    if sh_meta is not None:
+        cands.append((int(sh_meta["iter_num"]), 1, "sharded", out_dir,
+                      sh_meta))
+    elif glob.glob(os.path.join(out_dir, "ckpt-shard-*.pkl")):
+        # shard files exist but the set was refused (torn/unreadable
+        # before it could even rank): whatever we restore instead is a
+        # FALLBACK and must be recorded as one. load_sharded_checkpoint
+        # already counted the ckpt_corrupt_detected for its refusal.
+        echo(f"[ckpt] sharded set in {out_dir} is unusable; counting it "
+             "as a skipped candidate")
+        skipped += 1
+    if os.path.exists(os.path.join(out_dir, "ckpt.pt")):
+        try:
+            ckpt = call_with_retry(
+                lambda: load_checkpoint(out_dir, lazy=True),
+                what="ckpt.pt read")
+            cands.append((int(ckpt["iter_num"]), 1, "full", out_dir, ckpt))
+        except Exception as e:
+            echo(f"[ckpt] {out_dir}/ckpt.pt is unreadable ({e}); trying "
+                 "older generations")
+            reg.counter("ckpt_corrupt_detected").add(1)
+            # whatever restores instead of the newest live artifact is a
+            # fallback — symmetric with the sharded probe above
+            skipped += 1
+    for it, form, d in list_generations(out_dir):
+        if any(c[3] == d for c in cands):
+            continue
+        cands.append((it, 0, form, d, None))
+    # newest first; the live artifact outranks a generation of the same
+    # iteration (identical bytes, but the live one is what tools read),
+    # and full outranks sharded at the same iteration (old loop policy)
+    cands.sort(key=lambda c: (c[0], c[1], c[2] == "full"), reverse=True)
+    for it, _live, kind, d, payload in cands:
+        try:
+            if kind == "sharded":
+                verify_sharded_set(d, echo=echo)
+                meta = payload or load_sharded_checkpoint(d, meta_only=True)
+                if meta is None:
+                    raise CorruptCheckpoint(
+                        f"sharded set in {d} is incomplete or torn")
+            else:
+                _verify_full_file(d, strict=(d != out_dir), echo=echo)
+                meta = payload
+                if meta is None:
+                    meta = call_with_retry(
+                        lambda d=d: load_checkpoint(d, lazy=True),
+                        what="ckpt.pt read")
+        except Exception as e:  # noqa: BLE001 — any unusable candidate
+            # broader than (CorruptCheckpoint, OSError) on purpose:
+            # under AVENIR_RESTORE_VERIFY=sizes a size-preserving rot
+            # surfaces as BadZipFile/UnpicklingError from the parse, and
+            # the walk must degrade to an older generation, not die —
+            # exhausting every candidate still fails loud below
+            echo(f"[ckpt] refusing {kind} checkpoint in {d}: {e}")
+            reg.counter("ckpt_corrupt_detected").add(1)
+            skipped += 1
+            continue
+        if skipped:
+            reg.counter("ckpt_fallback").add(1)
+            echo(f"[ckpt] FALLBACK: restoring {kind} checkpoint at iter "
+                 f"{it} from {d} ({skipped} newer candidate(s) failed "
+                 "verification)")
+        return {"dir": d, "kind": kind, "iter_num": it, "meta": meta,
+                "skipped_bad": skipped}
+    raise RuntimeError(
+        f"init_from=resume but {out_dir} holds no restorable checkpoint: "
+        f"no usable ckpt.pt, no committed ckpt-shard-*.pkl set, and "
+        f"{skipped} candidate(s) failed integrity verification"
+    )
 
 
 class AsyncCheckpoint:
@@ -320,6 +651,7 @@ def save_checkpoint_async(out_dir, *, params, opt_state, **kw):
             save_checkpoint(out_dir, params=params, opt_state=opt_state,
                             **kw)
         except Exception as e:  # KeyboardInterrupt etc. propagate: this
+            get_registry().counter("ckpt_save_errors").add(1)
             handle.error = e    # runs on the MAIN thread, unlike run()
         return handle
     params = jax.tree.map(jnp.copy, params)
@@ -330,6 +662,7 @@ def save_checkpoint_async(out_dir, *, params, opt_state, **kw):
             save_checkpoint(out_dir, params=params, opt_state=opt_state,
                             **kw)
         except BaseException as e:  # noqa: BLE001 — surfaced via join()
+            get_registry().counter("ckpt_save_errors").add(1)
             handle.error = e
 
     t = threading.Thread(target=run, name="avenir-async-ckpt", daemon=True)
@@ -352,6 +685,53 @@ def save_checkpoint_async(out_dir, *, params, opt_state, **kw):
 # (docs/OPERATIONS.md).
 
 _SHARD_FMT = "ckpt-shard-{:05d}.pkl"
+# per-(file, iteration) commit sidecar: the iteration lives in the NAME
+# so a process starting save N+1 never overwrites or races the sidecar
+# the coordinator is still collecting for save N (peers only join their
+# OWN previous save — nothing orders them against the coordinator)
+_SIDECAR_FMT = "{}.crc-{:08d}.json"
+_SIDECAR_RE = r"\.crc-(\d{8})\.json$"
+
+
+def _collect_shard_sidecars(out_dir, iter_num, nproc, poll_s=0.05):
+    """Coordinator side of the sharded commit: wait for every process's
+    `<shard>.crc-<iter>.json` sidecar for THIS iteration, return
+    {basename: (bytes, crc)} for the set manifest. Sidecars are written
+    atomically, so a readable one is complete; an absent one just means
+    that process hasn't landed yet. Timing out leaves the set
+    UNCOMMITTED — restore will refuse it and fall back, which is the
+    correct outcome for a writer that died mid-save."""
+    deadline = time.monotonic() + float(
+        os.environ.get("AVENIR_CKPT_COMMIT_TIMEOUT_S", "300"))
+    files = {}
+    while len(files) < nproc:
+        for i in range(nproc):
+            name = _SHARD_FMT.format(i)
+            if name in files:
+                continue
+            try:
+                with open(os.path.join(
+                        out_dir, _SIDECAR_FMT.format(name, iter_num))) as f:
+                    side = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            if side.get("iter_num") == iter_num \
+                    and side.get("process_count") == nproc:
+                # keep each writer's OWN algo: hosts of one pod can
+                # disagree on whether the crc32c package is installed,
+                # and the CRC was computed by the shard's writer
+                files[name] = (side["bytes"], side["crc"],
+                               side.get("algo", "crc32"))
+        if len(files) < nproc:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"sharded save at iter {iter_num}: only {len(files)}/"
+                    f"{nproc} shard sidecars appeared before the commit "
+                    "timeout — the set stays uncommitted (restore will "
+                    "fall back to the previous generation)"
+                )
+            time.sleep(poll_s)
+    return files
 
 
 def _flat_arrays(state):
@@ -384,7 +764,8 @@ def _local_replica0_shards(leaf):
 
 def save_checkpoint_sharded_async(out_dir, *, params, opt_state, hyper,
                                   model_args, iter_num, best_val_loss,
-                                  config, model_family="gpt"):
+                                  config, model_family="gpt",
+                                  keep_checkpoints=2):
     """Pod-safe async checkpoint: zero collectives (see section comment).
     Snapshot semantics match save_checkpoint_async: device-side copies are
     taken on the calling thread (the train step donates its buffers), the
@@ -459,7 +840,7 @@ def save_checkpoint_sharded_async(out_dir, *, params, opt_state, hyper,
                 body[name] = sec
                 index_ranges[name] = rng_sec
             header = {
-                "format": "avenir_sharded_v1", "process_index": pid,
+                "format": "avenir_sharded_v2", "process_index": pid,
                 "process_count": nproc, "iter_num": int(iter_num),
                 "best_val_loss": float(best_val_loss), "count": count,
                 "hyper": hyper, "model_args": model_args, "config": config,
@@ -471,11 +852,42 @@ def save_checkpoint_sharded_async(out_dir, *, params, opt_state, hyper,
                 "index_ranges": index_ranges,
             }
             os.makedirs(out_dir, exist_ok=True)
-            tmp = path + ".part"
-            with open(tmp, "wb") as f:
-                pickle.dump(header, f, protocol=4)
-                pickle.dump(body, f, protocol=4)
-            os.replace(tmp, path)
+
+            # body write: CRC accumulated while streaming (no re-read),
+            # transient failures retried, the rename is the visibility
+            # point. v2 files are not RESTORABLE until the coordinator's
+            # MANIFEST.json rename commits the whole set below.
+            def _write_body():
+                get_injector().fail("ckpt_write_fail", what=path)
+                tmp = path + ".part"
+                with open(tmp, "wb") as f:
+                    w = ChecksumWriter(f)
+                    pickle.dump(header, w, protocol=4)
+                    pickle.dump(body, w, protocol=4)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+                return w
+
+            w = call_with_retry(_write_body,
+                                what=f"ckpt shard write p{pid}")
+            # per-process commit sidecar: the coordinator assembles the
+            # set manifest from these, so no process ever re-reads
+            # another's body off shared storage just to checksum it
+            side = {"iter_num": int(iter_num), "process_index": pid,
+                    "process_count": nproc, "bytes": w.nbytes,
+                    "crc": w.crc, "algo": w.algo}
+            side_path = os.path.join(
+                out_dir,
+                _SIDECAR_FMT.format(_SHARD_FMT.format(pid), int(iter_num)))
+
+            def _write_sidecar():
+                with open(side_path + ".part", "w") as f:
+                    json.dump(side, f)
+                os.replace(side_path + ".part", side_path)
+
+            call_with_retry(_write_sidecar,
+                            what=f"ckpt shard sidecar p{pid}")
             reg = get_registry()
             reg.counter("ckpt_saves").add(1)
             reg.counter("ckpt_save_ms").add((time.perf_counter() - t0) * 1e3)
@@ -489,7 +901,37 @@ def save_checkpoint_sharded_async(out_dir, *, params, opt_state, hyper,
                         out_dir, _SHARD_FMT.format(i))):
                     os.remove(os.path.join(out_dir, _SHARD_FMT.format(i)))
                     i += 1
+                files = _collect_shard_sidecars(out_dir, int(iter_num),
+                                                nproc)
+                man = build_manifest(iter_num=int(iter_num), form="sharded",
+                                     files=files, algo=side["algo"],
+                                     extra={"process_count": nproc})
+
+                def _commit():
+                    get_injector().fail("ckpt_write_fail",
+                                        what="sharded MANIFEST")
+                    write_manifest(out_dir, man)
+
+                call_with_retry(_commit, what="sharded manifest commit")
+                # manifest holds it all now: sweep this save's sidecars
+                # AND any older debris (a coordinator that died before
+                # cleanup) — but never a NEWER save's, whose collect may
+                # be racing this thread
+                import glob as _glob
+                import re as _re
+
+                for sp in _glob.glob(os.path.join(
+                        out_dir, "ckpt-shard-*.pkl.crc-*.json")):
+                    m = _re.search(_SIDECAR_RE, sp)
+                    if m and int(m.group(1)) <= int(iter_num):
+                        try:
+                            os.remove(sp)
+                        except OSError:
+                            pass
+                record_generation(out_dir, sorted(files),
+                                  manifest=man, keep=keep_checkpoints)
         except BaseException as e:  # noqa: BLE001 — surfaced via join()
+            get_registry().counter("ckpt_save_errors").add(1)
             handle.error = e
 
     t = threading.Thread(target=run, name="avenir-sharded-ckpt", daemon=True)
@@ -548,7 +990,76 @@ def _file_is_local(header, local_ranges):
     return False
 
 
-def load_sharded_checkpoint(out_dir, meta_only=False, local_ranges=None):
+class _FaultyRead:
+    """read_corrupt injection point, layered BELOW the checksum reader:
+    a flipped byte reaches the CRC and the unpickler through the same
+    buffer, exactly like bus/NIC corruption on a real mount. Implements
+    every method ChecksumReader delegates — pickle's C unpickler uses
+    readinto for large frames (i.e. every real tensor body), so an
+    armed-but-idle injector must not change which read path exists."""
+
+    def __init__(self, f, inj):
+        self._f = f
+        self._inj = inj
+
+    def read(self, n=-1):
+        return self._inj.corrupt("read_corrupt", self._f.read(n))
+
+    def readline(self):
+        return self._inj.corrupt("read_corrupt", self._f.readline())
+
+    def readinto(self, b):
+        data = self.read(len(b))
+        b[:len(data)] = data
+        return len(data)
+
+
+def _read_shard_body(path, manifest, verify):
+    """One shard file's tensor body, checksummed over the bytes AS READ
+    (not re-read from disk — transit corruption between the platters and
+    this process is exactly what the second pass would miss). Returns
+    (body, nbytes_read). Raises CorruptCheckpoint when the read bytes
+    disagree with the set manifest — BEFORE the caller can assemble them
+    into live weights; FaultInjected/OSError propagate for the retry
+    wrapper. v1 sets (no manifest entry) parse unverified."""
+    import pickle
+
+    inj = get_injector()
+    inj.fail("ckpt_read_fail", what=path)
+    name = os.path.basename(path)
+    ent = (manifest["files"].get(name)
+           if verify and manifest is not None else None)
+    with open(path, "rb") as fh:
+        src = _FaultyRead(fh, inj) if inj.enabled("read_corrupt") else fh
+        r = ChecksumReader(
+            src, algo=file_algo(manifest, name) if ent is not None else None)
+        try:
+            pickle.load(r)  # header — the caller already parsed it
+            body = pickle.load(r)
+            parse_err = None
+        except Exception as e:  # noqa: BLE001 — CRC decides below
+            # corrupt bytes usually break the pickle stream before the
+            # checksum can speak; finish counting, let the CRC classify
+            body, parse_err = None, e
+        r.drain()
+    if ent is not None and (r.nbytes != ent["bytes"]
+                            or r.crc != ent["crc"]):
+        raise CorruptCheckpoint(
+            f"{path}: bytes as read fail the manifest check ({r.nbytes} "
+            f"bytes, CRC {r.crc:#010x}; manifest says {ent['bytes']} "
+            f"bytes, CRC {ent['crc']:#010x}) — refusing to assemble them "
+            "into live weights"
+        )
+    if parse_err is not None:
+        raise parse_err  # verified bytes that still don't parse
+    return body, r.nbytes
+
+
+_SHARD_FORMATS = ("avenir_sharded_v1", "avenir_sharded_v2")
+
+
+def load_sharded_checkpoint(out_dir, meta_only=False, local_ranges=None,
+                            verify=True):
     """Read a ckpt-shard-*.pkl set. `meta_only=True` reads just the small
     per-file headers (set validation + iter comparison — what resume
     needs BEFORE deciding this set wins over ckpt.pt); otherwise the
@@ -563,11 +1074,30 @@ def load_sharded_checkpoint(out_dir, meta_only=False, local_ranges=None):
     {"params": {path: np}, "mu": ..., "nu": ..., iter_num, ...} (tensor
     sections absent under meta_only) or None when the set is absent,
     incomplete, torn (mixed iterations), or not a format this reader
-    knows — the caller then falls back to ckpt.pt."""
+    knows — the caller then falls back to ckpt.pt.
+
+    Commit protocol (ISSUE 5): v2 sets carry a MANIFEST.json whose
+    atomic rename is the commit. Body reads (`verify=True`) refuse an
+    uncommitted v2 set (None + `ckpt_corrupt_detected`) and checksum
+    every file's bytes AS READ against the manifest, raising
+    CorruptCheckpoint on a mismatch — a read that returned corrupt
+    bytes must never be assembled into live weights. Callers wanting
+    fallback-on-corruption verify FIRST via `verify_sharded_set`/
+    `select_checkpoint_source`; by body-read time a corruption is a
+    fail-loud event, not a silent retry."""
     import glob
     import pickle
 
-    files = sorted(glob.glob(os.path.join(out_dir, "ckpt-shard-*.pkl")))
+    manifest = load_manifest(out_dir, "sharded")
+    if manifest is not None:
+        files = sorted(os.path.join(out_dir, n) for n in manifest["files"])
+        if not all(os.path.exists(f) for f in files):
+            print(f"[ckpt] sharded set in {out_dir}: manifest lists files "
+                  "that are missing on disk; ignoring the set")
+            get_registry().counter("ckpt_corrupt_detected").add(1)
+            return None
+    else:
+        files = sorted(glob.glob(os.path.join(out_dir, "ckpt-shard-*.pkl")))
     if not files:
         return None
     headers = []
@@ -575,11 +1105,24 @@ def load_sharded_checkpoint(out_dir, meta_only=False, local_ranges=None):
         try:
             with open(f, "rb") as fh:
                 h = pickle.load(fh)
-            assert h.get("format") == "avenir_sharded_v1", h.get("format")
+            assert h.get("format") in _SHARD_FORMATS, h.get("format")
             headers.append((f, h))
         except Exception as e:
             print(f"[ckpt] unreadable/unknown shard file {f} ({e}); "
                   "ignoring the sharded set")
+            # an unparseable header is corruption evidence the same way
+            # a torn set is (a foreign/newer format would be a naming
+            # collision on our own ckpt-shard-*.pkl pattern — rarer than
+            # bit rot, and an operator should look either way)
+            get_registry().counter("ckpt_corrupt_detected").add(1)
+            return None
+    if (verify and manifest is None
+            and any(h.get("format") == "avenir_sharded_v2"
+                    for _, h in headers)):
+        if not meta_only:
+            print(f"[ckpt] sharded set in {out_dir} has no MANIFEST.json "
+                  "— the save never committed; refusing the set")
+            get_registry().counter("ckpt_corrupt_detected").add(1)
             return None
     nproc = headers[0][1]["process_count"]
     iters = {h["iter_num"] for _, h in headers}
@@ -594,6 +1137,10 @@ def load_sharded_checkpoint(out_dir, meta_only=False, local_ranges=None):
         print(f"[ckpt] sharded set in {out_dir} is incomplete or torn "
               f"({len(headers)}/{nproc} files, iters {sorted(iters)}, "
               f"process_counts {sorted(nprocs)}); falling back to ckpt.pt")
+        # a mixed-iteration set is direct crash-window evidence (SIGKILL
+        # between body renames) — the docs' failure matrix promises it
+        # is counted, not silently skipped
+        get_registry().counter("ckpt_corrupt_detected").add(1)
         return None
     out = {k: headers[0][1][k] for k in
            ("iter_num", "best_val_loss", "count", "hyper", "model_args",
@@ -621,10 +1168,10 @@ def load_sharded_checkpoint(out_dir, meta_only=False, local_ranges=None):
         if not _file_is_local(h, local_ranges):
             n_skipped += 1
             continue
-        with open(f, "rb") as fh:
-            pickle.load(fh)  # skip the header record
-            body = pickle.load(fh)
-        bytes_read += os.path.getsize(f)
+        body, n_read = call_with_retry(
+            lambda f=f: _read_shard_body(f, manifest, verify),
+            what=f"ckpt shard read {os.path.basename(f)}")
+        bytes_read += n_read
         for name in ("params", "mu", "nu"):
             sec = out[name]
             for k, ent in body[name].items():

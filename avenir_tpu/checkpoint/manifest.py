@@ -1,0 +1,259 @@
+"""Checkpoint commit protocol + integrity checking (ISSUE 5 tentpole).
+
+A multi-file checkpoint (the per-host sharded set) used to have no
+commit marker: a SIGKILL between shard renames left a set that was only
+detectable as torn by its iteration numbers, and a storage layer that
+flips bits returned garbage straight into live weights. This module
+gives every checkpoint artifact a verifiable identity:
+
+- `MANIFEST.json` lists every body file with its byte size and CRC; the
+  manifest's own atomic rename IS the commit — a set without a manifest
+  (or whose manifest disagrees with the bytes on disk) is refused by
+  restore, which then falls back to an older generation
+  (checkpoint/io.select_checkpoint_source).
+- The single-file `ckpt.pt` gets a sidecar (`ckpt.pt.manifest.json`)
+  with the same size+CRC record. Its rename is already atomic, so the
+  sidecar is pure corruption DETECTION: size-match-but-CRC-fail means
+  bit rot (reject); size mismatch means a foreign writer replaced the
+  file whole (the torch trainer saves ckpt.pt with no sidecar) — accept
+  as legacy-unverified, because rename atomicity rules out a torn file.
+
+Checksum: CRC32C (Castagnoli) via the `crc32c` package when installed,
+zlib's CRC-32 otherwise — both C-speed; the algorithm is recorded per
+manifest so a set written on one host verifies on another. Corruption
+is NEVER retried: `CorruptCheckpoint` is not an OSError, so the
+transient-IO retry policy (utils/retry.py) lets it propagate to the
+generation-fallback logic instead of burning the retry budget on
+deterministic garbage.
+"""
+
+import json
+import os
+import time
+import zlib
+
+MANIFEST_NAME = "MANIFEST.json"
+SIDECAR_SUFFIX = ".manifest.json"  # single-file form: <file>.manifest.json
+MANIFEST_FORMAT = "avenir_ckpt_manifest_v1"
+
+
+class CorruptCheckpoint(Exception):
+    """A checkpoint artifact failed integrity verification (checksum
+    mismatch, truncation, uncommitted set). Deliberately NOT an OSError:
+    retry policies must not catch it — corruption is deterministic, the
+    remedy is falling back to an older generation, not re-reading."""
+
+
+def _crc32c_py():  # pragma: no cover — exercised only where installed
+    try:
+        import crc32c
+
+        return "crc32c", crc32c.crc32c
+    except ImportError:
+        return None
+
+
+def checksum_algos():
+    """{name: update_fn(data, crc) -> crc}. zlib's CRC-32 is always
+    available; CRC32C is preferred when the package exists."""
+    algos = {"crc32": zlib.crc32}
+    c = _crc32c_py()
+    if c is not None:
+        algos[c[0]] = c[1]
+    return algos
+
+
+def preferred_algo():
+    algos = checksum_algos()
+    return "crc32c" if "crc32c" in algos else "crc32"
+
+
+def checksum_update_fn(algo):
+    """The update fn for `algo`, or CorruptCheckpoint when this host
+    cannot verify it — callers treat that exactly like a failed
+    verification (fall back / fail loud), never as a crash."""
+    fn = checksum_algos().get(algo)
+    if fn is None:
+        raise CorruptCheckpoint(
+            f"manifest uses checksum algo {algo!r}, unavailable on this "
+            "host (install the crc32c package to verify this artifact)"
+        )
+    return fn
+
+
+class ChecksumWriter:
+    """File-object wrapper that accumulates size + CRC as it writes, so
+    the shard writer gets its checksum for free instead of re-reading
+    the file it just streamed out."""
+
+    def __init__(self, f, algo=None):
+        self._f = f
+        self.algo = algo or preferred_algo()
+        self._update = checksum_update_fn(self.algo)
+        self.crc = 0
+        self.nbytes = 0
+
+    def write(self, data):
+        self.crc = self._update(data, self.crc) & 0xFFFFFFFF
+        self.nbytes += len(data)
+        return self._f.write(data)
+
+    def flush(self):
+        self._f.flush()
+
+
+class ChecksumReader:
+    """Streaming mirror of ChecksumWriter: accumulates size + CRC over
+    the bytes AS READ, so an unpickler can consume a checkpoint body
+    without the whole file ever sitting in one host buffer. The caller
+    verifies `crc`/`nbytes` after draining to EOF and BEFORE using
+    anything parsed from the stream."""
+
+    def __init__(self, f, algo=None):
+        self._f = f
+        self.algo = algo or preferred_algo()
+        self._update = checksum_update_fn(self.algo)
+        self.crc = 0
+        self.nbytes = 0
+
+    def _count(self, data):
+        self.crc = self._update(data, self.crc) & 0xFFFFFFFF
+        self.nbytes += len(data)
+        return data
+
+    def read(self, n=-1):
+        return self._count(self._f.read(n))
+
+    def readline(self):  # pickle.Unpickler requires it
+        return self._count(self._f.readline())
+
+    def readinto(self, b):
+        n = self._f.readinto(b)
+        self._count(bytes(b[:n]))
+        return n
+
+    def drain(self, chunk_bytes=1 << 20):
+        """Consume to EOF (counting), so crc/nbytes cover the file."""
+        while self.read(chunk_bytes):
+            pass
+
+
+def file_checksum(path, algo=None, chunk_bytes=1 << 20):
+    """(nbytes, crc) of a file, streamed in chunks (peak memory is one
+    chunk — the streaming-save memory contract extends to verification).
+    An `algo` this host cannot compute raises CorruptCheckpoint (treat
+    as unverifiable, not a crash)."""
+    algo = algo or preferred_algo()
+    update = checksum_update_fn(algo)
+    crc = 0
+    n = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk_bytes)
+            if not buf:
+                break
+            crc = update(buf, crc) & 0xFFFFFFFF
+            n += len(buf)
+    return n, crc
+
+
+def build_manifest(*, iter_num, form, files, algo=None, extra=None):
+    """`files`: {basename: (nbytes, crc) or (nbytes, crc, algo)}.
+    `form`: 'full' | 'sharded'. A per-file algo overrides the set-level
+    one — a pod's hosts can differ on whether the crc32c package is
+    installed, and each shard's CRC was computed by its writer."""
+    top = algo or preferred_algo()
+    ents = {}
+    for name, tup in sorted(files.items()):
+        nb, crc = tup[0], tup[1]
+        ent = {"bytes": int(nb), "crc": int(crc)}
+        if len(tup) > 2 and tup[2] and tup[2] != top:
+            ent["algo"] = tup[2]
+        ents[name] = ent
+    m = {
+        "format": MANIFEST_FORMAT,
+        "iter_num": int(iter_num),
+        "form": form,
+        "t": time.time(),
+        "algo": top,
+        "files": ents,
+    }
+    if extra:
+        m.update(extra)
+    return m
+
+
+def file_algo(manifest, name):
+    """The checksum algo for one manifest entry (per-file override or
+    the set-level default)."""
+    ent = manifest["files"][name]
+    return ent.get("algo", manifest.get("algo", "crc32"))
+
+
+def manifest_path(dirpath, form):
+    """Sharded sets own the directory's MANIFEST.json; the single-file
+    form uses a sidecar so both can coexist (out_dir holds a full
+    ckpt.pt AND a sharded set at different iterations)."""
+    if form == "sharded":
+        return os.path.join(dirpath, MANIFEST_NAME)
+    assert form == "full", form
+    return os.path.join(dirpath, "ckpt.pt" + SIDECAR_SUFFIX)
+
+
+def write_manifest(dirpath, manifest):
+    """Atomic write (json to .part, rename). For sharded sets this
+    rename IS the set's commit point; everything before it is torn."""
+    path = manifest_path(dirpath, manifest["form"])
+    tmp = path + ".part"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def load_manifest(dirpath, form):
+    """Parsed manifest, or None when absent/unparseable (an unparseable
+    manifest is an UNCOMMITTED set: the commit is the rename of a fully
+    written json, so garbage here means the commit never happened)."""
+    path = manifest_path(dirpath, form)
+    try:
+        with open(path) as f:
+            m = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if m.get("format") != MANIFEST_FORMAT:
+        return None
+    return m
+
+
+def verify_files(dirpath, manifest, files=None):
+    """Check size + CRC of `files` (default: every file in the manifest)
+    against the manifest's records. Raises CorruptCheckpoint naming every
+    failing file; size mismatch is reported distinctly from CRC mismatch
+    (truncation vs bit rot read differently in an incident)."""
+    names = list(manifest["files"]) if files is None else list(files)
+    bad = []
+    for name in names:
+        ent = manifest["files"].get(name)
+        path = os.path.join(dirpath, name)
+        if ent is None:
+            bad.append(f"{name}: not listed in the manifest")
+            continue
+        if not os.path.exists(path):
+            bad.append(f"{name}: missing")
+            continue
+        size = os.path.getsize(path)
+        if size != ent["bytes"]:
+            bad.append(f"{name}: {size} bytes, manifest says {ent['bytes']} "
+                       "(truncated or foreign write)")
+            continue
+        _, crc = file_checksum(path, algo=file_algo(manifest, name))
+        if crc != ent["crc"]:
+            bad.append(f"{name}: CRC {crc:#010x} != manifest "
+                       f"{ent['crc']:#010x} (bit corruption)")
+    if bad:
+        raise CorruptCheckpoint(
+            f"checkpoint in {dirpath} failed verification: " + "; ".join(bad)
+        )

@@ -20,6 +20,8 @@ import jax
 import numpy as np
 
 from avenir_tpu.obs.metrics import get_registry
+from avenir_tpu.utils.faults import get_injector
+from avenir_tpu.utils.retry import call_with_retry
 
 # the on-disk .bin format AND the H2D wire format are uint16 (half the
 # transfer bytes of int32 — the r5 win); any vocab that doesn't fit must
@@ -73,25 +75,61 @@ class DataLoader:
         self._prefetch_error = None
 
     def _sample_local(self, split):
-        arr = np.memmap(
-            os.path.join(self.data_dir, f"{split}.bin"), dtype=WIRE_DTYPE,
-            mode="r",
-        )
         n = self.grad_accum * self.local_batch
-        ix = self.rng.integers(0, len(arr) - self.block_size, size=n)
-        # tokens stay uint16 ON THE WIRE (the .bin dtype; every vocab here
-        # fits) — the jit'd step casts to int32 on device (train/step.py),
-        # halving H2D bytes per batch. Measured r5 on the tunneled bench
-        # chip: ~230ms of per-window transfer serialization at int32, the
-        # dominant loop-vs-step-harness gap; pods pay the same halving on
-        # DCN-attached hosts.
-        x = np.stack([arr[i : i + self.block_size] for i in ix])
-        y = np.stack([arr[i + 1 : i + 1 + self.block_size] for i in ix])
+        # the rng draw happens ONCE, before the (retryable) file reads:
+        # a flaky read retried by call_with_retry must re-read the SAME
+        # crops, or the consumed rng stream would depend on how flaky
+        # the storage was (breaking the deterministic-resume contract)
+        ix = None
+
+        def read():
+            nonlocal ix
+            get_injector().fail("data_read_fail", what=f"{split}.bin")
+            arr = np.memmap(
+                os.path.join(self.data_dir, f"{split}.bin"),
+                dtype=WIRE_DTYPE, mode="r",
+            )
+            if ix is None:
+                ix = self.rng.integers(0, len(arr) - self.block_size,
+                                       size=n)
+            # tokens stay uint16 ON THE WIRE (the .bin dtype; every vocab
+            # here fits) — the jit'd step casts to int32 on device
+            # (train/step.py), halving H2D bytes per batch. Measured r5
+            # on the tunneled bench chip: ~230ms of per-window transfer
+            # serialization at int32, the dominant loop-vs-step-harness
+            # gap; pods pay the same halving on DCN-attached hosts.
+            x = np.stack([arr[i : i + self.block_size] for i in ix])
+            y = np.stack([arr[i + 1 : i + 1 + self.block_size] for i in ix])
+            return x, y
+
+        x, y = call_with_retry(read, what=f"data read {split}.bin")
         if self.flat:
             shape = (self.local_batch, self.block_size)
         else:
             shape = (self.grad_accum, self.local_batch, self.block_size)
         return x.reshape(shape), y.reshape(shape)
+
+    def fast_forward(self, plan):
+        """Advance the sampling rng as if the draws had already happened:
+        `plan` is [(split, n_batches), ...] replayed in order. Resume
+        support (ISSUE 5): a relaunched run fast-forwards its fresh
+        loader past the batches the killed run consumed, making the
+        post-resume batch stream bit-identical to an uninterrupted
+        run's. The replay must use each split's REAL sampling bound —
+        numpy's bounded-integer rejection sampling consumes a
+        bound-dependent amount of the bit stream, so a dummy bound
+        would desync it."""
+        assert not self._buf and self._prefetch_thread is None, (
+            "fast_forward must run on a fresh loader (before any draw "
+            "or prefetch)"
+        )
+        n = self.grad_accum * self.local_batch
+        for split, count in plan:
+            nbytes = os.path.getsize(
+                os.path.join(self.data_dir, f"{split}.bin"))
+            hi = nbytes // np.dtype(WIRE_DTYPE).itemsize - self.block_size
+            for _ in range(int(count)):
+                self.rng.integers(0, hi, size=n)
 
     def _count(self, x, t0):
         """Batch-staging telemetry: wall time spent sampling + assembling

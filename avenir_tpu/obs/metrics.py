@@ -88,6 +88,26 @@ METRIC_SCHEMA = {
         "files whose header index ranges intersect this process's "
         "addressable shards — ~1/N of the set per process; "
         "docs/OPERATIONS.md)"),
+    # -- crash consistency / fault tolerance (ISSUE 5) --
+    "io_retries": (
+        "counter", "1",
+        "transient-IO retries taken by utils/retry.call_with_retry "
+        "(checkpoint body reads/writes, loader file reads); each also "
+        "writes a `retry` record to the run log"),
+    "ckpt_corrupt_detected": (
+        "counter", "1",
+        "checkpoint artifacts that failed manifest/checksum verification "
+        "at restore (uncommitted sets, truncation, bit rot)"),
+    "ckpt_fallback": (
+        "counter", "1",
+        "restores that fell back past a bad newest checkpoint to an "
+        "older committed generation (checkpoint/io."
+        "select_checkpoint_source)"),
+    "ckpt_save_errors": (
+        "counter", "1",
+        "checkpoint save attempts that raised (async writer-thread "
+        "failures surface at the next join/loop boundary; sync failures "
+        "raise in place)"),
     # -- watchdog --
     "watchdog_stalls": (
         "counter", "1", "stall-watchdog warnings fired"),
@@ -109,7 +129,13 @@ METRIC_SCHEMA = {
         "recorded once per region trace (see pipe_ticks_real)"),
     # -- serving engine (avenir_tpu/serve) --
     "serve_requests": (
-        "counter", "1", "requests completed by the serve engine"),
+        "counter", "1",
+        "requests completed by the serve engine (incl. timeouts)"),
+    "serve_timeouts": (
+        "counter", "1",
+        "requests that exceeded their deadline_ms (evicted from their "
+        "slot mid-decode, or expired while queued) and finished with "
+        "finish_reason='timeout'"),
     "tokens_out": (
         "counter", "tok",
         "tokens emitted by the serve engine (one per live slot per "

@@ -86,6 +86,8 @@ def summarize(records):
     losses = [(r["iter"], r["loss"]) for r in iters]
     dts = [r["dt_ms"] for r in iters if "dt_ms" in r]
     toks = [r["tok_per_sec"] for r in iters if "tok_per_sec" in r]
+    retries = _by_kind(records, "retry")
+    restores = _by_kind(records, "restore")
     requests = _by_kind(records, "request")
     serve = None
     if requests:
@@ -97,6 +99,8 @@ def summarize(records):
                       or float(sum(r.get("n_out", 0) for r in requests)))
         serve = {
             "n_requests": len(requests),
+            "n_timeouts": sum(1 for r in requests
+                              if r.get("finish_reason") == "timeout"),
             "tokens_out": tokens_out,
             "goodput_tok_per_sec": (tokens_out / (total_ms / 1e3)
                                     if total_ms else None),
@@ -128,6 +132,14 @@ def summarize(records):
         "restore_bytes": counters.get("ckpt_restore_bytes", 0.0),
         "pipe_ticks_real": counters.get("pipe_ticks_real", 0.0),
         "pipe_ticks_bubble": counters.get("pipe_ticks_bubble", 0.0),
+        # fault tolerance (ISSUE 5): counters carry totals when the run
+        # ended cleanly; the per-event records cover killed runs too
+        "io_retries": max(counters.get("io_retries", 0.0), len(retries)),
+        "ckpt_fallback": counters.get("ckpt_fallback", 0.0),
+        "ckpt_corrupt_detected": counters.get("ckpt_corrupt_detected", 0.0),
+        "ckpt_save_errors": counters.get("ckpt_save_errors", 0.0),
+        "n_restores": len(restores),
+        "restore_fallbacks": sum(r.get("skipped_bad", 0) for r in restores),
     }
 
 
@@ -194,6 +206,16 @@ def format_report(s):
             f"({s['pipe_ticks_real']:.0f} real / "
             f"{s['pipe_ticks_bubble']:.0f} bubble tick-slots, summed "
             "over region traces)")
+    if s["io_retries"]:
+        extras.append(f"flaky IO: {s['io_retries']:.0f} transient-read/"
+                      "write retries (see `retry` records)")
+    if s["ckpt_save_errors"]:
+        extras.append(f"CHECKPOINT SAVE ERRORS: {s['ckpt_save_errors']:.0f}")
+    if s["ckpt_corrupt_detected"] or s["ckpt_fallback"]:
+        extras.append(
+            f"CHECKPOINT CORRUPTION: {s['ckpt_corrupt_detected']:.0f} "
+            f"artifact(s) refused, {s['ckpt_fallback']:.0f} restore "
+            "fallback(s) to an older generation — check the storage")
     if s["n_stalls"]:
         extras.append(f"WATCHDOG STALL WARNINGS: {s['n_stalls']}")
     if extras:
@@ -206,7 +228,9 @@ def format_report(s):
         lines.append(f"  requests: {sv['n_requests']}   "
                      f"tokens out: {sv['tokens_out']:,.0f}"
                      + (f"   goodput {sv['goodput_tok_per_sec']:,.1f} tok/s"
-                        if sv["goodput_tok_per_sec"] is not None else ""))
+                        if sv["goodput_tok_per_sec"] is not None else "")
+                     + (f"   TIMEOUTS: {sv['n_timeouts']}"
+                        if sv.get("n_timeouts") else ""))
         if sv["ttft_p50_ms"] is not None:
             lines.append(f"  ttft: p50 {sv['ttft_p50_ms']:.1f} ms  "
                          f"p99 {sv['ttft_p99_ms']:.1f} ms")

@@ -23,6 +23,8 @@ RECORD_KINDS = {
     "compile",    # per first-dispatch of a window length: compile wall
     "stall",      # watchdog warning: seconds since last progress
     "request",    # per finished serve-engine request: ttft/tpot/tokens
+    "retry",      # per transient-IO retry (utils/retry.py): site + delay
+    "restore",    # per resume source decision: dir, kind, fallback count
     "run_end",    # one per run, at exit: final counter snapshot
 }
 
@@ -65,3 +67,22 @@ class NullSink:
 
     def close(self):
         pass
+
+
+# process-wide "current run log" handle, for library layers that have no
+# sink plumbed through their call chain (the retry wrapper fires from
+# loader prefetch threads and checkpoint writer threads). The training
+# loop installs its JsonlSink for the duration of the run; outside a run
+# the default is a NullSink, so call sites stay branch-free.
+_run_sink = [None]
+
+
+def get_run_sink():
+    return _run_sink[0] if _run_sink[0] is not None else NullSink()
+
+
+def set_run_sink(sink):
+    """Install `sink` as the process run log; returns the previous one
+    (restore it when the run ends — a closed sink must not linger)."""
+    prev, _run_sink[0] = _run_sink[0], sink
+    return prev

@@ -29,15 +29,24 @@ from contextlib import contextmanager
 class StallWatchdog:
     def __init__(self, *, floor_secs, factor=10.0, poll_secs=1.0,
                  registry=None, sink=None, dump_stacks=True,
-                 echo=print):
+                 echo=print, fatal_count=0, exit_fn=None):
         """`floor_secs`: minimum stall threshold (the --watchdog_secs
         flag; also the only threshold until the first window lands).
         `factor`: multiple of the median completed-window time that
-        counts as a stall once windows have completed."""
+        counts as a stall once windows have completed.
+        `fatal_count` (the --watchdog_fatal_count flag, default 0=off):
+        after that many CONSECUTIVE warnings with no progress between
+        them, dump stacks one last time and exit the process non-zero —
+        a hung collective holds every process of a pod hostage forever
+        otherwise, and a supervisor can only restart a job that DIES.
+        `exit_fn` is injectable for tests; the default is os._exit
+        (sys.exit from a daemon thread cannot kill the process)."""
         assert floor_secs > 0 and factor > 0
         self.floor_secs = float(floor_secs)
         self.factor = float(factor)
         self.poll_secs = float(poll_secs)
+        self.fatal_count = int(fatal_count or 0)
+        self._exit_fn = exit_fn if exit_fn is not None else self._os_exit
         self._registry = registry
         self._sink = sink
         self._dump_stacks = dump_stacks
@@ -48,16 +57,28 @@ class StallWatchdog:
         self._iter = 0
         self._paused = 0  # >0: inside a declared host boundary, don't fire
         self._warned_at = None  # monotonic time of last warning, or None
+        self._consecutive = 0  # warnings since the last progress/pause
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="avenir-stall-watchdog", daemon=True)
         self._thread.start()
+
+    # non-zero and distinctive: a supervisor (or a human reading pod
+    # logs) can tell a watchdog kill from an OOM or a python traceback
+    FATAL_EXIT_CODE = 70  # EX_SOFTWARE
+
+    @staticmethod
+    def _os_exit(code):  # pragma: no cover — tests inject exit_fn
+        import os
+
+        os._exit(code)
 
     def notify(self, window_secs=None, iter_num=None):
         """Record loop progress (call on every completed window)."""
         with self._lock:
             self._last_progress = time.monotonic()
             self._warned_at = None
+            self._consecutive = 0
             if iter_num is not None:
                 self._iter = int(iter_num)
             if window_secs is not None:
@@ -82,6 +103,7 @@ class StallWatchdog:
                 self._paused -= 1
                 self._last_progress = time.monotonic()
                 self._warned_at = None
+                self._consecutive = 0
 
     def threshold_secs(self):
         with self._lock:
@@ -115,11 +137,17 @@ class StallWatchdog:
             self._fire(since, thr)
 
     def _fire(self, since, thr):
+        with self._lock:
+            self._consecutive += 1
+            consecutive = self._consecutive
+        fatal = bool(self.fatal_count) and consecutive >= self.fatal_count
         self._echo(
             f"[watchdog] no training window completed in {since:.1f}s "
             f"(stall threshold {thr:.1f}s = max(floor {self.floor_secs:.1f}s, "
             f"{self.factor:.0f}x median window)); last progress at iter "
             f"{self._iter} — a hung collective or wedged host thread?"
+            + (f" [warning {consecutive}/{self.fatal_count} before fatal "
+               "exit]" if self.fatal_count else "")
         )
         if self._registry is not None:
             self._registry.counter("watchdog_stalls").add(1)
@@ -127,9 +155,9 @@ class StallWatchdog:
             self._sink.write({
                 "kind": "stall", "t": time.time(), "iter": self._iter,
                 "secs_since_progress": round(since, 3),
-                "threshold_s": round(thr, 3),
+                "threshold_s": round(thr, 3), "fatal": fatal,
             })
-        if self._dump_stacks:
+        if self._dump_stacks or fatal:
             import faulthandler
 
             self._echo("[watchdog] python stacks of all threads:")
@@ -137,3 +165,15 @@ class StallWatchdog:
                 faulthandler.dump_traceback(file=sys.stderr)
             except Exception:
                 pass  # never let diagnostics kill the watchdog
+        if fatal:
+            # escalation (ISSUE 5 satellite): the loop is not coming
+            # back — exit non-zero so a pod supervisor restarts the job
+            # (which resumes from the last committed checkpoint). The
+            # JSONL sink flushes per write, so the stall record above is
+            # already durable.
+            self._echo(
+                f"[watchdog] FATAL: {consecutive} consecutive stall "
+                f"warnings with no progress — exiting "
+                f"{self.FATAL_EXIT_CODE} for the supervisor to restart"
+            )
+            self._exit_fn(self.FATAL_EXIT_CODE)

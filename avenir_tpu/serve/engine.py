@@ -73,9 +73,9 @@ class FinishedRequest:
     tokens: List[int]          # prompt + emitted (stop token included)
     n_prompt: int
     n_out: int
-    finish_reason: str         # 'stop' | 'length'
+    finish_reason: str         # 'stop' | 'length' | 'timeout'
     text: Optional[str]        # detokenized, when a codec was given
-    ttft_ms: float
+    ttft_ms: Optional[float]   # None: timed out before the first token
     tpot_ms: float
 
 
@@ -100,7 +100,11 @@ class Engine:
 
     def __init__(self, model, *, n_slots=4, max_seq_len=None,
                  detokenize: Optional[Callable] = None, registry=None,
-                 sink=None, seed=0):
+                 sink=None, seed=0, clock=None):
+        # one clock for submit timestamps, TTFT/TPOT, and deadline
+        # expiry — injectable so the deadline tests drive time instead
+        # of sleeping through it
+        self._clock = clock if clock is not None else time.perf_counter
         cfg = model.config
         self.model = model
         self.n_slots = int(n_slots)
@@ -203,13 +207,17 @@ class Engine:
         self._state = nnx.split(self.model)[1]
 
     def submit(self, prompt, *, max_new_tokens, temperature=1.0,
-               top_k=None, stop_tokens=(), rng=None):
+               top_k=None, stop_tokens=(), rng=None, deadline_ms=None):
         """Enqueue a request; returns its id. `rng` defaults to
         fold_in(engine seed, id) — pass an explicit key to reproduce a
-        one-shot `generate_cached` run."""
+        one-shot `generate_cached` run. `deadline_ms` (None = none): a
+        wall-time budget from submission; past it the request finishes
+        with finish_reason='timeout' — evicted from its slot (partial
+        tokens returned) or dropped from the queue before prefill."""
         prompt = tuple(int(t) for t in prompt)
         assert prompt, "empty prompt"
         assert max_new_tokens >= 1
+        assert deadline_ms is None or deadline_ms > 0
         if len(prompt) + max_new_tokens > self.T_max:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
@@ -223,17 +231,22 @@ class Engine:
             req_id=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
             temperature=float(temperature), top_k=top_k,
             stop_tokens=_normalize_stop(stop_tokens) or (), rng=rng,
-            submit_t=time.perf_counter(),
+            submit_t=self._clock(),
+            deadline_ms=None if deadline_ms is None else float(deadline_ms),
         )
         self.sched.enqueue(req)
         self._reg.gauge("queue_depth").set(self.sched.queue_depth)
         return rid
 
     def step(self):
-        """One scheduler iteration: admit, one batched decode dispatch,
-        harvest. Returns the requests that finished this iteration."""
+        """One scheduler iteration: expire, admit, one batched decode
+        dispatch, harvest. Returns the requests that finished this
+        iteration (including timeouts)."""
         state = self._state
         V = self.pool.logits.shape[-1]
+        finished = []
+        for req in self.sched.expire_queued(self._clock()):
+            finished.append(self._finish_queued_timeout(req))
         for req, slot in self.sched.take_admissions():
             t0 = len(req.prompt)
             t_pad = self.sched.bucket(t0)
@@ -248,7 +261,6 @@ class Engine:
                 )
             self._live[slot] = _Live(req)
 
-        finished = []
         if self._live:
             active = np.zeros((self.n_slots,), bool)
             active[list(self._live)] = True
@@ -256,7 +268,7 @@ class Engine:
                 toks, self.pool = self._step_fn(state, self.pool,
                                                 jnp.asarray(active))
                 toks = np.asarray(toks)  # the per-iteration D2H fence
-            now = time.perf_counter()
+            now = self._clock()
             self._reg.counter("tokens_out").add(len(self._live))
             for slot in sorted(self._live):
                 live = self._live[slot]
@@ -273,6 +285,16 @@ class Engine:
                 if hit_stop or len(live.emitted) >= live.req.max_new_tokens:
                     finished.append(self._finish(
                         slot, live, "stop" if hit_stop else "length"))
+            # deadline eviction AFTER harvest: this iteration's token is
+            # kept (the request pays for it either way), then the slot
+            # is recycled — surviving co-tenants are untouched, so their
+            # streams stay bit-identical to a one-shot run (the same
+            # argument as stop-token recycling; parity-tested)
+            now = self._clock()
+            for slot in sorted(self._live):
+                live = self._live[slot]
+                if live.req.expired(now):
+                    finished.append(self._finish(slot, live, "timeout"))
         self._reg.gauge("queue_depth").set(self.sched.queue_depth)
         self._reg.gauge("slot_occupancy").set(len(self._live) / self.n_slots)
         assert len(self.traces["prefill"]) <= len(self.sched.ladder), (
@@ -317,10 +339,13 @@ class Engine:
         self.pool = self.pool._replace(
             top_k=self.pool.top_k.at[slot].set(V))
         n_out = len(live.emitted)
-        ttft_ms = (live.t_first - req.submit_t) * 1e3
+        ttft_ms = ((live.t_first - req.submit_t) * 1e3
+                   if live.t_first is not None else None)
         tpot_ms = ((live.t_last - live.t_first) / (n_out - 1) * 1e3
                    if n_out > 1 else 0.0)
         self._reg.counter("serve_requests").add(1)
+        if reason == "timeout":
+            self._reg.counter("serve_timeouts").add(1)
         if n_out > 1:  # tpot is undefined for single-token requests
             self._reg.hist("tpot_ms").observe(tpot_ms)
         rec = FinishedRequest(
@@ -332,9 +357,29 @@ class Engine:
         record = {
             "kind": "request", "t": time.time(), "id": req.req_id,
             "n_prompt": rec.n_prompt, "n_out": n_out,
-            "finish_reason": reason, "ttft_ms": ttft_ms,
+            "finish_reason": reason,
         }
+        if ttft_ms is not None:
+            record["ttft_ms"] = ttft_ms
         if n_out > 1:  # omitted (not 0.0) so report percentiles stay honest
             record["tpot_ms"] = tpot_ms
         self.sink.write(record)
+        return rec
+
+    def _finish_queued_timeout(self, req):
+        """A request whose deadline passed while it was still QUEUED: it
+        never held a slot and emitted nothing — no pool state to touch."""
+        self._reg.counter("serve_requests").add(1)
+        self._reg.counter("serve_timeouts").add(1)
+        rec = FinishedRequest(
+            req_id=req.req_id, tokens=list(req.prompt),
+            n_prompt=len(req.prompt), n_out=0, finish_reason="timeout",
+            text="" if self.detokenize is not None else None,
+            ttft_ms=None, tpot_ms=0.0,
+        )
+        self.sink.write({
+            "kind": "request", "t": time.time(), "id": req.req_id,
+            "n_prompt": rec.n_prompt, "n_out": 0,
+            "finish_reason": "timeout",
+        })
         return rec

@@ -39,6 +39,15 @@ class Request:
     stop_tokens: Tuple[int, ...] = ()
     rng: object = None
     submit_t: float = 0.0
+    # wall-time budget from submit, in ms; None = no deadline. An
+    # expired request finishes with finish_reason='timeout' — evicted
+    # from its slot mid-decode, or dropped from the queue before it
+    # ever burns a prefill (ISSUE 5 satellite).
+    deadline_ms: Optional[float] = None
+
+    def expired(self, now):
+        return (self.deadline_ms is not None
+                and (now - self.submit_t) * 1e3 >= self.deadline_ms)
 
 
 class FCFSScheduler:
@@ -72,6 +81,17 @@ class FCFSScheduler:
         return len(self._free)
 
     # -- admission / recycling --
+
+    def expire_queued(self, now):
+        """Pop (and return) every queued request whose deadline has
+        passed — BEFORE admission, so a request that can no longer be
+        served never burns a prefill dispatch or blocks the FCFS head."""
+        expired = [r for r in self._queue if r.expired(now)]
+        if expired:
+            dead = {r.req_id for r in expired}
+            self._queue = deque(r for r in self._queue
+                                if r.req_id not in dead)
+        return expired
 
     def take_admissions(self):
         """Pop (request, slot) pairs while both a queued request and a

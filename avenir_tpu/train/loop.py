@@ -246,35 +246,29 @@ def run_training(cfg):
     ckpt_sharded = None
     sh_meta = None
     hf_init = None
+    resume_src = None
     if cfg["init_from"] == "scratch":
         model_args["vocab_size"] = meta_vocab_size if meta_vocab_size else 50304
     elif cfg["init_from"] == "resume":
-        # prefer whichever artifact is NEWER: the per-host sharded set
-        # (async eval-cadence saves on pods) or the full ckpt.pt (final/
-        # SIGTERM saves, single-process saves, the torch bridge)
-        from avenir_tpu.checkpoint.io import load_sharded_checkpoint
+        # crash-consistent source selection (ISSUE 5): pick the newest
+        # artifact — live ckpt.pt, live sharded set, or a ring
+        # generation — that passes manifest/checksum verification,
+        # falling back past corrupt or uncommitted candidates (counted
+        # as ckpt_corrupt_detected / ckpt_fallback). Every process walks
+        # the same shared-storage state, so the decision agrees.
+        from avenir_tpu.checkpoint.io import select_checkpoint_source
 
-        # headers only for the decision — assembling the sharded tensors
-        # costs N full-checkpoint reads per process, wasted whenever the
-        # full ckpt.pt turns out newer (any SIGTERM/final save)
-        sh_meta = load_sharded_checkpoint(cfg["out_dir"], meta_only=True)
-        have_full = os.path.exists(os.path.join(cfg["out_dir"], "ckpt.pt"))
-        if have_full:
+        resume_src = select_checkpoint_source(cfg["out_dir"])
+        if resume_src["kind"] == "full":
             # lazy: tensors stream from the zip one at a time during restore
-            ckpt = load_checkpoint(cfg["out_dir"], lazy=True)
-            if sh_meta is not None and sh_meta["iter_num"] <= int(ckpt["iter_num"]):
-                sh_meta = None
-            elif sh_meta is not None:
-                ckpt = None
+            ckpt = resume_src["meta"]
+        else:
+            sh_meta = resume_src["meta"]
         # NB the sharded BODIES are read only after setup_state below:
         # the locality-aware loader needs the mesh shardings to read just
         # the shard files whose index ranges intersect this process's
         # addressable shards (advisor r5 — kills the O(N×ckpt) read
         # amplification docs/OPERATIONS.md used to document as a cost)
-        assert ckpt is not None or sh_meta is not None, (
-            f"init_from=resume but {cfg['out_dir']} has neither ckpt.pt "
-            "nor a complete ckpt-shard-*.pkl set"
-        )
         src = ckpt if ckpt is not None else sh_meta
         for k in ("n_layer", "n_head", "n_embd", "block_size", "bias", "vocab_size"):
             model_args[k] = src["model_args"][k]
@@ -284,7 +278,8 @@ def run_training(cfg):
         best_val_loss = float(src["best_val_loss"])
         if master:
             form = "sharded set" if ckpt is None else "ckpt.pt"
-            print(f"resuming from {cfg['out_dir']} ({form}) at iter {iter_num}")
+            print(f"resuming from {resume_src['dir']} ({form}) at iter "
+                  f"{iter_num}")
     elif cfg["init_from"].startswith("gpt2"):
         # finetune from HF GPT-2 (train.py:167-176 torch equivalent)
         from avenir_tpu.tools.hf_import import HF_CONFIGS, hf_sd_to_torch_layout, _load_hf_numpy_sd
@@ -310,15 +305,18 @@ def run_training(cfg):
     if cfg["init_from"] == "resume" and sh_meta is not None:
         # body read, now that the shardings say which index ranges this
         # process actually hosts — only intersecting files are opened
-        from avenir_tpu.checkpoint.io import local_shard_ranges
+        from avenir_tpu.checkpoint.io import (
+            load_sharded_checkpoint,
+            local_shard_ranges,
+        )
 
         ckpt_sharded = load_sharded_checkpoint(
-            cfg["out_dir"],
+            resume_src["dir"],
             local_ranges=local_shard_ranges(st["abs_state"], shardings),
         )
         assert ckpt_sharded is not None, (
-            f"sharded set in {cfg['out_dir']} disappeared or tore between "
-            "the header check and the body read"
+            f"sharded set in {resume_src['dir']} disappeared or tore "
+            "between the header check and the body read"
         )
     if master:
         # print the RESOLVED hot-path impls — a silent fallback to the slow
@@ -400,6 +398,20 @@ def run_training(cfg):
         sharding=eval_sharding, grad_accum=1, seed=cfg["seed"] + 1, flat=True,
         vocab_size=model_args["vocab_size"],
     )
+    if cfg["init_from"] == "resume" and iter_num > 0:
+        # deterministic resume (ISSUE 5): a fresh loader's rng starts at
+        # draw 0, but the run being resumed consumed one train draw per
+        # iteration — replay the rng stream to where the kill left it,
+        # so the post-resume batch sequence is BIT-IDENTICAL to the
+        # uninterrupted run's (tools/chaos_train.py asserts the final
+        # loss matches exactly). The eval loader likewise skips the
+        # draws of every eval that ran at iters < iter_num (the eval AT
+        # iter_num re-runs on resume, so it is not skipped).
+        train_loader.fast_forward([("train", iter_num)])
+        n_past_evals = (iter_num - 1) // cfg["eval_interval"] + 1
+        eval_loader.fast_forward(
+            [("train", cfg["eval_iters"]), ("val", cfg["eval_iters"])]
+            * n_past_evals)
 
     # ---- step fns ----
     train_step_fn, eval_step_fn = make_step_fns(
@@ -483,6 +495,7 @@ def run_training(cfg):
             model_args=model_args, iter_num=it,
             best_val_loss=best_val_loss, config=cfg,
             model_family=st["model_type"],
+            keep_checkpoints=int(cfg.get("keep_checkpoints", 2)),
         )
         # the span counts only LOOP-BLOCKING time: snapshot + enqueue for
         # async saves, the whole write for sync ones (the async writer's
@@ -536,10 +549,24 @@ def run_training(cfg):
     sink = (JsonlSink(os.path.join(cfg["out_dir"], "metrics.jsonl"),
                       append=(cfg["init_from"] == "resume"))
             if (cfg.get("metrics_log", True) and master) else NullSink())
+    # the process run-log handle: library layers without a plumbed sink
+    # (the retry wrapper, writer threads) log retries through this
+    from avenir_tpu.obs.sink import set_run_sink
+
+    _prev_sink = set_run_sink(sink)
+    if resume_src is not None:
+        sink.write({
+            "kind": "restore", "t": time.time(), "iter": iter_num,
+            "source_kind": resume_src["kind"],
+            "source_dir": resume_src["dir"],
+            "skipped_bad": resume_src["skipped_bad"],
+            "counters": reg.counters(),
+        })
     wd = None
     if float(cfg.get("watchdog_secs", 0) or 0) > 0:
         wd = StallWatchdog(
             floor_secs=float(cfg["watchdog_secs"]), registry=reg, sink=sink,
+            fatal_count=int(cfg.get("watchdog_fatal_count", 0) or 0),
             echo=(print if master else
                   (lambda m: print(f"[p{jax.process_index()}] {m}"))),
         )
@@ -802,6 +829,14 @@ def run_training(cfg):
                                   iter_num=iter_num + 1)
                     _t0[0] = _now  # keep per-iter timing (old t0 contract)
             iter_num += K
+            # surface async-writer failures at the NEXT loop boundary
+            # (ISSUE 5 satellite): a writer thread that died must not
+            # stay silent until the next save decision happens to join
+            # it — a finished handle joins here for free (no blocking;
+            # join() re-raises the writer's exception)
+            if pending_ckpt[0] is not None and pending_ckpt[0].done():
+                pending_ckpt[0].join()
+                pending_ckpt[0] = None
             # coordinated preemption (r5, VERDICT r4 missing #3): SIGTERM
             # lands at different iterations on different processes, so no
             # process may save unilaterally (a lone collective save
@@ -869,7 +904,8 @@ def run_training(cfg):
                 "kind": "run_end", "t": time.time(), "iter": iter_num,
                 "best_val_loss": float(best_val_loss), **snap,
             })
-            sink.close()
+            set_run_sink(_prev_sink)  # before close: no writes to a
+            sink.close()              # closed sink from stray threads
 
     return {
         "iter_num": iter_num, "best_val_loss": float(best_val_loss),

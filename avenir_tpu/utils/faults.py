@@ -1,0 +1,125 @@
+"""Deterministic fault injection for the IO paths (ISSUE 5 tentpole).
+
+The crash-consistency story is only trustworthy if it is EXERCISED:
+this module lets a test (or tools/chaos_train.py) make the checkpoint
+writer fail transiently, the reader see corrupt bytes, or the data
+loader hit flaky storage — without monkeypatching library internals.
+
+Spec format (env var `AVENIR_FAULTS`, or FaultInjector(spec)):
+
+    AVENIR_FAULTS="ckpt_write_fail:p=0.3,read_corrupt:p=0.05:n=1"
+
+Comma-separated sites, colon-separated options per site:
+    p=<float>   probability a consult fires (default 1.0)
+    n=<int>     max total fires for the site (default unlimited)
+    after=<int> skip the first N consults (default 0)
+
+`AVENIR_FAULTS_SEED` seeds the injector's private rng, so a chaos run's
+fault schedule is reproducible from its seed alone.
+
+Sites consulted by the production IO paths:
+
+    ckpt_write_fail      raise OSError before a checkpoint body/manifest
+                         rename lands (checkpoint/io.py writers)
+    ckpt_read_fail       raise OSError before a checkpoint body read
+    read_corrupt         flip one byte in checkpoint body bytes as read
+                         (detected by the manifest CRC, never retried)
+    data_read_fail       raise OSError in DataLoader._sample_local
+
+The default injector (no env var) is inert: `enabled()` is a dict
+lookup returning False, so the hot paths pay nothing. Inject faults in
+tests with `set_injector(FaultInjector("..."))`, restoring after.
+"""
+
+import os
+import random
+
+
+class FaultInjected(OSError):
+    """The injected transient-IO error. An OSError subclass ON PURPOSE:
+    the retry policy must treat injected write/read failures exactly
+    like real EIO/ESTALE, or the harness would not be testing the
+    production retry path."""
+
+
+def _parse_spec(spec):
+    sites = {}
+    for entry in filter(None, (s.strip() for s in spec.split(","))):
+        parts = entry.split(":")
+        opts = {"p": 1.0, "n": None, "after": 0}
+        for opt in parts[1:]:
+            k, _, v = opt.partition("=")
+            assert k in opts, f"unknown fault option {k!r} in {entry!r}"
+            opts[k] = float(v) if k == "p" else int(v)
+        sites[parts[0]] = opts
+    return sites
+
+
+class FaultInjector:
+    def __init__(self, spec="", seed=0):
+        self.sites = _parse_spec(spec or "")
+        self._rng = random.Random(seed)
+        self.fired = {}     # site -> times a consult fired
+        self.consults = {}  # site -> times a consult happened
+
+    @classmethod
+    def from_env(cls):
+        return cls(os.environ.get("AVENIR_FAULTS", ""),
+                   seed=int(os.environ.get("AVENIR_FAULTS_SEED", "0")))
+
+    def enabled(self, site):
+        return site in self.sites
+
+    def should_fire(self, site):
+        """Consult the schedule; True when the fault fires this time."""
+        opts = self.sites.get(site)
+        if opts is None:
+            return False
+        seen = self.consults.get(site, 0)
+        self.consults[site] = seen + 1
+        if seen < opts["after"]:
+            return False
+        if opts["n"] is not None and self.fired.get(site, 0) >= opts["n"]:
+            return False
+        if self._rng.random() >= opts["p"]:
+            return False
+        self.fired[site] = self.fired.get(site, 0) + 1
+        return True
+
+    def fail(self, site, what=""):
+        """Raise FaultInjected when the site fires; no-op otherwise."""
+        if self.should_fire(site):
+            raise FaultInjected(f"injected fault {site!r}"
+                                + (f" ({what})" if what else ""))
+
+    def corrupt(self, site, data):
+        """Flip one byte of `data` (bytes) when the site fires. The flip
+        position is drawn from the injector rng, so it is reproducible
+        and can land anywhere — header, body, or manifest bytes."""
+        if not data or not self.should_fire(site):
+            return data
+        pos = self._rng.randrange(len(data))
+        out = bytearray(data)
+        out[pos] ^= 0xFF
+        return bytes(out)
+
+    def report(self):
+        """{site: {consults, fired}} — chaos_train's JSON artifact."""
+        return {s: {"consults": self.consults.get(s, 0),
+                    "fired": self.fired.get(s, 0)}
+                for s in self.sites}
+
+
+_injector = [None]
+
+
+def get_injector():
+    if _injector[0] is None:
+        _injector[0] = FaultInjector.from_env()
+    return _injector[0]
+
+
+def set_injector(inj):
+    """Swap the process injector (tests); returns the previous one."""
+    prev, _injector[0] = _injector[0], inj
+    return prev

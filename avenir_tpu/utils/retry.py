@@ -1,0 +1,103 @@
+"""Capped exponential backoff for flaky IO (ISSUE 5 tentpole, part 3).
+
+Production filesystems (GCS fuse mounts, NFS exports, preempted-node
+local disks) return transient EIO/ESTALE/ECONNRESET long before they
+return clean data — a training run that dies on the first flaky read
+wastes everything since the last checkpoint. `call_with_retry` wraps the
+IO-shaped call sites (checkpoint body reads/writes, loader memmap reads)
+with a small, fully deterministic-under-test policy:
+
+  delay_n = min(cap, base * 2**n) * (1 + jitter * u),  u ~ U[0, 1)
+
+Every retry increments the `io_retries` counter and writes a `retry`
+record to the JSONL run log (obs.sink.get_run_sink), so flaky storage is
+VISIBLE in tools/obs_report.py output instead of silently stretching
+step time. Exhausted attempts re-raise the last error — retries mask
+transience, never corruption (checksum failures are NOT retryable:
+avenir_tpu/checkpoint/manifest.py raises CorruptCheckpoint, which no
+policy here catches).
+
+Testing: `clock` and `rng` are injectable, so the backoff sequence is
+asserted without sleeping (tests/test_retry.py).
+"""
+
+import time
+
+
+class RetryPolicy:
+    """Immutable backoff description. `sleep`/`rng` injectable for tests;
+    `attempts` counts TOTAL tries (1 = no retries)."""
+
+    def __init__(self, attempts=4, base_s=0.05, cap_s=2.0, jitter=0.25,
+                 sleep=time.sleep, rng=None):
+        assert attempts >= 1 and base_s >= 0 and cap_s >= base_s
+        self.attempts = int(attempts)
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.jitter = float(jitter)
+        self.sleep = sleep
+        import random
+
+        self._rng = rng if rng is not None else random.Random()
+
+    def delay_s(self, n_failures):
+        """Backoff before the (n_failures+1)-th try (n_failures >= 1)."""
+        d = min(self.cap_s, self.base_s * (2 ** (n_failures - 1)))
+        return d * (1.0 + self.jitter * self._rng.random())
+
+
+# module default, swappable in tests (e.g. a no-sleep policy for the
+# whole suite) via set_default_policy
+_default = [RetryPolicy()]
+
+
+def set_default_policy(policy):
+    prev, _default[0] = _default[0], policy
+    return prev
+
+
+def default_policy():
+    return _default[0]
+
+
+# errors worth retrying: the OS-level transient class. ValueError /
+# pickle / zip errors are NOT here on purpose — garbage bytes must
+# surface as corruption (fallback territory), not burn the retry budget.
+TRANSIENT_ERRORS = (OSError,)
+
+
+def call_with_retry(fn, *, what, policy=None, retry_on=TRANSIENT_ERRORS,
+                    registry=None, sink=None, echo=print):
+    """Run `fn()` with up to policy.attempts tries. Each retry is counted
+    (`io_retries`), logged to the run sink as a `retry` record, and
+    echoed — a retried save that eventually lands must leave a trace.
+    The final failure re-raises the ORIGINAL exception."""
+    policy = policy or _default[0]
+    if registry is None:
+        from avenir_tpu.obs.metrics import get_registry
+
+        registry = get_registry()
+    if sink is None:
+        from avenir_tpu.obs.sink import get_run_sink
+
+        sink = get_run_sink()
+    failures = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            failures += 1
+            if failures >= policy.attempts:
+                raise
+            delay = policy.delay_s(failures)
+            registry.counter("io_retries").add(1)
+            echo(f"[retry] {what}: attempt {failures}/{policy.attempts} "
+                 f"failed ({type(e).__name__}: {e}); retrying in "
+                 f"{delay * 1e3:.0f}ms")
+            sink.write({
+                "kind": "retry", "t": time.time(), "what": what,
+                "attempt": failures, "max_attempts": policy.attempts,
+                "error": f"{type(e).__name__}: {e}",
+                "delay_ms": round(delay * 1e3, 3),
+            })
+            policy.sleep(delay)

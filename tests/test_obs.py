@@ -161,6 +161,66 @@ def test_watchdog_pause_suppresses_firing_during_boundaries():
         wd.stop()
 
 
+def test_watchdog_fatal_escalation_exits_after_n_fires(capsys):
+    """--watchdog_fatal_count (ISSUE 5 satellite): after N consecutive
+    stall warnings with no progress, the watchdog dumps stacks one last
+    time and calls the (injected) exit with the fatal code — a pod
+    supervisor restarts the job from the last committed checkpoint."""
+    reg = MetricsRegistry()
+
+    class _Sink:
+        records = []
+
+        def write(self, r):
+            self.records.append(r)
+
+    exits = []
+    wd = StallWatchdog(floor_secs=0.03, factor=2.0, poll_secs=0.01,
+                       registry=reg, sink=_Sink(), dump_stacks=False,
+                       fatal_count=3, exit_fn=exits.append)
+    try:
+        wd.notify(window_secs=0.01, iter_num=2)
+        deadline = time.time() + 5.0
+        while not exits and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        wd.stop()
+    assert exits and exits[0] == StallWatchdog.FATAL_EXIT_CODE
+    assert reg.counter("watchdog_stalls").total >= 3
+    fatal_recs = [r for r in _Sink.records if r.get("fatal")]
+    assert fatal_recs and fatal_recs[0]["kind"] == "stall"
+    out = capsys.readouterr().out
+    assert "FATAL" in out and "python stacks" in out
+
+
+def test_watchdog_fatal_counter_resets_on_progress():
+    """Progress between warnings resets the consecutive count: a loop
+    that stalls, recovers, and stalls again must NOT accumulate toward
+    the fatal exit across recoveries."""
+    reg = MetricsRegistry()
+    exits = []
+    # fire _fire directly (floor/poll park the real thread): the poll
+    # cadence is load-sensitive, and a stretched sleep on a busy CI box
+    # could legitimately accumulate fatal_count fires in ONE gap — the
+    # reset property needs deterministic driving
+    wd = StallWatchdog(floor_secs=100.0, factor=2.0, poll_secs=100.0,
+                       registry=reg, dump_stacks=False, fatal_count=4,
+                       exit_fn=exits.append, echo=lambda m: None)
+    try:
+        for _ in range(3):
+            wd._fire(1.0, 0.5)
+        assert not exits  # 3 consecutive < fatal_count
+        wd.notify(window_secs=0.01, iter_num=1)  # progress resets
+        for _ in range(3):
+            wd._fire(1.0, 0.5)
+        assert not exits  # reset worked: 3 again, not 6
+        wd._fire(1.0, 0.5)  # 4th consecutive without progress
+        assert exits == [StallWatchdog.FATAL_EXIT_CODE]
+        assert reg.counter("watchdog_stalls").total == 7
+    finally:
+        wd.stop()
+
+
 def test_watchdog_threshold_tracks_median():
     wd = StallWatchdog(floor_secs=1.0, factor=10.0, poll_secs=10.0,
                        dump_stacks=False)

@@ -237,6 +237,90 @@ def test_engine_metrics_and_jsonl(gpt_fix, tmp_path):
     assert "-- serving --" in format_report(s)
 
 
+class _Clock:
+    """Injectable engine clock: the deadline tests drive time forward
+    instead of sleeping through real wall time."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_deadline_evicts_live_slot_survivors_bit_identical(gpt_fix):
+    """A request that exceeds deadline_ms mid-decode is evicted with
+    finish_reason='timeout' and its partial tokens; the co-tenant that
+    survives stays BIT-IDENTICAL to its one-shot reference (eviction is
+    the same slot-recycling path the stop-token tests pin)."""
+    model, reqs = gpt_fix
+    clk = _Clock()
+    reg = MetricsRegistry()
+    engine = Engine(model, n_slots=2, max_seq_len=32, registry=reg,
+                    clock=clk)
+    kw_survivor, ref = reqs[1]  # plain length-terminated reference
+    sid = engine.submit(**kw_survivor)
+    tid = engine.submit([5, 6, 7], max_new_tokens=MAX_NEW,
+                        deadline_ms=50.0)
+    done = engine.step()  # both admitted, first token each
+    assert done == []
+    clk.t = 0.2  # 200 ms >> the 50 ms deadline
+    done = engine.step()
+    assert [f.req_id for f in done] == [tid]
+    assert done[0].finish_reason == "timeout"
+    assert done[0].n_out == 2  # kept its partial output
+    assert done[0].ttft_ms is not None  # it did emit before timing out
+    rest = {f.req_id: f for f in engine.drain()}
+    assert rest[sid].tokens == ref, (rest[sid].tokens, ref)
+    assert rest[sid].finish_reason in ("stop", "length")
+    snap = reg.snapshot()["counters"]
+    assert snap["serve_timeouts"] == 1
+    assert snap["serve_requests"] == 2
+
+
+def test_deadline_expires_queued_request_before_prefill(gpt_fix):
+    """A request whose deadline passes while QUEUED is dropped before
+    admission: no prefill dispatch, n_out=0, the slot-holder is
+    untouched."""
+    model, reqs = gpt_fix
+    clk = _Clock()
+    reg = MetricsRegistry()
+    engine = Engine(model, n_slots=1, max_seq_len=32, registry=reg,
+                    clock=clk)
+    kw_survivor, ref = reqs[1]
+    sid = engine.submit(**kw_survivor)          # takes the only slot
+    tid = engine.submit([9, 8, 7], max_new_tokens=MAX_NEW,
+                        deadline_ms=50.0)       # queued behind it
+    engine.step()
+    n_prefills = len(engine.traces["prefill"])
+    clk.t = 0.2
+    done = engine.step()
+    assert [f.req_id for f in done] == [tid]
+    assert done[0].finish_reason == "timeout"
+    assert done[0].n_out == 0 and done[0].ttft_ms is None
+    assert done[0].tokens == [9, 8, 7]  # prompt only
+    assert len(engine.traces["prefill"]) == n_prefills  # no prefill paid
+    rest = {f.req_id: f for f in engine.drain()}
+    assert rest[sid].tokens == ref
+    snap = reg.snapshot()["counters"]
+    assert snap["serve_timeouts"] == 1
+    # the request record says timeout and omits ttft (percentile honesty)
+    assert engine.sched.queue_depth == 0
+
+
+def test_no_deadline_requests_never_time_out(gpt_fix):
+    model, reqs = gpt_fix
+    clk = _Clock()
+    engine = Engine(model, n_slots=2, max_seq_len=32,
+                    registry=MetricsRegistry(), clock=clk)
+    kw, ref = reqs[1]
+    rid = engine.submit(**kw)
+    clk.t = 1e6  # a million seconds of "wall time"
+    out = {f.req_id: f for f in engine.drain()}
+    assert out[rid].tokens == ref
+    assert out[rid].finish_reason != "timeout"
+
+
 def test_scheduler_bucket_ladder_bound():
     from avenir_tpu.infer.decode import bucket_ladder
     from avenir_tpu.serve.scheduler import FCFSScheduler

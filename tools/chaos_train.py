@@ -1,0 +1,321 @@
+"""Chaos harness: SIGKILL a real training job over and over and prove
+resume is EXACT (ISSUE 5 tentpole, part 4).
+
+The crash-consistency claim this repo makes is concrete: any SIGKILL —
+between steps, mid-eval, or mid-checkpoint-save — loses at most the
+work since the last committed checkpoint, and the relaunched run's loss
+trajectory is BIT-IDENTICAL to a never-interrupted run's (the loader
+fast-forwards its rng stream on resume; step rngs are iteration-
+indexed; saves are commit-marked). This tool is the proof:
+
+  1. run the job uninterrupted, record every logged loss;
+  2. run it again, SIGKILLing it `--kills` times at seeded-random
+     trigger points (roughly half aimed at the "saving checkpoint"
+     window to hit mid-save), relaunching with --init_from=resume;
+  3. assert the union of logged (iter, loss) pairs matches the
+     uninterrupted run's EXACTLY, bit for bit;
+  4. optional corruption drill (--drill=all|corruption): flip one byte
+     in the newest committed checkpoint, resume, and assert the restore
+     fell back to the previous generation (`ckpt_fallback` recorded in
+     the JSONL run log).
+
+Emits a BENCH-style JSON report (kills survived, resume sources,
+fallbacks taken, io retries, bit_identical verdict); exits non-zero if
+any assertion fails, so CI can gate on it.
+
+    python tools/chaos_train.py --seed=0 --kills=10 --max_iters=24
+    python tools/chaos_train.py --drill=corruption --out=chaos.json
+
+Inject extra storage faults into the children with --faults=SPEC
+(forwarded as AVENIR_FAULTS, e.g. --faults=ckpt_write_fail:p=0.5:n=2 —
+the retry/backoff layer must absorb them; see avenir_tpu/utils/faults).
+"""
+
+import json
+import os
+import random
+import select
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _parse_args():
+    return {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
+            for a in sys.argv[1:]}
+
+
+def _cli(data_dir, out_dir, cfg, extra):
+    args = dict(
+        dataset=data_dir, out_dir=out_dir, backend="tpu", device="cpu",
+        compile=False, eval_interval=cfg["eval_interval"], eval_iters=2,
+        log_interval=1, batch_size=4, block_size=32, n_layer=2, n_head=2,
+        n_embd=32, dropout=0.0, gradient_accumulation_steps=2,
+        always_save_checkpoint=True, warmup_iters=2, lr_decay_iters=200,
+        learning_rate=1e-3, use_pallas=False, mesh_shape="data:1",
+        max_iters=cfg["max_iters"], keep_checkpoints=cfg["keep"],
+        metrics_log=True, dtype="float32",
+    )
+    args.update(extra)
+    return [sys.executable, "train.py"] + [f"--{k}={v}"
+                                           for k, v in args.items()]
+
+
+def _env(cfg):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    if cfg["faults"]:
+        env["AVENIR_FAULTS"] = cfg["faults"]
+        env["AVENIR_FAULTS_SEED"] = str(cfg["seed"])
+    return env
+
+
+def _run_to_completion(data_dir, out_dir, cfg, extra, timeout=900):
+    r = subprocess.run(_cli(data_dir, out_dir, cfg, extra), cwd=REPO,
+                       env=_env(cfg), capture_output=True, text=True,
+                       timeout=timeout)
+    assert r.returncode == 0, (
+        f"training run failed ({r.returncode}):\n{r.stdout}\n{r.stderr}")
+    return r.stdout
+
+
+def _kill_one(data_dir, out_dir, cfg, extra, trigger, rng, timeout=900):
+    """Launch a run and SIGKILL it when `trigger` fires (plus a small
+    random delay, to land INSIDE the triggered phase). Triggers are
+    RELATIVE so a resumed segment always gets killed while it is still
+    making progress: ("iters", n) kills after the n-th new `iter` log
+    line of THIS segment, ("line", s) on the first line containing s
+    (e.g. "saving checkpoint" for the mid-save window). Returns
+    (killed, stdout_so_far) — killed=False means the segment completed
+    before the trigger."""
+    proc = subprocess.Popen(
+        _cli(data_dir, out_dir, cfg, extra), cwd=REPO, env=_env(cfg),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    mode, arg = trigger
+    buf = ""
+    seen_iters = 0
+    deadline = time.time() + timeout
+    try:
+        while proc.poll() is None and time.time() < deadline:
+            ready, _, _ = select.select([proc.stdout], [], [], 1.0)
+            if not ready:
+                continue
+            line = proc.stdout.readline()
+            buf += line
+            if mode == "iters" and line.startswith("iter "):
+                seen_iters += 1
+            hit = (seen_iters >= arg if mode == "iters"
+                   else arg in line)
+            if hit:
+                time.sleep(rng.uniform(0.0, 0.05))
+                proc.send_signal(signal.SIGKILL)
+                proc.wait(timeout=60)
+                return True, buf
+        if proc.poll() is None:  # never hit the trigger in time
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=60)
+            return True, buf
+        return False, buf + proc.stdout.read()  # completed before trigger
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def _trajectory(metrics_path):
+    """{iter: loss} from every `iter` record across ALL log segments
+    (a resumed run appends; re-run iters overwrite — determinism makes
+    first and last occurrence identical, asserted by the caller's
+    comparison against the uninterrupted run)."""
+    from avenir_tpu.obs.report import load_records
+
+    out = {}
+    for r in load_records(metrics_path):
+        if r.get("kind") == "iter":
+            out[r["iter"]] = r["loss"]
+    return out
+
+
+def _log_counters(metrics_path):
+    """Summed fault-tolerance counters + restore records across every
+    segment of a (possibly many-times-killed) run log. Counters are
+    cumulative per segment, so the per-segment MAX is the segment's
+    total; segments reset on relaunch, so totals sum across segments."""
+    from avenir_tpu.obs.report import load_records
+
+    keys = ("io_retries", "ckpt_fallback", "ckpt_corrupt_detected",
+            "ckpt_save_errors")
+    totals = dict.fromkeys(keys, 0.0)
+    seg = dict.fromkeys(keys, 0.0)
+    restores = []
+    retries = 0
+    for r in load_records(metrics_path):
+        kind = r.get("kind")
+        if kind == "run_meta":  # new segment: bank the previous one
+            for k in keys:
+                totals[k] += seg[k]
+            seg = dict.fromkeys(keys, 0.0)
+        elif kind == "restore":
+            restores.append({"iter": r.get("iter"),
+                             "source_kind": r.get("source_kind"),
+                             "skipped_bad": r.get("skipped_bad", 0)})
+            for k in keys:
+                seg[k] = max(seg[k], float(
+                    (r.get("counters") or {}).get(k, 0.0)))
+        elif kind == "retry":
+            retries += 1
+        else:
+            for k in keys:
+                seg[k] = max(seg[k], float(
+                    (r.get("counters") or {}).get(k, 0.0)))
+    for k in keys:
+        totals[k] += seg[k]
+    totals["retry_records"] = retries
+    totals["restores"] = restores
+    return totals
+
+
+def _flip_byte(path, rng):
+    with open(path, "r+b") as f:
+        f.seek(0, 2)
+        size = f.tell()
+        pos = rng.randrange(size)
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return pos
+
+
+def main():
+    t_start = time.time()
+    a = _parse_args()
+    cfg = {
+        "seed": int(a.get("seed", 0)),
+        "kills": int(a.get("kills", 10)),
+        "max_iters": int(a.get("max_iters", 24)),
+        "eval_interval": int(a.get("eval_interval", 4)),
+        "keep": int(a.get("keep", 2)),
+        "faults": a.get("faults", ""),
+        "drill": a.get("drill", "kills"),  # kills | corruption | all
+        "out": a.get("out", ""),
+        "workdir": a.get("workdir", ""),
+    }
+    rng = random.Random(cfg["seed"])
+    import tempfile
+
+    work = cfg["workdir"] or tempfile.mkdtemp(prefix="avenir-chaos-")
+    os.makedirs(work, exist_ok=True)
+    data_dir = os.path.join(work, "data")
+    if not os.path.exists(os.path.join(data_dir, "train.bin")):
+        from avenir_tpu.utils.corpus import synthetic_corpus, write_char_dataset
+
+        write_char_dataset(data_dir, synthetic_corpus(n_chars=60_000, seed=7))
+
+    report = {"tool": "chaos_train", "seed": cfg["seed"],
+              "config": {k: cfg[k] for k in
+                         ("kills", "max_iters", "eval_interval", "keep",
+                          "faults", "drill")},
+              "kills": [], "ok": True}
+
+    if cfg["drill"] in ("kills", "all"):
+        print(f"[chaos] baseline uninterrupted run -> {work}/base")
+        base_out = os.path.join(work, "base")
+        _run_to_completion(data_dir, base_out, cfg, {})
+        base_traj = _trajectory(os.path.join(base_out, "metrics.jsonl"))
+        assert base_traj, "baseline run logged no iters"
+
+        chaos_out = os.path.join(work, "chaos")
+        kills_done = 0
+        while kills_done < cfg["kills"]:
+            have_ckpt = (
+                os.path.exists(os.path.join(chaos_out, "ckpt.pt"))
+                or os.path.exists(os.path.join(chaos_out, "MANIFEST.json"))
+                or os.path.isdir(os.path.join(chaos_out, "ckpt-gens")))
+            extra = {"init_from": "resume"} if have_ckpt else {}
+            mid_save = rng.random() < 0.5
+            trigger = (("line", "saving checkpoint") if mid_save else
+                       ("iters",
+                        rng.randrange(1, 2 * cfg["eval_interval"])))
+            killed, _ = _kill_one(data_dir, chaos_out, cfg, extra,
+                                  trigger, rng)
+            report["kills"].append({
+                "n": kills_done, "trigger": list(trigger),
+                "mid_save": mid_save,
+                "resumed": bool(extra), "killed": killed,
+            })
+            print(f"[chaos] kill {kills_done + 1}/{cfg['kills']}: "
+                  f"trigger={trigger!r} killed={killed} "
+                  f"resumed={bool(extra)}")
+            kills_done += 1
+            if not killed:
+                # the run completed before the trigger; wipe nothing —
+                # further relaunches just resume to completion instantly
+                continue
+        print("[chaos] final relaunch to completion")
+        _run_to_completion(data_dir, chaos_out, cfg,
+                           {"init_from": "resume"}
+                           if os.path.exists(os.path.join(chaos_out,
+                                                          "ckpt.pt"))
+                           or os.path.isdir(os.path.join(chaos_out,
+                                                         "ckpt-gens"))
+                           else {})
+        chaos_traj = _trajectory(os.path.join(chaos_out, "metrics.jsonl"))
+        mismatches = {
+            i: (base_traj[i], chaos_traj.get(i))
+            for i in base_traj
+            if chaos_traj.get(i) != base_traj[i]
+        }
+        stats = _log_counters(os.path.join(chaos_out, "metrics.jsonl"))
+        report.update({
+            "baseline_final_loss": base_traj[max(base_traj)],
+            "final_loss": chaos_traj.get(max(base_traj)),
+            "iters_compared": len(base_traj),
+            "bit_identical": not mismatches,
+            "mismatches": {str(k): v for k, v in
+                           list(mismatches.items())[:10]},
+            **stats,
+        })
+        report["ok"] &= not mismatches
+        print(f"[chaos] {len(base_traj)} iters compared, bit_identical="
+              f"{not mismatches}, restores={len(stats['restores'])}, "
+              f"io_retries={stats['io_retries']:.0f}")
+
+    if cfg["drill"] in ("corruption", "all"):
+        cor_out = os.path.join(work, "corrupt")
+        print(f"[chaos] corruption drill -> {cor_out}")
+        _run_to_completion(data_dir, cor_out, cfg, {})
+        pos = _flip_byte(os.path.join(cor_out, "ckpt.pt"), rng)
+        out = _run_to_completion(
+            data_dir, cor_out, cfg,
+            {"init_from": "resume",
+             "max_iters": cfg["max_iters"] + cfg["eval_interval"]})
+        stats = _log_counters(os.path.join(cor_out, "metrics.jsonl"))
+        fell_back = (stats["ckpt_fallback"] >= 1
+                     and any(r["skipped_bad"] >= 1
+                             for r in stats["restores"]))
+        report["corruption_drill"] = {
+            "flipped_byte_at": pos,
+            "ckpt_fallback": stats["ckpt_fallback"],
+            "ckpt_corrupt_detected": stats["ckpt_corrupt_detected"],
+            "fell_back": fell_back,
+            "resumed_output_has_fallback_line": "FALLBACK" in out,
+        }
+        report["ok"] &= fell_back
+        print(f"[chaos] corruption drill: fell_back={fell_back} "
+              f"(corrupt_detected={stats['ckpt_corrupt_detected']:.0f})")
+
+    report["wall_s"] = round(time.time() - t_start, 1)
+    line = json.dumps(report)
+    print(line)
+    if cfg["out"]:
+        with open(cfg["out"], "w") as f:
+            f.write(line + "\n")
+    sys.exit(0 if report["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

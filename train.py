@@ -125,6 +125,12 @@ profile = False  # capture a jax.profiler trace window
 # save checkpoints from a background thread (single-process only; training
 # continues while the snapshot streams to ckpt.pt.part, atomically renamed)
 async_checkpoint = False
+# generation ring (tpu backend, docs/OPERATIONS.md "Failure / recovery"):
+# keep the last K COMMITTED checkpoint generations under out_dir/ckpt-gens/
+# (hard links — metadata-cheap). On resume, the newest artifact is verified
+# against its manifest checksums and restore falls back generation by
+# generation past corruption. 0 disables the ring (no fallback copies).
+keep_checkpoints = 2
 # accept silent replication of param dims the mesh doesn't divide (e.g. an
 # unpadded char vocab on tensor:2); default is a hard error (fail-loud)
 allow_unsharded_fallback = False
@@ -137,6 +143,10 @@ metrics_log = True
 # max(watchdog_secs, 10x median window time) — hung pod collectives freeze
 # silently otherwise (avenir_tpu/obs/watchdog.py)
 watchdog_secs = 0.0
+# watchdog escalation: after N CONSECUTIVE stall warnings with no progress,
+# dump stacks one last time and exit non-zero (code 70) so a pod supervisor
+# restarts the job from the last committed checkpoint. 0 = warn forever
+watchdog_fatal_count = 0
 # -----------------------------------------------------------------------------
 from configurator import configure
 
