@@ -127,10 +127,28 @@ METRIC_SCHEMA = {
         "counter", "1",
         "per-stage pipeline tick-slots spent in warmup/drain bubbles, "
         "recorded once per region trace (see pipe_ticks_real)"),
-    # -- serving engine (avenir_tpu/serve) --
+    # -- serving engine + fleet router (avenir_tpu/serve) --
     "serve_requests": (
         "counter", "1",
-        "requests completed by the serve engine (incl. timeouts)"),
+        "requests completed by the serving stack — engine or router — "
+        "incl. timeouts"),
+    "serve_rejected": (
+        "counter", "1",
+        "requests refused at submit for an impossible shape (prompt + "
+        "budget exceeds max_seq_len); finish_reason='rejected', no slot "
+        "or prefill ever spent, the engine does NOT crash"),
+    "serve_shed": (
+        "counter", "1",
+        "requests refused at router admission (per-priority queue depth "
+        "limit, or projected queue wait already exceeding deadline_ms); "
+        "finish_reason='shed' — load shedding instead of unbounded "
+        "queue growth (serve/router.py)"),
+    "serve_failovers": (
+        "counter", "1",
+        "in-flight or engine-queued requests requeued off a dead or "
+        "stalled replica for a from-scratch re-prefill on a healthy one "
+        "(serve/router.py; completed outputs stay bit-identical to "
+        "one-shot generation)"),
     "serve_timeouts": (
         "counter", "1",
         "requests that exceeded their deadline_ms (evicted from their "
@@ -152,6 +170,15 @@ METRIC_SCHEMA = {
     "queue_depth": (
         "gauge", "1",
         "requests waiting for a slot after the last engine event"),
+    "router_queue_depth": (
+        "gauge", "1",
+        "requests waiting in the router's priority queues after the "
+        "last router step (fleet-level; per-engine backlogs are "
+        "queue_depth)"),
+    "replica_healthy": (
+        "gauge", "1",
+        "healthy replicas in the serve fleet after the last router step "
+        "(draining and dead excluded)"),
     "slot_occupancy": (
         "gauge", "1",
         "fraction of KV slots live after the last engine step"),

@@ -101,6 +101,11 @@ def summarize(records):
             "n_requests": len(requests),
             "n_timeouts": sum(1 for r in requests
                               if r.get("finish_reason") == "timeout"),
+            "n_shed": sum(1 for r in requests
+                          if r.get("finish_reason") == "shed"),
+            "n_rejected": sum(1 for r in requests
+                              if r.get("finish_reason") == "rejected"),
+            "failovers": counters.get("serve_failovers", 0.0),
             "tokens_out": tokens_out,
             "goodput_tok_per_sec": (tokens_out / (total_ms / 1e3)
                                     if total_ms else None),
@@ -231,6 +236,14 @@ def format_report(s):
                         if sv["goodput_tok_per_sec"] is not None else "")
                      + (f"   TIMEOUTS: {sv['n_timeouts']}"
                         if sv.get("n_timeouts") else ""))
+        fleet_bits = [
+            f"failovers {sv['failovers']:.0f}" if sv.get("failovers") else "",
+            f"SHED: {sv['n_shed']}" if sv.get("n_shed") else "",
+            f"rejected {sv['n_rejected']}" if sv.get("n_rejected") else "",
+        ]
+        fleet_bits = [b for b in fleet_bits if b]
+        if fleet_bits:
+            lines.append("  fleet: " + "   ".join(fleet_bits))
         if sv["ttft_p50_ms"] is not None:
             lines.append(f"  ttft: p50 {sv['ttft_p50_ms']:.1f} ms  "
                          f"p99 {sv['ttft_p99_ms']:.1f} ms")

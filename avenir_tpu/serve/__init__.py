@@ -1,5 +1,5 @@
-"""avenir_tpu.serve — slot-based continuous-batching inference engine
-(ISSUE 2).
+"""avenir_tpu.serve — continuous-batching inference engine (ISSUE 2)
+plus the multi-replica fleet layer over it (ISSUE 6).
 
 - slots.py:     fixed (L, n_slots, T_max, H_kv, D) KV slot pool + per-slot
                 decode state, donated through the jitted step
@@ -8,15 +8,25 @@
 - engine.py:    submit()/step()/drain() driver over the shared
                 infer/decode.py forward; per-request bit-parity with
                 one-shot generate_cached
+- replica.py:   health-checked engine wrapper — heartbeat from step
+                progress, healthy/draining/dead state machine, fault
+                sites (serve_step_fail, replica_stall)
+- router.py:    fleet front door — failover (no accepted request ever
+                lost), admission control + load shedding, priority
+                fair-share, SLO-aware dispatch
 
-See docs/SERVING.md for the design and the parity contract.
+See docs/SERVING.md for the design, the parity contract, and the
+router's failover semantics.
 """
 
 from avenir_tpu.serve.engine import Engine, FinishedRequest
+from avenir_tpu.serve.replica import DEAD, DRAINING, HEALTHY, Replica
+from avenir_tpu.serve.router import PRIORITIES, Router, RouterFinished
 from avenir_tpu.serve.scheduler import FCFSScheduler, Request
 from avenir_tpu.serve.slots import SlotPool, init_slot_pool
 
 __all__ = [
     "Engine", "FinishedRequest", "FCFSScheduler", "Request", "SlotPool",
-    "init_slot_pool",
+    "init_slot_pool", "Replica", "Router", "RouterFinished", "PRIORITIES",
+    "HEALTHY", "DRAINING", "DEAD",
 ]

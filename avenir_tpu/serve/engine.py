@@ -46,6 +46,7 @@ bookkeeping plus traced arguments — occupancy changes never retrace.
 
 import dataclasses
 import functools
+import statistics
 import time
 import warnings
 from typing import Callable, List, Optional
@@ -73,7 +74,7 @@ class FinishedRequest:
     tokens: List[int]          # prompt + emitted (stop token included)
     n_prompt: int
     n_out: int
-    finish_reason: str         # 'stop' | 'length' | 'timeout'
+    finish_reason: str         # 'stop' | 'length' | 'timeout' | 'rejected'
     text: Optional[str]        # detokenized, when a codec was given
     ttft_ms: Optional[float]   # None: timed out before the first token
     tpot_ms: float
@@ -117,6 +118,8 @@ class Engine:
         self.sink = sink if sink is not None else NullSink()
         self.sched = FCFSScheduler(self.n_slots, self.T_max)
         self._live = {}  # slot -> _Live
+        self._pending = []  # rejected-at-submit records, flushed by step()
+        self._tick_s = []   # recent decode-tick durations (clock secs)
         self._next_id = 0
         self._base_rng = jax.random.key(seed)
         self.traces = {"prefill": [], "step": []}
@@ -206,32 +209,62 @@ class Engine:
         mutation, e.g. loading a new checkpoint into the same module)."""
         self._state = nnx.split(self.model)[1]
 
+    def tick_estimate_s(self):
+        """Median recent decode-tick wall time in engine-clock seconds.
+        The MEDIAN — watchdog-style — so the first compiling tick cannot
+        inflate the dispatch-time expiry lookahead into spuriously
+        expiring short-deadline work; with fewer than two samples the
+        only measurement IS that compile spike, so the estimate stays
+        0.0 (no lookahead) until a steady-state tick lands."""
+        if len(self._tick_s) < 2:
+            return 0.0
+        return statistics.median_low(self._tick_s)
+
     def submit(self, prompt, *, max_new_tokens, temperature=1.0,
-               top_k=None, stop_tokens=(), rng=None, deadline_ms=None):
+               top_k=None, stop_tokens=(), rng=None, deadline_ms=None,
+               submit_t=None):
         """Enqueue a request; returns its id. `rng` defaults to
         fold_in(engine seed, id) — pass an explicit key to reproduce a
         one-shot `generate_cached` run. `deadline_ms` (None = none): a
         wall-time budget from submission; past it the request finishes
         with finish_reason='timeout' — evicted from its slot (partial
-        tokens returned) or dropped from the queue before prefill."""
+        tokens returned) or dropped from the queue before prefill.
+        `submit_t` (engine-clock seconds) backdates the request — the
+        router's failover path uses it so TTFT and the deadline keep
+        counting from the ORIGINAL submission, not the resubmission.
+
+        A prompt+budget that cannot fit `max_seq_len` is NOT an engine
+        crash (ISSUE 6 satellite): it finishes immediately with
+        finish_reason='rejected' (`serve_rejected` counter) — bad user
+        input on a shared engine must never take the fleet down."""
         prompt = tuple(int(t) for t in prompt)
         assert prompt, "empty prompt"
         assert max_new_tokens >= 1
         assert deadline_ms is None or deadline_ms > 0
-        if len(prompt) + max_new_tokens > self.T_max:
-            raise ValueError(
-                f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
-                f"engine max_seq_len {self.T_max}"
-            )
         rid = self._next_id
         self._next_id += 1
+        if len(prompt) + max_new_tokens > self.T_max:
+            self._reg.counter("serve_rejected").add(1)
+            rec = FinishedRequest(
+                req_id=rid, tokens=list(prompt), n_prompt=len(prompt),
+                n_out=0, finish_reason="rejected",
+                text="" if self.detokenize is not None else None,
+                ttft_ms=None, tpot_ms=0.0,
+            )
+            self.sink.write({
+                "kind": "request", "t": time.time(), "id": rid,
+                "n_prompt": len(prompt), "n_out": 0,
+                "finish_reason": "rejected",
+            })
+            self._pending.append(rec)
+            return rid
         if rng is None:
             rng = jax.random.fold_in(self._base_rng, rid)
         req = Request(
             req_id=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
             temperature=float(temperature), top_k=top_k,
             stop_tokens=_normalize_stop(stop_tokens) or (), rng=rng,
-            submit_t=self._clock(),
+            submit_t=self._clock() if submit_t is None else float(submit_t),
             deadline_ms=None if deadline_ms is None else float(deadline_ms),
         )
         self.sched.enqueue(req)
@@ -244,8 +277,14 @@ class Engine:
         iteration (including timeouts)."""
         state = self._state
         V = self.pool.logits.shape[-1]
-        finished = []
-        for req in self.sched.expire_queued(self._clock()):
+        finished = self._pending
+        self._pending = []
+        # dispatch-time expiry lookahead (ISSUE 6 satellite): a queued
+        # request whose remaining deadline cannot cover even ONE decode
+        # tick would time out before its first token — expire it now
+        # instead of letting hopeless work burn a prefill and a slot
+        for req in self.sched.expire_queued(self._clock(),
+                                            lookahead_s=self.tick_estimate_s()):
             finished.append(self._finish_queued_timeout(req))
         for req, slot in self.sched.take_admissions():
             t0 = len(req.prompt)
@@ -264,11 +303,15 @@ class Engine:
         if self._live:
             active = np.zeros((self.n_slots,), bool)
             active[list(self._live)] = True
+            t_tick = self._clock()
             with span("serve_decode", registry=self._reg):
                 toks, self.pool = self._step_fn(state, self.pool,
                                                 jnp.asarray(active))
                 toks = np.asarray(toks)  # the per-iteration D2H fence
             now = self._clock()
+            self._tick_s.append(now - t_tick)
+            if len(self._tick_s) > 64:
+                del self._tick_s[:32]
             self._reg.counter("tokens_out").add(len(self._live))
             for slot in sorted(self._live):
                 live = self._live[slot]
@@ -308,14 +351,14 @@ class Engine:
     def drain(self):
         """Run steps until queue and slots are empty; returns every
         request finished along the way."""
-        bound = 2 + sum(
+        bound = 2 + len(self._pending) + sum(
             r.max_new_tokens
             for r in ([lv.req for lv in self._live.values()]
                       + list(self.sched._queue))
         ) + self.sched.queue_depth  # admission-wait iterations
         out = []
         steps = 0
-        while self.sched.queue_depth or self._live:
+        while self._pending or self.sched.queue_depth or self._live:
             out.extend(self.step())
             steps += 1
             if steps > bound:

@@ -1,0 +1,202 @@
+"""Health-checked serve replica: one Engine plus a failure story
+(ISSUE 6 tentpole, part 1).
+
+A replica is an in-process handle wrapping one `serve.Engine`, so the
+whole fleet is CPU-testable and fault-injectable — in a deployment each
+replica is one process on one chip and this object is the router's view
+of it (state, heartbeat, step driver). Two failure modes are modeled,
+both through `utils/faults.py` sites so the PRODUCTION failover path is
+exactly what the tests and the chaos harness exercise:
+
+    serve_step_fail   the engine step raises (XLA abort, HBM OOM, the
+                      process dying under the driver) -> the replica
+                      marks itself `dead` the moment the exception
+                      surfaces
+    replica_stall     the replica silently stops making progress (a
+                      wedged collective, a hung host thread — the same
+                      silence obs/watchdog.py exists for). A stalled
+                      replica keeps "running" but never heartbeats; the
+                      ROUTER's health check declares it dead once the
+                      stall threshold passes.
+
+Heartbeats are derived from step progress: every completed `step()`
+stamps `last_beat` and records its duration, and the stall threshold is
+the obs/watchdog.py pattern — `max(floor, factor x median completed
+step)` — so one knob stays meaningful from a tiny CPU test (ms steps)
+to a real chip (tens of ms).
+
+State machine:
+
+    healthy --- step raises / stall threshold passed ---> dead
+    healthy --- drain() ------------------------------> draining
+    draining -- step raises / stall ------------------> dead
+    dead ------ revive() -----------------------------> healthy
+
+`draining` stops NEW admissions (the router checks state before
+dispatch) while in-flight work finishes — the graceful half of a
+restart. `revive()` hard-resets the engine's host state (queue, live
+map, free list); the KV pool is NOT scrubbed — the overwrite-before-
+attend invariant (serve/slots.py) makes stale K/V from the previous
+life unreachable until overwritten, the same argument slot recycling
+already rests on.
+"""
+
+import statistics
+
+from avenir_tpu.serve.engine import Engine
+from avenir_tpu.serve.scheduler import FCFSScheduler
+from avenir_tpu.utils.faults import get_injector
+
+HEALTHY = "healthy"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+class Replica:
+    """One engine in the fleet, with the router-facing health surface."""
+
+    def __init__(self, model, replica_id, *, n_slots=4, max_seq_len=None,
+                 detokenize=None, registry=None, sink=None, seed=0,
+                 clock=None, stall_floor_secs=10.0, stall_factor=10.0):
+        self.replica_id = int(replica_id)
+        self.engine = Engine(
+            model, n_slots=n_slots, max_seq_len=max_seq_len,
+            detokenize=detokenize, registry=registry, sink=sink,
+            seed=seed, clock=clock,
+        )
+        self._clock = self.engine._clock
+        self.state = HEALTHY
+        self.stall_floor_secs = float(stall_floor_secs)
+        self.stall_factor = float(stall_factor)
+        self.last_beat = self._clock()
+        self._durs = []       # completed-step durations, clock seconds
+        self._stalled = False  # fault-injected wedge (no beats, no work)
+        self.deaths = 0
+        self.last_error = None  # the exception that killed us, if any
+
+    # -- capacity surface the router routes on --
+
+    @property
+    def n_slots(self):
+        return self.engine.n_slots
+
+    @property
+    def free_slots(self):
+        return self.engine.sched.free_slots if self.state == HEALTHY else 0
+
+    @property
+    def dispatchable_slots(self):
+        """Free slots minus work already submitted but not yet admitted
+        (engine `free_slots` only drops at admission, one engine step
+        later) — the router's dispatch budget, so a fleet step never
+        over-commits a replica and fair-share stays a ROUTER decision
+        instead of decaying into per-engine FCFS backlogs."""
+        if self.state != HEALTHY:
+            return 0
+        return max(0, self.engine.sched.free_slots
+                   - self.engine.sched.queue_depth)
+
+    @property
+    def busy(self):
+        """Holds admitted-but-unfinished work (any state)."""
+        eng = self.engine
+        return bool(eng._live or eng.sched.queue_depth or eng._pending)
+
+    def median_step_secs(self):
+        return statistics.median_low(self._durs) if self._durs else 0.0
+
+    # -- stepping --
+
+    def step(self):
+        """One engine iteration under fault consult. Returns the engine's
+        finished list; on a step failure the replica is `dead` and the
+        return is empty — the ROUTER owns requeueing what was in flight
+        (it knows the original prompts; this object only knows slots)."""
+        if self.state == DEAD:
+            return []
+        inj = get_injector()
+        if not self._stalled and inj.should_fire("replica_stall"):
+            self._stalled = True
+        if self._stalled:
+            # a wedged replica does no work and — the defining symptom —
+            # does NOT heartbeat; only the router's threshold check can
+            # tell this silence from an idle replica
+            return []
+        t0 = self._clock()
+        had_work = self.busy
+        try:
+            inj.fail("serve_step_fail", f"replica {self.replica_id}")
+            finished = self.engine.step()
+        except Exception as e:
+            # a dead replica is a ROUTABLE event, not a fleet crash —
+            # but keep the corpse's cause of death inspectable (a
+            # deterministic bug kills every replica it fails over to,
+            # and drain()'s all-dead error is where an operator looks)
+            self.last_error = e
+            self.mark_dead()
+            return []
+        now = self._clock()
+        self.last_beat = now
+        if had_work:
+            # idle no-op steps still heartbeat but must not enter the
+            # duration stats: a mostly-idle replica's median would
+            # collapse to ~0, degrading the stall threshold to its bare
+            # floor and making a slow replica look fast to the router's
+            # deadline-slack placement penalty
+            self._durs.append(now - t0)
+            if len(self._durs) > 64:
+                del self._durs[:32]
+        return finished
+
+    # -- health --
+
+    def stall_threshold_secs(self):
+        """obs/watchdog.py's threshold rule: max(floor, factor x median
+        completed-step time) — scale-free across model sizes."""
+        return max(self.stall_floor_secs,
+                   self.stall_factor * self.median_step_secs())
+
+    def check_health(self, now):
+        """Declare a silent stall: HOLDING WORK with no heartbeat within
+        the threshold. An idle replica is exempt — with nothing admitted
+        there is no progress to expect (and another replica's long
+        compile delaying the fleet loop must not read as this one's
+        death); a wedged-but-idle replica is caught the moment work
+        lands on it and fails to move. Returns the (updated) state."""
+        if (self.state != DEAD and self.busy
+                and now - self.last_beat > self.stall_threshold_secs()):
+            self.mark_dead()
+        return self.state
+
+    # -- state transitions --
+
+    def drain(self):
+        """Stop new admissions; in-flight work keeps stepping."""
+        if self.state == HEALTHY:
+            self.state = DRAINING
+
+    def mark_dead(self):
+        """Abrupt death (step failure, declared stall, or a chaos kill).
+        Engine host state is left in place so the router can still read
+        it; `revive()` resets it."""
+        if self.state != DEAD:
+            self.state = DEAD
+            self.deaths += 1
+
+    def revive(self):
+        """From `dead`: a restarted replica rejoins empty — fresh
+        scheduler (all slots free, empty queue), live map cleared; the
+        KV pool is deliberately NOT scrubbed (stale rows stay masked
+        until overwritten — the slot-hygiene invariant, serve/slots.py).
+        From `draining`: just un-drain — in-flight work is live and must
+        NOT be dropped."""
+        if self.state == DEAD:
+            eng = self.engine
+            eng._live.clear()
+            eng._pending = []
+            eng.sched = FCFSScheduler(eng.n_slots, eng.T_max)
+            self._stalled = False
+            self._durs = []
+            self.last_error = None
+        self.state = HEALTHY
+        self.last_beat = self._clock()

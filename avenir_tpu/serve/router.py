@@ -1,0 +1,454 @@
+"""Multi-replica serve router: health checks, failover, admission
+control, priority fair-share (ISSUE 6 tentpole, part 2).
+
+One engine per chip is not a fleet. The router owns the front door over
+N `serve/replica.py` replicas and makes four promises:
+
+1. **No accepted request is ever lost.** Every submit that is not
+   refused at the door finishes exactly once — with its tokens, or with
+   an explicit `timeout`. Requests in flight on a replica that dies or
+   stops heartbeating are requeued (oldest first, ahead of new work)
+   and re-prefilled FROM THE ORIGINAL PROMPT with the ORIGINAL rng on a
+   healthy replica, so a completed output is bit-identical to a one-shot
+   `generate_cached` run no matter how many failovers it survived — the
+   engine's parity contract (tests/test_serve.py) is the oracle, and
+   the partial tokens of the dead attempt are discarded, not spliced.
+2. **Bounded memory under overload.** Per-priority queue depth limits
+   plus an admission-time projected-wait check against `deadline_ms`:
+   work that would miss its deadline anyway is refused immediately with
+   `finish_reason='shed'` (`serve_shed`) instead of growing the queue —
+   backpressure the caller can see.
+3. **Batch can never starve interactive.** Two priority classes with
+   weighted fair-share dispatch (smoothed weighted round-robin — with
+   weights 4:1 a saturated fleet serves I I I I B ...): batch soaks up
+   idle capacity, interactive keeps its share the moment it arrives.
+4. **SLO-aware placement, not round-robin.** A dispatch goes to the
+   healthy replica maximizing free-slot fraction minus its engine queue
+   backlog, and a tight-deadline request additionally penalizes slow
+   replicas by the ticks of slack they would burn (`deadline_ms`, queue
+   depth and slot occupancy are the routing signals — the same ones
+   METRIC_SCHEMA already exports).
+
+Orca-style iteration-level scheduling (serve/scheduler.py) stays the
+per-replica substrate; vLLM's continuous-batching serving stack is the
+reference for the fleet shape (PAPERS.md). Synchronous and network-free
+like the engine: `step()` is one fleet iteration (health check ->
+expire -> dispatch -> step replicas -> harvest), `drain()` runs it to
+empty. A transport in front of this owns no scheduling logic.
+"""
+
+import dataclasses
+import statistics
+import time
+from collections import deque
+from typing import Optional, Tuple
+
+import jax
+
+from avenir_tpu.obs import NullSink, get_registry
+from avenir_tpu.serve.engine import FinishedRequest
+from avenir_tpu.serve.replica import DEAD, DRAINING, HEALTHY, Replica
+
+PRIORITIES = ("interactive", "batch")
+
+
+@dataclasses.dataclass
+class RoutedRequest:
+    """Router-side request record: everything needed to (re)submit to
+    any engine — failover restarts from the original prompt + rng."""
+
+    rid: int
+    prompt: Tuple[int, ...]
+    max_new_tokens: int
+    temperature: float
+    top_k: Optional[int]
+    stop_tokens: Tuple[int, ...]
+    rng: object
+    priority: str
+    deadline_ms: Optional[float]
+    submit_t: float            # ORIGINAL submission (router clock secs)
+    failovers: int = 0
+    dispatch_t: Optional[float] = None
+
+    def expired(self, now):
+        return (self.deadline_ms is not None
+                and (now - self.submit_t) * 1e3 >= self.deadline_ms)
+
+
+@dataclasses.dataclass
+class RouterFinished(FinishedRequest):
+    """FinishedRequest plus the fleet-level facts. finish_reason adds
+    'shed' (refused at admission) to the engine's set; `failovers` is
+    how many replica deaths this request survived."""
+
+    priority: str = "interactive"
+    replica: int = -1
+    failovers: int = 0
+
+
+class Router:
+    def __init__(self, model, *, n_replicas=2, n_slots=4, max_seq_len=None,
+                 detokenize=None, registry=None, sink=None, seed=0,
+                 clock=None, weights=None, queue_limits=None,
+                 stall_floor_secs=10.0, stall_factor=10.0):
+        """`weights`: dispatch shares per priority class (default
+        interactive 4 : batch 1). `queue_limits`: max queued per class
+        before shedding (default 16/64 x fleet slots). `clock` is shared
+        with every replica engine (injectable for tests)."""
+        assert n_replicas >= 1
+        self._clock = clock if clock is not None else time.perf_counter
+        self._reg = registry if registry is not None else get_registry()
+        self.sink = sink if sink is not None else NullSink()
+        self.replicas = [
+            Replica(model, i, n_slots=n_slots, max_seq_len=max_seq_len,
+                    detokenize=detokenize, registry=self._reg,
+                    sink=self.sink, seed=seed, clock=self._clock,
+                    stall_floor_secs=stall_floor_secs,
+                    stall_factor=stall_factor)
+            for i in range(n_replicas)
+        ]
+        self.T_max = self.replicas[0].engine.T_max
+        self.detokenize = detokenize
+        self.weights = dict(weights or {"interactive": 4.0, "batch": 1.0})
+        assert set(self.weights) == set(PRIORITIES)
+        assert all(w > 0 for w in self.weights.values())
+        total_slots = n_replicas * int(n_slots)
+        self.queue_limits = dict(queue_limits or {
+            "interactive": 16 * total_slots, "batch": 64 * total_slots})
+        self._queues = {c: deque() for c in PRIORITIES}
+        self._wrr = {c: 0.0 for c in PRIORITIES}  # smoothed-WRR credits
+        self._next_id = 0
+        self._base_rng = jax.random.key(seed)
+        self._pending = []     # shed/rejected/failover-timeout records
+        self._open = {}        # rid -> RoutedRequest (queued or in flight)
+        self._where = {}       # rid -> replica_id, while dispatched
+        self._by_replica = {r.replica_id: {} for r in self.replicas}
+        #                    replica_id -> {engine_rid: rid}
+        self._holds = []       # recent slot-hold durations (clock secs)
+
+    # ---- API ----
+
+    def submit(self, prompt, *, max_new_tokens, temperature=1.0,
+               top_k=None, stop_tokens=(), rng=None, deadline_ms=None,
+               priority="interactive"):
+        """Enqueue (or refuse) a request; returns its router id. `rng`
+        defaults to fold_in(router seed, id) — routing decisions never
+        touch it, so a request's reference stream is fixed at submit.
+        Refusals ('rejected' for an impossible shape, 'shed' for
+        admission control) surface as finished records from the next
+        `step()` — the caller sees one terminal record per submit either
+        way."""
+        assert priority in PRIORITIES, f"unknown priority {priority!r}"
+        prompt = tuple(int(t) for t in prompt)
+        assert prompt, "empty prompt"
+        assert max_new_tokens >= 1
+        assert deadline_ms is None or deadline_ms > 0
+        rid = self._next_id
+        self._next_id += 1
+        if rng is None:
+            rng = jax.random.fold_in(self._base_rng, rid)
+        now = self._clock()
+        if len(prompt) + int(max_new_tokens) > self.T_max:
+            self._reg.counter("serve_rejected").add(1)
+            self._refuse(rid, prompt, priority, "rejected")
+            return rid
+        q = self._queues[priority]
+        if len(q) >= self.queue_limits[priority]:
+            self._reg.counter("serve_shed").add(1)
+            self._refuse(rid, prompt, priority, "shed")
+            return rid
+        if (deadline_ms is not None
+                and self.projected_wait_ms(priority) >= deadline_ms):
+            self._reg.counter("serve_shed").add(1)
+            self._refuse(rid, prompt, priority, "shed")
+            return rid
+        req = RoutedRequest(
+            rid=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature), top_k=top_k,
+            stop_tokens=tuple(stop_tokens or ()), rng=rng,
+            priority=priority,
+            deadline_ms=None if deadline_ms is None else float(deadline_ms),
+            submit_t=now,
+        )
+        q.append(req)
+        self._open[rid] = req
+        self._reg.gauge("router_queue_depth").set(self.queue_depth)
+        return rid
+
+    def step(self):
+        """One fleet iteration: health-check + failover, expire hopeless
+        queued work, fair-share dispatch, step every replica, harvest.
+        Returns every request that reached a terminal state."""
+        finished = self._pending
+        self._pending = []
+        now = self._clock()
+        for rep in self.replicas:
+            if rep.state != DEAD and rep.check_health(now) == DEAD:
+                self._failover(rep)
+        self._expire_queued(now, finished)
+        self._dispatch_all(now)
+        for rep in self.replicas:
+            was_dead = rep.state == DEAD
+            for f in rep.step():
+                finished.append(self._harvest(rep, f))
+            if rep.state == DEAD and not was_dead:
+                # died inside this step (serve_step_fail): nothing it
+                # held finished — requeue all of it right away
+                self._failover(rep)
+        finished.extend(self._pending)
+        self._pending = []
+        self._reg.gauge("router_queue_depth").set(self.queue_depth)
+        self._reg.gauge("replica_healthy").set(self.n_healthy)
+        # the engines share ONE registry, so their per-step gauge writes
+        # are last-replica-wins; re-set them to the FLEET view here so
+        # the values a log reader sees are aggregates, not whichever
+        # replica happened to step last
+        self._reg.gauge("queue_depth").set(
+            sum(r.engine.sched.queue_depth for r in self.replicas))
+        total = sum(r.n_slots for r in self.replicas)
+        self._reg.gauge("slot_occupancy").set(
+            sum(len(r.engine._live) for r in self.replicas) / total)
+        return finished
+
+    def drain(self, max_steps=None):
+        """Step until every accepted request reached a terminal state.
+        Raises if no non-dead replica remains while work is still open
+        (a fleet with nothing to run it on cannot drain — revive one)."""
+        bound = max_steps or (
+            20 + len(self._pending) + 2 * len(self._open)
+            + 4 * sum(r.max_new_tokens for r in self._open.values()))
+        out = []
+        steps = 0
+        while self._pending or self._open:
+            if (self._open and not self._pending
+                    and all(r.state == DEAD for r in self.replicas)):
+                causes = "; ".join(
+                    f"replica {r.replica_id}: {r.last_error!r}"
+                    for r in self.replicas if r.last_error is not None)
+                raise RuntimeError(
+                    "all replicas dead with open requests — revive one"
+                    + (f" (causes of death: {causes})" if causes else ""))
+            out.extend(self.step())
+            steps += 1
+            if steps > bound:
+                raise RuntimeError(
+                    f"router failed to drain within {bound} iterations")
+        return out
+
+    # -- fleet controls (chaos harness / operator surface) --
+
+    def kill_replica(self, i):
+        """Abrupt replica death (the chaos drill's kill): mark dead and
+        fail its work over immediately."""
+        rep = self.replicas[i]
+        if rep.state != DEAD:
+            rep.mark_dead()
+            self._failover(rep)
+
+    def drain_replica(self, i):
+        self.replicas[i].drain()
+
+    def revive_replica(self, i):
+        # a dead replica's assignments were already requeued by
+        # _failover, so there is nothing to clear here; reviving a
+        # draining replica must keep its live assignment map intact
+        self.replicas[i].revive()
+
+    # -- observable surface --
+
+    @property
+    def queue_depth(self):
+        return sum(len(q) for q in self._queues.values())
+
+    @property
+    def n_healthy(self):
+        return sum(r.state == HEALTHY for r in self.replicas)
+
+    @property
+    def open_requests(self):
+        """Accepted and not yet terminal (queued or in flight)."""
+        return len(self._open)
+
+    def projected_wait_ms(self, priority):
+        """Admission-time queue-wait estimate for a new request of this
+        class: its queue drains at the fleet's healthy slot capacity
+        times the class's fair share, one median slot-hold per round.
+        Deliberately coarse — it exists to refuse work that would miss
+        its deadline ANYWAY, so erring generous (0 until the first
+        completion lands) only delays shedding, never loses work.
+        With no healthy replica the wait is infinite and every
+        deadline-carrying submit sheds."""
+        cap = sum(r.n_slots for r in self.replicas if r.state == HEALTHY)
+        if cap == 0:
+            return float("inf")
+        hold = statistics.median_low(self._holds) if self._holds else 0.0
+        contending = [c for c in PRIORITIES
+                      if self._queues[c] or c == priority]
+        share = self.weights[priority] / sum(self.weights[c]
+                                             for c in contending)
+        return len(self._queues[priority]) / (cap * share) * hold * 1e3
+
+    def fleet_tick_secs(self):
+        """Median decode-tick estimate across healthy replicas — the
+        router-queue analogue of the engine's dispatch-time expiry
+        lookahead."""
+        ticks = [r.engine.tick_estimate_s() for r in self.replicas
+                 if r.state == HEALTHY]
+        return statistics.median_low(ticks) if ticks else 0.0
+
+    # ---- internals ----
+
+    def _refuse(self, rid, prompt, priority, reason):
+        """Terminal-at-the-door record ('rejected'/'shed'): no queue
+        entry, no slot, delivered from the next step()."""
+        self._pending.append(RouterFinished(
+            req_id=rid, tokens=list(prompt), n_prompt=len(prompt),
+            n_out=0, finish_reason=reason,
+            text="" if self.detokenize is not None else None,
+            ttft_ms=None, tpot_ms=0.0, priority=priority,
+        ))
+        self.sink.write({
+            "kind": "request", "t": time.time(), "id": rid,
+            "n_prompt": len(prompt), "n_out": 0, "finish_reason": reason,
+            "priority": priority,
+        })
+
+    def _expire_queued(self, now, out):
+        """Router-queue deadline sweep with one fleet tick of lookahead:
+        a request that could not emit even one token if dispatched right
+        now finishes 'timeout' instead of ever taking a slot."""
+        horizon = now + self.fleet_tick_secs()
+        for c in PRIORITIES:
+            q = self._queues[c]
+            if not any(r.expired(horizon) for r in q):
+                continue
+            keep = deque()
+            for req in q:
+                if req.expired(horizon):
+                    out.append(self._finish_router_timeout(req))
+                else:
+                    keep.append(req)
+            self._queues[c] = keep
+
+    def _pick_class(self):
+        """Smoothed weighted round-robin over non-empty classes: each
+        pick credits every contender its weight, serves the largest
+        credit, then debits the total — weights 4:1 interleave
+        I I I I B ... exactly. An empty class's credit resets, so batch
+        absorbs idle capacity without banking a starvation-sized burst
+        for later."""
+        live = [c for c in PRIORITIES if self._queues[c]]
+        if not live:
+            return None
+        for c in PRIORITIES:
+            if c not in live:
+                self._wrr[c] = 0.0
+        for c in live:
+            self._wrr[c] += self.weights[c]
+        pick = max(live, key=lambda c: (self._wrr[c], -PRIORITIES.index(c)))
+        self._wrr[pick] -= sum(self.weights[c] for c in live)
+        return pick
+
+    def _pick_replica(self, req, now):
+        """SLO-aware placement: free-slot fraction, minus any engine
+        queue backlog, minus — for deadline-carrying requests — the
+        replica's step time scaled by the inverse of the remaining
+        slack (a tight deadline prefers the fastest replica; an
+        unhurried one just fills the emptiest). Deterministic tiebreak
+        on replica id."""
+        cands = [r for r in self.replicas if r.dispatchable_slots > 0]
+        if not cands:
+            return None
+        slack_s = None
+        if req.deadline_ms is not None:
+            slack_s = max(req.deadline_ms / 1e3 - (now - req.submit_t),
+                          1e-3)
+
+        def score(r):
+            # dispatchable fraction already nets out the engine-queue
+            # backlog (replica.dispatchable_slots), so occupancy and
+            # queue depth are both in this one term
+            s = r.dispatchable_slots / r.n_slots
+            if slack_s is not None:
+                s -= r.median_step_secs() / slack_s
+            return (s, -r.replica_id)
+
+        return max(cands, key=score)
+
+    def _dispatch_all(self, now):
+        while any(r.dispatchable_slots > 0 for r in self.replicas):
+            c = self._pick_class()
+            if c is None:
+                return
+            req = self._queues[c].popleft()
+            rep = self._pick_replica(req, now)
+            eng_rid = rep.engine.submit(
+                req.prompt, max_new_tokens=req.max_new_tokens,
+                temperature=req.temperature, top_k=req.top_k,
+                stop_tokens=req.stop_tokens, rng=req.rng,
+                deadline_ms=req.deadline_ms, submit_t=req.submit_t,
+            )
+            req.dispatch_t = self._clock()
+            self._where[req.rid] = rep.replica_id
+            self._by_replica[rep.replica_id][eng_rid] = req.rid
+
+    def _harvest(self, rep, f):
+        """Map an engine FinishedRequest back to its router identity."""
+        rid = self._by_replica[rep.replica_id].pop(f.req_id)
+        req = self._open.pop(rid)
+        self._where.pop(rid, None)
+        if req.dispatch_t is not None:
+            self._holds.append(self._clock() - req.dispatch_t)
+            if len(self._holds) > 64:
+                del self._holds[:32]
+        return RouterFinished(
+            **{**dataclasses.asdict(f), "req_id": rid},
+            priority=req.priority, replica=rep.replica_id,
+            failovers=req.failovers,
+        )
+
+    def _failover(self, rep):
+        """A replica died: every request it held goes back to the FRONT
+        of its class queue (oldest first — they have waited longest) for
+        a from-scratch re-prefill elsewhere; the dead attempt's partial
+        tokens are discarded so the eventual output is the one-shot
+        stream. A request already past its deadline finishes 'timeout'
+        here instead of being requeued."""
+        assigned = self._by_replica[rep.replica_id]
+        if not assigned:
+            return
+        reqs = sorted((self._open[rid] for rid in assigned.values()),
+                      key=lambda r: (r.submit_t, r.rid))
+        assigned.clear()
+        now = self._clock()
+        for req in reversed(reqs):
+            self._where.pop(req.rid, None)
+            req.dispatch_t = None
+            req.failovers += 1
+            if req.expired(now):
+                # not a failover (nothing is re-prefilled): the death
+                # just surfaced a deadline that had already passed
+                self._pending.append(self._finish_router_timeout(req))
+            else:
+                self._reg.counter("serve_failovers").add(1)
+                self._queues[req.priority].appendleft(req)
+
+    def _finish_router_timeout(self, req):
+        """Deadline death in the ROUTER's hands (queued, or orphaned by
+        a failover past its deadline): same counters and record shape as
+        the engine's queued-timeout path."""
+        self._open.pop(req.rid, None)
+        self._reg.counter("serve_requests").add(1)
+        self._reg.counter("serve_timeouts").add(1)
+        self.sink.write({
+            "kind": "request", "t": time.time(), "id": req.rid,
+            "n_prompt": len(req.prompt), "n_out": 0,
+            "finish_reason": "timeout", "priority": req.priority,
+        })
+        return RouterFinished(
+            req_id=req.rid, tokens=list(req.prompt),
+            n_prompt=len(req.prompt), n_out=0, finish_reason="timeout",
+            text="" if self.detokenize is not None else None,
+            ttft_ms=None, tpot_ms=0.0, priority=req.priority,
+            failovers=req.failovers,
+        )

@@ -82,11 +82,14 @@ class FCFSScheduler:
 
     # -- admission / recycling --
 
-    def expire_queued(self, now):
+    def expire_queued(self, now, lookahead_s=0.0):
         """Pop (and return) every queued request whose deadline has
         passed — BEFORE admission, so a request that can no longer be
-        served never burns a prefill dispatch or blocks the FCFS head."""
-        expired = [r for r in self._queue if r.expired(now)]
+        served never burns a prefill dispatch or blocks the FCFS head.
+        `lookahead_s` (the engine's decode-tick estimate) also expires
+        requests whose remaining deadline cannot cover even one more
+        tick: hopeless work must never occupy a slot (ISSUE 6)."""
+        expired = [r for r in self._queue if r.expired(now + lookahead_s)]
         if expired:
             dead = {r.req_id for r in expired}
             self._queue = deque(r for r in self._queue

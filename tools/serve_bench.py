@@ -1,12 +1,16 @@
-"""Seeded-Poisson load generator for the serve engine (ISSUE 2).
+"""Seeded-Poisson load generator for the serve fleet (ISSUE 2 + 6).
 
-Drives avenir_tpu/serve.Engine with exponential interarrivals on the
-wall clock and reports TTFT / TPOT p50/p99 plus engine goodput. The
-request mix (prompt lengths, budgets, arrival times) is fully
+Drives `avenir_tpu/serve.Router` (N in-process replicas over one model;
+`--n_replicas=1` is the single-engine case) with exponential
+interarrivals on the wall clock and reports TTFT / TPOT p50/p99,
+goodput, and per-priority-class SLO attainment — the fraction of
+requests meeting a TTFT/TPOT target (ISSUE 6 satellite). The request
+mix (prompt lengths, budgets, priorities, arrival times) is fully
 determined by --seed; by default the model is a tiny random-init GPT so
 the bench runs anywhere (pass --out_dir to serve a trained ckpt.pt).
 
     python tools/serve_bench.py --n_requests=64 --rate=20 --n_slots=4 \
+        --n_replicas=2 --batch_frac=0.5 --slo_ttft_ms=500 \
         --max_new_tokens=32 --metrics_log=/tmp/serve/metrics.jsonl
 
 --metrics_log writes an obs JSONL (run_meta / request / run_end) that
@@ -34,12 +38,31 @@ def _pct(xs, q):
     return float("nan") if p is None else p
 
 
+def slo_attainment(finished, *, slo_ttft_ms, slo_tpot_ms):
+    """Fraction of a class's requests that were SERVED (tokens
+    delivered, not shed/rejected/timed out) within both targets; tpot
+    applies only where it is defined (n_out > 1)."""
+    if not finished:
+        return None
+    met = 0
+    for f in finished:
+        ok = (f.finish_reason in ("stop", "length")
+              and f.ttft_ms is not None and f.ttft_ms <= slo_ttft_ms
+              and (f.n_out <= 1 or f.tpot_ms <= slo_tpot_ms))
+        met += bool(ok)
+    return met / len(finished)
+
+
 def main():
     args = {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
             for a in sys.argv[1:]}
     n_requests = int(args.get("n_requests", 32))
     rate = float(args.get("rate", 16.0))  # mean arrivals per second
     n_slots = int(args.get("n_slots", 4))
+    n_replicas = int(args.get("n_replicas", 1))
+    batch_frac = float(args.get("batch_frac", 0.0))
+    slo_ttft_ms = float(args.get("slo_ttft_ms", 500.0))
+    slo_tpot_ms = float(args.get("slo_tpot_ms", 50.0))
     max_new = int(args.get("max_new_tokens", 32))
     max_prompt = int(args.get("max_prompt", 48))
     seed = int(args.get("seed", 0))
@@ -50,7 +73,7 @@ def main():
     from flax import nnx
 
     from avenir_tpu.obs import JsonlSink, NullSink, reset_registry
-    from avenir_tpu.serve import Engine
+    from avenir_tpu.serve import PRIORITIES, Router
 
     if out_dir:
         from avenir_tpu.checkpoint.io import load_checkpoint
@@ -83,8 +106,8 @@ def main():
         os.makedirs(os.path.dirname(os.path.abspath(metrics_log)),
                     exist_ok=True)
         sink = JsonlSink(metrics_log)
-    engine = Engine(model, n_slots=n_slots, registry=reg, sink=sink,
-                    seed=seed)
+    router = Router(model, n_replicas=n_replicas, n_slots=n_slots,
+                    registry=reg, sink=sink, seed=seed)
 
     load_rng = np.random.default_rng(seed)
     arrivals = np.cumsum(load_rng.exponential(1.0 / rate, n_requests))
@@ -93,21 +116,25 @@ def main():
                                            int(load_rng.integers(2, max_prompt + 1)))]
         for _ in range(n_requests)
     ]
+    priorities = ["batch" if load_rng.random() < batch_frac
+                  else "interactive" for _ in range(n_requests)]
 
     sink.write({"kind": "run_meta", "t": time.time(), "model_type":
                 type(model).__name__.lower(), "n_slots": n_slots,
-                "rate": rate, "n_requests": n_requests, "seed": seed})
+                "n_replicas": n_replicas, "rate": rate,
+                "n_requests": n_requests, "seed": seed})
     t0 = time.perf_counter()
     submitted = 0
     done = []
     while len(done) < n_requests:
         now = time.perf_counter() - t0
         while submitted < n_requests and arrivals[submitted] <= now:
-            engine.submit(prompts[submitted], max_new_tokens=max_new,
-                          temperature=1.0, top_k=top_k)
+            router.submit(prompts[submitted], max_new_tokens=max_new,
+                          temperature=1.0, top_k=top_k,
+                          priority=priorities[submitted])
             submitted += 1
-        if engine.sched.queue_depth or engine._live:
-            done.extend(engine.step())
+        if router.open_requests or router._pending:
+            done.extend(router.step())
         elif submitted < n_requests:
             time.sleep(min(0.005, arrivals[submitted] - now))
     wall = time.perf_counter() - t0
@@ -115,11 +142,12 @@ def main():
                 "counters": reg.snapshot()["counters"]})
     sink.close()
 
-    ttfts = [f.ttft_ms for f in done]
+    ttfts = [f.ttft_ms for f in done if f.ttft_ms is not None]
     tpots = [f.tpot_ms for f in done if f.n_out > 1]
-    tokens_out = reg.snapshot()["counters"]["tokens_out"]
+    counters = reg.snapshot()["counters"]
+    tokens_out = counters["tokens_out"]
     print(f"requests: {n_requests} at {rate:.1f} req/s (seed {seed}), "
-          f"{n_slots} slots, wall {wall:.2f}s")
+          f"{n_replicas} replica(s) x {n_slots} slots, wall {wall:.2f}s")
     print(f"ttft: p50 {_pct(ttfts, 0.50):.1f} ms  "
           f"p99 {_pct(ttfts, 0.99):.1f} ms")
     print(f"tpot: p50 {_pct(tpots, 0.50):.2f} ms  "
@@ -127,8 +155,24 @@ def main():
     print(f"goodput: {tokens_out / wall:,.1f} tok/s out "
           f"({tokens_out:.0f} tokens), "
           f"{len(done) / wall:.2f} req/s completed")
-    print(f"compiles: {len(engine.traces['prefill'])} prefill bucket(s) "
-          f"+ {len(engine.traces['step'])} decode step")
+    for cls in PRIORITIES:
+        fs = [f for f in done if f.priority == cls]
+        if not fs:
+            continue
+        att = slo_attainment(fs, slo_ttft_ms=slo_ttft_ms,
+                             slo_tpot_ms=slo_tpot_ms)
+        cls_ttft = [f.ttft_ms for f in fs if f.ttft_ms is not None]
+        refused = sum(f.finish_reason in ("shed", "rejected", "timeout")
+                      for f in fs)
+        print(f"slo[{cls}]: attainment {att:6.1%} of {len(fs)} "
+              f"(ttft<={slo_ttft_ms:.0f}ms & tpot<={slo_tpot_ms:.0f}ms)  "
+              f"ttft p99 {_pct(cls_ttft, 0.99):.1f} ms"
+              + (f"  shed/rejected/timeout: {refused}" if refused else ""))
+    n_prefills = sum(len(r.engine.traces["prefill"])
+                     for r in router.replicas)
+    n_steps = sum(len(r.engine.traces["step"]) for r in router.replicas)
+    print(f"compiles: {n_prefills} prefill bucket(s) "
+          f"+ {n_steps} decode step(s) across {n_replicas} replica(s)")
     if metrics_log:
         print(f"metrics: {metrics_log} "
               f"(summarize: python tools/obs_report.py {metrics_log})")
