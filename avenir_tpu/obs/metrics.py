@@ -179,6 +179,27 @@ METRIC_SCHEMA = {
         "gauge", "1",
         "healthy replicas in the serve fleet after the last router step "
         "(draining and dead excluded)"),
+    "replica_respawns": (
+        "counter", "1",
+        "dead process-backend replicas respawned by the fleet "
+        "supervisor (serve/proc.py RespawnSupervisor; capped "
+        "exponential backoff via utils/retry.RetryPolicy) — the worker "
+        "rejoins EMPTY, its former work having already failed over"),
+    "rpc_timeouts": (
+        "counter", "1",
+        "worker RPCs that exceeded their per-op timeout "
+        "(serve/proc.py) — the silent-wedge detection path: the replica "
+        "is marked dead, its corpse SIGKILLed, its work failed over"),
+    "frame_crc_errors": (
+        "counter", "1",
+        "worker frames refused for a CRC mismatch (serve/frames.py) — "
+        "pipe corruption; treated as replica death and NEVER retried "
+        "(the stream offset is no longer trustworthy)"),
+    "heartbeat_age_s": (
+        "gauge", "s",
+        "oldest heartbeat age across non-dead replicas after the last "
+        "router step — a rising value is a stall forming, visible "
+        "before the threshold declares it"),
     "slot_occupancy": (
         "gauge", "1",
         "fraction of KV slots live after the last engine step"),

@@ -106,6 +106,9 @@ def summarize(records):
             "n_rejected": sum(1 for r in requests
                               if r.get("finish_reason") == "rejected"),
             "failovers": counters.get("serve_failovers", 0.0),
+            "respawns": counters.get("replica_respawns", 0.0),
+            "rpc_timeouts": counters.get("rpc_timeouts", 0.0),
+            "frame_crc_errors": counters.get("frame_crc_errors", 0.0),
             "tokens_out": tokens_out,
             "goodput_tok_per_sec": (tokens_out / (total_ms / 1e3)
                                     if total_ms else None),
@@ -238,6 +241,11 @@ def format_report(s):
                         if sv.get("n_timeouts") else ""))
         fleet_bits = [
             f"failovers {sv['failovers']:.0f}" if sv.get("failovers") else "",
+            f"respawns {sv['respawns']:.0f}" if sv.get("respawns") else "",
+            (f"RPC TIMEOUTS: {sv['rpc_timeouts']:.0f}"
+             if sv.get("rpc_timeouts") else ""),
+            (f"FRAME CRC ERRORS: {sv['frame_crc_errors']:.0f}"
+             if sv.get("frame_crc_errors") else ""),
             f"SHED: {sv['n_shed']}" if sv.get("n_shed") else "",
             f"rejected {sv['n_rejected']}" if sv.get("n_rejected") else "",
         ]

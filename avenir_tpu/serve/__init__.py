@@ -13,20 +13,40 @@ plus the multi-replica fleet layer over it (ISSUE 6).
                 sites (serve_step_fail, replica_stall)
 - router.py:    fleet front door — failover (no accepted request ever
                 lost), admission control + load shedding, priority
-                fair-share, SLO-aware dispatch
+                fair-share, SLO-aware dispatch; `backend='process'`
+                swaps in process-isolated replicas (ISSUE 8)
+- frames.py:    length-prefixed, CRC-checked, versioned frame protocol
+                over pipes (stdlib-only)
+- worker.py:    `python -m avenir_tpu.serve.worker` — one Engine in its
+                own OS process behind a frame-RPC loop
+- proc.py:      ProcReplica (the Replica surface over a worker process:
+                per-op RPC timeouts, EOF/CRC/timeout -> dead) + the
+                capped-backoff RespawnSupervisor
 
 See docs/SERVING.md for the design, the parity contract, and the
 router's failover semantics.
 """
 
 from avenir_tpu.serve.engine import Engine, FinishedRequest
-from avenir_tpu.serve.replica import DEAD, DRAINING, HEALTHY, Replica
+from avenir_tpu.serve.proc import (
+    ProcReplica,
+    RespawnSupervisor,
+    model_spec_from_model,
+)
+from avenir_tpu.serve.replica import (
+    DEAD,
+    DRAINING,
+    HEALTHY,
+    Replica,
+    ReplicaGone,
+)
 from avenir_tpu.serve.router import PRIORITIES, Router, RouterFinished
 from avenir_tpu.serve.scheduler import FCFSScheduler, Request
 from avenir_tpu.serve.slots import SlotPool, init_slot_pool
 
 __all__ = [
     "Engine", "FinishedRequest", "FCFSScheduler", "Request", "SlotPool",
-    "init_slot_pool", "Replica", "Router", "RouterFinished", "PRIORITIES",
-    "HEALTHY", "DRAINING", "DEAD",
+    "init_slot_pool", "Replica", "ReplicaGone", "ProcReplica",
+    "RespawnSupervisor", "model_spec_from_model", "Router",
+    "RouterFinished", "PRIORITIES", "HEALTHY", "DRAINING", "DEAD",
 ]

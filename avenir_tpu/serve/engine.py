@@ -220,6 +220,21 @@ class Engine:
             return 0.0
         return statistics.median_low(self._tick_s)
 
+    def stats(self):
+        """Host-state heartbeat snapshot — what a process worker ships
+        back in every reply frame so its parent-side ProcReplica can
+        mirror the scheduler surface the router routes on
+        (serve/proc.py) without a second RPC."""
+        return {
+            "n_slots": self.n_slots,
+            "free": self.sched.free_slots,
+            "queue": self.sched.queue_depth,
+            "live": {int(lv.req.req_id): len(lv.emitted)
+                     for lv in self._live.values()},
+            "pending": len(self._pending),
+            "tick_s": self.tick_estimate_s(),
+        }
+
     def submit(self, prompt, *, max_new_tokens, temperature=1.0,
                top_k=None, stop_tokens=(), rng=None, deadline_ms=None,
                submit_t=None):
@@ -347,6 +362,26 @@ class Engine:
             "the decode step retraced — a slot-pool shape leaked"
         )
         return finished
+
+    def evict(self, rids):
+        """Host-driven expiry (ISSUE 8): a process worker's PARENT owns
+        the deadline clock (worker clocks are unrelated to the fleet's,
+        injectable test clocks doubly so), so it names the expired
+        requests and the engine evicts them with timeout semantics — a
+        queued one finishes without ever taking a slot, a live one
+        finishes with its partial tokens and frees the slot for this
+        step's admissions. Returns the finished records."""
+        rids = set(rids)
+        out = []
+        if not rids:
+            return out
+        for slot in sorted(self._live):
+            live = self._live[slot]
+            if live.req.req_id in rids:
+                out.append(self._finish(slot, live, "timeout"))
+        out.extend(self._finish_queued_timeout(r)
+                   for r in self.sched.remove(rids))
+        return out
 
     def drain(self):
         """Run steps until queue and slots are empty; returns every

@@ -1,0 +1,191 @@
+"""Length-prefixed, CRC-checked, versioned frame protocol for the
+process-isolated serve fleet (ISSUE 8 tentpole, part 1).
+
+The sandbox has no sockets, so a serve worker process talks to its
+parent over stdin/stdout pipes. Pipes deliver a byte stream with none
+of the message framing, integrity or liveness guarantees an RPC layer
+needs, and a fleet that SIGKILLs workers on purpose (tools/
+chaos_serve.py) will routinely read half-written frames from corpses —
+so every message rides in one self-describing frame:
+
+    MAGIC "AVFR" | u8 proto version | u8 payload type | u32 payload len
+    | u32 CRC-32 of payload | payload bytes
+
+and every failure mode is a DISTINCT, loud exception:
+
+    FrameProtocolError  bad magic (stream desync — a worker printed to
+                        the frame fd) or a proto version this side does
+                        not speak: fail fast, never guess
+    FrameCRCError       payload bytes did not survive the pipe (or the
+                        `frame_corrupt` fault site flipped one). Never
+                        retried — like the checkpoint manifests
+                        (ISSUE 5), corruption is fallback territory,
+                        not retry territory: the reader's stream offset
+                        can no longer be trusted, so the peer is dead
+    FrameEOF            the peer closed the pipe (worker SIGKILLed,
+                        parent gone) — possibly mid-frame
+    FrameTimeout        no (complete) frame within the caller's per-op
+                        budget: a silently wedged peer
+
+Payloads are JSON (`PT_JSON`, the control plane) or pickle
+(`PT_PICKLE`, the model-state handshake: config dataclass + numpy
+weight arrays — parent and worker run the same trusted codebase, and
+the handshake is the only pickle frame either side ever sends).
+
+Deliberately stdlib-only: the codec imports no jax, so the protocol
+unit tests (tests/test_serve_proc.py, tier-1) cost nothing, and a
+future transport (sockets, shared memory) swaps the fd layer without
+touching the frame format. The `frame_corrupt` fault site lives in the
+WRITER — the CRC is computed first, then the flip — so what the tests
+exercise is the reader's production detection path.
+"""
+
+import json
+import os
+import pickle
+import select
+import struct
+import time
+import zlib
+
+MAGIC = b"AVFR"
+PROTO_VERSION = 1
+PT_JSON = 0
+PT_PICKLE = 1
+
+_HEADER = struct.Struct(">4sBBII")  # magic, version, ptype, len, crc
+HEADER_SIZE = _HEADER.size
+
+# a frame bigger than this is a desynced stream, not a message (the
+# largest legitimate frame is the model-state handshake; 1 GiB covers
+# any model whose weights a pipe handshake makes sense for at all)
+MAX_FRAME_BYTES = 1 << 30
+
+
+class FrameError(RuntimeError):
+    """Base of every frame-layer failure."""
+
+
+class FrameProtocolError(FrameError):
+    """Bad magic or a protocol version this side does not speak."""
+
+
+class FrameCRCError(FrameError):
+    """Payload failed its CRC — corruption, never retried."""
+
+
+class FrameEOF(FrameError):
+    """Peer closed the pipe (possibly mid-frame)."""
+
+
+class FrameTimeout(FrameError):
+    """No complete frame within the caller's per-op budget."""
+
+
+def encode_frame(obj, ptype=PT_JSON):
+    """One wire-ready frame. The CRC covers the payload as SERIALIZED;
+    the `frame_corrupt` fault site flips a payload byte AFTER the CRC
+    is computed, so an armed injector produces exactly the torn frame
+    the reader's CRC check exists to catch."""
+    if ptype == PT_JSON:
+        payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    elif ptype == PT_PICKLE:
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    else:
+        raise ValueError(f"unknown payload type {ptype!r}")
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    from avenir_tpu.utils.faults import get_injector
+
+    payload = get_injector().corrupt("frame_corrupt", payload)
+    return _HEADER.pack(MAGIC, PROTO_VERSION, ptype, len(payload), crc) \
+        + payload
+
+
+def decode_header(header):
+    """-> (ptype, length, crc); raises FrameProtocolError loudly on bad
+    magic or a version mismatch (the handshake's fail-fast path)."""
+    magic, version, ptype, length, crc = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise FrameProtocolError(
+            f"bad frame magic {magic!r} — stream desync (did something "
+            "print to the frame fd?)")
+    if version != PROTO_VERSION:
+        raise FrameProtocolError(
+            f"frame protocol version mismatch: peer speaks v{version}, "
+            f"this side speaks v{PROTO_VERSION} — refusing to guess at "
+            "an incompatible wire format (upgrade both sides together)")
+    if length > MAX_FRAME_BYTES:
+        raise FrameProtocolError(
+            f"frame length {length} exceeds MAX_FRAME_BYTES — desynced "
+            "stream or a hostile peer")
+    return ptype, length, crc
+
+
+def decode_payload(ptype, payload, crc):
+    """CRC-check and deserialize one payload."""
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise FrameCRCError(
+            f"frame payload failed CRC ({len(payload)} bytes) — the pipe "
+            "delivered corrupt bytes; the stream is no longer trustworthy")
+    if ptype == PT_JSON:
+        return json.loads(payload.decode("utf-8"))
+    if ptype == PT_PICKLE:
+        return pickle.loads(payload)
+    raise FrameProtocolError(f"unknown payload type {ptype}")
+
+
+class FrameStream:
+    """Frame reader/writer over a pair of pipe fds.
+
+    Reads are select()-driven with a wall-clock deadline shared across
+    the header and payload of one frame — a peer that trickles half a
+    frame and wedges still trips FrameTimeout. After any FrameError the
+    stream's buffer can hold a partial frame; callers treat the peer as
+    dead (the fleet's policy) rather than resynchronize.
+    """
+
+    def __init__(self, read_fd, write_fd):
+        self._rfd = read_fd
+        self._wfd = write_fd
+        self._buf = bytearray()  # bytearray: += on bytes is quadratic
+        #                          over a GiB-scale handshake frame
+
+    def write(self, obj, ptype=PT_JSON):
+        """Serialize and write one frame; OSError (EPIPE when the peer
+        is a corpse) propagates to the caller's dead-peer handling."""
+        data = encode_frame(obj, ptype)
+        view = memoryview(data)
+        while view:
+            n = os.write(self._wfd, view)
+            view = view[n:]
+
+    def read(self, timeout_s=None):
+        """Read one frame; returns the decoded object. `timeout_s` is
+        the whole-frame budget (None = block forever)."""
+        deadline = None if timeout_s is None \
+            else time.monotonic() + timeout_s
+        header = self._read_exact(HEADER_SIZE, deadline)
+        ptype, length, crc = decode_header(header)
+        payload = self._read_exact(length, deadline)
+        return decode_payload(ptype, payload, crc)
+
+    def _read_exact(self, n, deadline):
+        while len(self._buf) < n:
+            if deadline is not None:
+                wait = deadline - time.monotonic()
+                if wait <= 0:
+                    raise FrameTimeout(
+                        "no complete frame within the per-op timeout")
+            else:
+                wait = None
+            ready, _, _ = select.select([self._rfd], [], [], wait)
+            if not ready:
+                raise FrameTimeout(
+                    "no complete frame within the per-op timeout")
+            chunk = os.read(self._rfd, 1 << 16)
+            if not chunk:
+                raise FrameEOF("peer closed the pipe")
+            self._buf.extend(chunk)
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out

@@ -1,0 +1,720 @@
+"""Process-isolated serve replicas: ProcReplica + respawn supervisor
+(ISSUE 8 tentpole, part 3).
+
+`ProcReplica` is the parent-side handle for one `serve/worker.py`
+process. It duck-types the in-process `Replica` surface the Router
+already speaks — state machine, heartbeat, `step()`, and an `engine`
+proxy carrying `submit`/`T_max`/`sched.queue_depth`/`_live` — so the
+Router's failover, admission and fair-share semantics are IDENTICAL
+over both backends (the same tests run over both; the router changes
+no logic, only which replica class it builds).
+
+What changes is what death means. An in-process replica dies by
+exception or injected silence; a process replica dies for real:
+
+    pipe EOF / EPIPE    the worker was SIGKILLed (chaos, OOM killer,
+                        a preempted node) — mark_dead, fail over
+    RPC timeout         the worker is silently wedged (`worker_hang`);
+                        the per-op budget is the stall-threshold rule
+                        plus slack, with a compile grace while the
+                        worker is still warming — mark_dead, SIGKILL
+                        the corpse, fail over (`rpc_timeouts`)
+    CRC mismatch        the pipe delivered corrupt bytes
+                        (`frame_corrupt`); the stream offset can no
+                        longer be trusted, so corruption is death,
+                        never a retry (`frame_crc_errors`) — the same
+                        policy as checkpoint manifests (ISSUE 5)
+    op error reply      the engine raised inside the worker — the
+                        process analogue of `serve_step_fail`
+
+Retries exist ONLY for idempotent ops (`ping`): a retried `submit`
+could double-enqueue, a retried `step` double-advances — non-idempotent
+failures fail over instead, which the router already knows how to do.
+
+Latency truth: TTFT/TPOT are stamped on the PARENT's clock from the
+step replies' first-token lists, with the router's own `submit_t` — a
+worker's clock is unrelated to the parent's, and the parity/fair-share
+tests drive injectable clocks. Engine counters are mirrored into the
+fleet registry as per-reply deltas, so one registry tells the whole
+fleet's story either backend (docs/OBSERVABILITY.md).
+
+`RespawnSupervisor` is the restart story the ROADMAP's phase-2 item
+asks for: a dead worker is respawned with capped exponential backoff
+(`utils/retry.RetryPolicy` — the same schedule shape the checkpoint
+IO retries use), rejoins EMPTY (the router already requeued its work,
+so re-prefill failover keeps completed outputs bit-identical), and a
+crash-looping worker exhausts its budget and stays dead — at which
+point `Router.drain()` stops waiting and fails loud.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+from avenir_tpu.obs import NullSink, get_registry
+from avenir_tpu.serve.frames import (
+    PROTO_VERSION,
+    PT_PICKLE,
+    FrameCRCError,
+    FrameError,
+    FrameStream,
+    FrameTimeout,
+)
+from avenir_tpu.serve.replica import DEAD, HEALTHY, ReplicaGone, \
+    ReplicaHealth
+from avenir_tpu.utils.faults import get_injector
+from avenir_tpu.utils.retry import RetryPolicy, call_with_retry
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+# the worker is launched through this bootstrap, not bare `-m`: fd 1
+# must become the frame fd BEFORE the package imports run (jax/flax
+# import-time chatter on a still-unredirected stdout would land in the
+# frame stream ahead of the hello reply and desync the protocol).
+# `python -m avenir_tpu.serve.worker` stays a valid manual entrypoint —
+# worker.main() does its own dup when the env var is absent.
+_WORKER_BOOTSTRAP = (
+    "import os,sys;"
+    "fd=os.dup(1);os.dup2(2,1);sys.stdout=sys.stderr;"
+    "os.environ['AVENIR_WORKER_FRAME_FD']=str(fd);"
+    "from avenir_tpu.serve.worker import main;main()"
+)
+
+# per-op reply budgets (seconds). `step` is dynamic — the stall
+# threshold plus slack (see ProcReplica._step_timeout_s); `hello` is
+# generous because the worker pays the jax import and model build
+# inside it.
+OP_TIMEOUT_S = {
+    "hello": 600.0,
+    "submit": 60.0,
+    "ping": 10.0,
+    "arm_fault": 10.0,
+    "shutdown": 10.0,
+}
+IDEMPOTENT_OPS = frozenset({"ping"})
+
+
+class WorkerOpError(RuntimeError):
+    """The worker replied ok=False — its engine raised (the process
+    analogue of serve_step_fail) or it refused the op."""
+
+
+def model_spec_from_model(model):
+    """Handshake spec for a live model: (family, config dataclass,
+    numpy state). Shipping the actual weights — not an init seed —
+    makes worker models BIT-identical to the parent's, which is what
+    the failover parity contract rests on. Deployments serving big
+    checkpoints pass {"kind": "checkpoint", "out_dir": ...} instead so
+    the weights ride the filesystem, not a pipe."""
+    import jax
+    from flax import nnx
+
+    _, state = nnx.split(model)
+    return {
+        "kind": "state",
+        "family": type(model).__name__.lower(),
+        "config": model.config,
+        "state": jax.tree.map(lambda x: np.asarray(x), state),
+    }
+
+
+class _SchedView:
+    """The slice of FCFSScheduler the router reads, mirrored from
+    worker heartbeats."""
+
+    def __init__(self):
+        self.queue_depth = 0
+        self.free_slots = 0
+
+
+class _EngineProxy:
+    """Parent-side mirror of the worker's engine host state, refreshed
+    from every reply frame's heartbeat. The router reads `T_max`,
+    `sched.queue_depth`, `_live`, `tick_estimate_s()` and calls
+    `submit()` — the same surface the in-process Engine exposes."""
+
+    def __init__(self, owner):
+        self._owner = owner
+        self.T_max = None          # set by the handshake
+        self.n_slots = 0
+        self.sched = _SchedView()
+        self._live = {}            # engine rid -> tokens emitted so far
+        self._pending = 0
+        self._tick_s = 0.0
+
+    def tick_estimate_s(self):
+        return self._tick_s
+
+    def submit(self, *args, **kw):
+        return self._owner._submit_rpc(*args, **kw)
+
+    def update(self, hb):
+        self.n_slots = int(hb.get("n_slots", self.n_slots))
+        self.sched.free_slots = int(hb.get("free", 0))
+        self.sched.queue_depth = int(hb.get("queue", 0))
+        self._live = {int(k): int(v)
+                      for k, v in (hb.get("live") or {}).items()}
+        self._pending = int(hb.get("pending", 0))
+        self._tick_s = float(hb.get("tick_s", 0.0))
+
+    def clear(self):
+        self.sched.free_slots = 0
+        self.sched.queue_depth = 0
+        self._live = {}
+        self._pending = 0
+        self._tick_s = 0.0
+
+
+class ProcReplica(ReplicaHealth):
+    """One serve worker PROCESS, behind the Replica health/dispatch
+    surface. Construction spawns and handshakes the worker; pass
+    `defer_handshake=True` (the Router does) to spawn a whole fleet
+    first and let the workers pay their jax imports concurrently."""
+
+    def __init__(self, model_spec, replica_id, *, n_slots=4,
+                 max_seq_len=None, detokenize=None, registry=None,
+                 sink=None, seed=0, clock=None, stall_floor_secs=10.0,
+                 stall_factor=10.0, rpc_slack_secs=5.0,
+                 compile_grace_secs=300.0, env=None,
+                 defer_handshake=False):
+        super().__init__(
+            replica_id,
+            clock=clock if clock is not None else time.perf_counter,
+            stall_floor_secs=stall_floor_secs, stall_factor=stall_factor)
+        self._spec = model_spec
+        self._ekw = {"n_slots": int(n_slots), "max_seq_len": max_seq_len,
+                     "detokenize": detokenize, "seed": int(seed)}
+        self._reg = registry if registry is not None else get_registry()
+        self.sink = sink if sink is not None else NullSink()
+        self.rpc_slack_secs = float(rpc_slack_secs)
+        self.compile_grace_secs = float(compile_grace_secs)
+        self._env = env
+        self.engine = _EngineProxy(self)
+        self._proc = None
+        self._stream = None
+        self._counters_seen = {}   # worker counter totals, last reply
+        self._seq = 0              # request/reply alignment (see _rpc)
+        self._submit_t = {}        # engine rid -> router-clock submit_t
+        self._t_first = {}         # engine rid -> router-clock 1st token
+        self._deadline = {}        # engine rid -> deadline_ms (or None)
+        self._n_busy_steps = 0
+        # compile-grace accounting: the worker compiles on its first
+        # prefill of each prompt BUCKET (and its first decode step) —
+        # track which buckets this worker instance has seen so the
+        # step-RPC timeout grants grace exactly when a compile may be
+        # in flight, not just for the first two steps of its life (a
+        # late new-bucket prompt must not read as a hang)
+        self._seen_buckets = set()
+        self._grace_steps = 2
+        self._spawn()
+        if not defer_handshake:
+            self.finish_handshake()
+
+    # -- lifecycle --
+
+    def _spawn(self):
+        env = dict(os.environ if self._env is None else self._env)
+        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep \
+            + env.get("PYTHONPATH", "")
+        # the worker's jax must land on the parent's platform even when
+        # only the live config (not the env) was pinned to it
+        env.setdefault("JAX_PLATFORMS", _parent_platform())
+        self._proc = subprocess.Popen(
+            [sys.executable, "-c", _WORKER_BOOTSTRAP],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=None,  # worker chatter joins the parent's stderr
+            cwd=_REPO_ROOT, env=env)
+        try:
+            # widen the hello pipe (best effort): the model-state frame
+            # can exceed the 64 KiB default, and a write past the buffer
+            # blocks the parent until the worker finishes its jax import
+            import fcntl
+
+            fcntl.fcntl(self._proc.stdin.fileno(),
+                        fcntl.F_SETPIPE_SZ, 1 << 20)
+        except (ImportError, AttributeError, OSError, PermissionError):
+            pass
+        self._stream = FrameStream(self._proc.stdout.fileno(),
+                                   self._proc.stdin.fileno())
+        # NOTE: no hello here — _spawn only starts the process, so a
+        # fleet can launch N workers and they all pay their jax imports
+        # concurrently; the hello (whose pickled model state can exceed
+        # the pipe buffer, blocking the writer until the worker reads)
+        # goes out in finish_handshake
+
+    def finish_handshake(self):
+        """Send hello, block for the worker's reply; fail loud on a
+        protocol mismatch (never guess at an incompatible peer)."""
+        self._seq += 1
+        self._stream.write(
+            {"op": "hello", "seq": self._seq, "proto": PROTO_VERSION,
+             "model": self._spec, "engine": self._ekw},
+            ptype=PT_PICKLE)
+        reply = self._read_reply(timeout_s=OP_TIMEOUT_S["hello"])
+        if not reply.get("ok"):
+            raise RuntimeError(
+                f"replica {self.replica_id} worker refused handshake: "
+                f"{reply.get('error')}")
+        if reply.get("proto") != PROTO_VERSION:
+            raise RuntimeError(
+                f"replica {self.replica_id} worker speaks proto "
+                f"{reply.get('proto')}, parent speaks {PROTO_VERSION}")
+        self.engine.T_max = int(reply["t_max"])
+        self.engine.n_slots = int(reply["n_slots"])
+        self.engine.sched.free_slots = int(reply["n_slots"])
+        self.last_beat = self._clock()
+        return self
+
+    @property
+    def pid(self):
+        """The worker's OS pid — the chaos drill's REAL SIGKILL target
+        (None once the corpse is reaped)."""
+        return self._proc.pid if self._proc is not None else None
+
+    def _teardown(self, kill):
+        proc, self._proc, self._stream = self._proc, None, None
+        self.engine.clear()
+        if proc is None:
+            return
+        for f in (proc.stdin, proc.stdout):
+            try:
+                f.close()
+            except OSError:
+                pass
+        try:
+            if kill and proc.poll() is None:
+                os.kill(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            proc.wait(timeout=5)  # reap — no zombies in a long fleet
+        except subprocess.TimeoutExpired:
+            pass
+
+    def _on_dead(self):
+        # a replica declared dead for ANY reason tears its process down
+        # — a wedged worker must not linger half-alive (its pipes stay
+        # readable and a later frame would desync the new stream)
+        self._teardown(kill=True)
+
+    def close(self):
+        """Graceful shutdown (drained replica, end of run)."""
+        if self._proc is not None and self._proc.poll() is None:
+            try:
+                self._rpc({"op": "shutdown"},
+                          timeout_s=OP_TIMEOUT_S["shutdown"])
+            except (FrameError, WorkerOpError, OSError, ValueError):
+                pass
+        self._teardown(kill=True)
+
+    def __del__(self):  # best effort — tests and tools call close()
+        try:
+            self._teardown(kill=True)
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    # -- capacity surface the router routes on (mirrors Replica) --
+
+    @property
+    def n_slots(self):
+        return self.engine.n_slots
+
+    @property
+    def free_slots(self):
+        return self.engine.sched.free_slots if self.state == HEALTHY \
+            else 0
+
+    @property
+    def dispatchable_slots(self):
+        if self.state != HEALTHY:
+            return 0
+        return max(0, self.engine.sched.free_slots
+                   - self.engine.sched.queue_depth)
+
+    @property
+    def busy(self):
+        return bool(self.engine._live or self.engine.sched.queue_depth
+                    or self.engine._pending)
+
+    # -- RPC --
+
+    def _rpc(self, msg, *, timeout_s, ptype=0):
+        """One request/reply exchange. Every request carries a sequence
+        number the worker echoes; `_read_reply` discards stale replies
+        (the late answer to an op a retry already gave up on — without
+        this, one retried ping would shift request/reply alignment for
+        every RPC after it). Heartbeat bookkeeping rides every reply;
+        callers map FrameError/WorkerOpError to death."""
+        if self._stream is None:
+            raise ReplicaGone(f"replica {self.replica_id} has no worker")
+        self._seq += 1
+        msg["seq"] = self._seq
+        self._stream.write(msg, ptype=ptype)
+        reply = self._read_reply(timeout_s=timeout_s)
+        if not reply.get("ok"):
+            raise WorkerOpError(reply.get("error", "worker error"))
+        if "hb" in reply:
+            self.engine.update(reply["hb"])
+        if "counters" in reply:
+            self._apply_counter_deltas(reply["counters"])
+        return reply
+
+    def _read_reply(self, *, timeout_s):
+        """Read until the reply matching the current seq (bounded):
+        stale-seq replies are drained and dropped."""
+        for _ in range(16):
+            reply = self._stream.read(timeout_s=timeout_s)
+            if reply.get("seq") == self._seq:
+                return reply
+        raise FrameError(
+            f"replica {self.replica_id}: no reply with seq {self._seq} "
+            "within 16 frames — stream misaligned beyond recovery")
+
+    def _die(self, err, *, counter=None):
+        if counter is not None:
+            self._reg.counter(counter).add(1)
+        self.last_error = err
+        self.mark_dead()
+
+    def _step_timeout_s(self):
+        """The hang-detection budget: the watchdog-rule stall threshold
+        plus RPC slack, with a compile grace whenever the worker may be
+        compiling — its first busy steps, or a step that will admit a
+        prompt from a bucket this worker instance has never prefilled
+        (killing a healthy worker mid-compile would cascade: the
+        failed-over prompt makes the next replica compile and die the
+        same way)."""
+        t = self.stall_threshold_secs() + self.rpc_slack_secs
+        if self._grace_steps > 0:
+            t += self.compile_grace_secs
+        return t
+
+    def _submit_rpc(self, prompt, *, max_new_tokens, temperature=1.0,
+                    top_k=None, stop_tokens=(), rng=None,
+                    deadline_ms=None, submit_t=None):
+        """The proxy's Engine.submit: ships the request (rng as raw key
+        data, submit_t as an AGE — worker clocks are unrelated). The
+        deadline is NOT shipped: deadline semantics belong to the
+        FLEET's clock (injectable in tests), so the parent tracks it
+        and names expired rids in each step request (Engine.evict). A
+        submit is NOT idempotent (a blind resend could double-enqueue),
+        so failure here is replica death + ReplicaGone; the router
+        requeues the request on another replica."""
+        import jax
+
+        now = self._clock()
+        st = now if submit_t is None else float(submit_t)
+        msg = {
+            "op": "submit",
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+            "top_k": None if top_k is None else int(top_k),
+            "stop_tokens": [int(t) for t in (stop_tokens or ())],
+            "rng": None if rng is None else
+                   np.asarray(jax.random.key_data(rng)).tolist(),
+            "age_ms": max(0.0, (now - st) * 1e3),
+        }
+        try:
+            reply = self._rpc(msg, timeout_s=OP_TIMEOUT_S["submit"])
+        except FrameTimeout as e:
+            self._die(e, counter="rpc_timeouts")
+            raise ReplicaGone(str(e)) from e
+        except FrameCRCError as e:
+            self._die(e, counter="frame_crc_errors")
+            raise ReplicaGone(str(e)) from e
+        except (FrameError, WorkerOpError, OSError, ValueError) as e:
+            self._die(e)
+            raise ReplicaGone(str(e)) from e
+        rid = int(reply["rid"])
+        self._submit_t[rid] = st
+        self._deadline[rid] = (None if deadline_ms is None
+                               else float(deadline_ms))
+        from avenir_tpu.infer.decode import prompt_bucket
+
+        bucket = prompt_bucket(len(msg["prompt"]), self.engine.T_max)
+        if bucket not in self._seen_buckets:
+            # the step that admits this prompt pays a prefill compile:
+            # grant the RPC grace for the next couple of steps
+            self._seen_buckets.add(bucket)
+            self._grace_steps = max(self._grace_steps, 2)
+        if not self._stalled:
+            # a successful RPC is liveness evidence — except under the
+            # injected replica_stall wedge, whose whole point is
+            # SIMULATED silence (the in-process replica's submit path
+            # never beats either)
+            self.last_beat = self._clock()
+        return rid
+
+    def ping(self):
+        """Idempotent liveness probe — the ONE retried op (transient
+        timeout only; EOF/CRC mean a corpse, and retrying those would
+        just re-read it)."""
+        return call_with_retry(
+            lambda: self._rpc({"op": "ping"},
+                              timeout_s=OP_TIMEOUT_S["ping"]),
+            what=f"replica {self.replica_id} ping",
+            policy=RetryPolicy(attempts=3, base_s=0.05, cap_s=0.5),
+            retry_on=(FrameTimeout,), registry=self._reg, sink=self.sink)
+
+    def arm_fault(self, spec, seed=0):
+        """Install a seeded fault injector in THIS worker (the chaos
+        harness's targeted hang/corrupt arming)."""
+        return self._rpc({"op": "arm_fault", "spec": spec,
+                          "seed": int(seed)},
+                         timeout_s=OP_TIMEOUT_S["arm_fault"])
+
+    # -- stepping --
+
+    def step(self):
+        """One worker iteration over RPC. Same consult order as the
+        in-process Replica (replica_stall, then serve_step_fail), so
+        seeded fault schedules replay identically over both backends;
+        the process-only paths — EOF, timeout, CRC — map to the same
+        mark_dead the router already fails over from."""
+        if self.state == DEAD:
+            return []
+        inj = get_injector()
+        if not self._stalled and inj.should_fire("replica_stall"):
+            self._stalled = True
+        if self._stalled:
+            # parent-side wedge: no RPC, no beats — indistinguishable
+            # from idle until the stall threshold says otherwise
+            return []
+        t0 = self._clock()
+        had_work = self.busy
+        try:
+            inj.fail("serve_step_fail", f"replica {self.replica_id}")
+        except Exception as e:  # noqa: BLE001 — FaultInjected is OSError
+            self._die(e)
+            return []
+        # parent-clock deadline sweep over THIS worker's requests:
+        # queued-in-worker rids get the engine's dispatch-time tick
+        # lookahead (they could not emit a token in time anyway); live
+        # rids expire exactly at their deadline. The worker evicts what
+        # we name (Engine.evict) — its own clock never judges deadlines
+        expire = []
+        tick = self.engine._tick_s
+        for rid, dl in self._deadline.items():
+            if dl is None:
+                continue
+            horizon = t0 + (0.0 if rid in self.engine._live else tick)
+            if (horizon - self._submit_t.get(rid, t0)) * 1e3 >= dl:
+                expire.append(rid)
+        try:
+            reply = self._rpc({"op": "step", "expire": expire},
+                              timeout_s=self._step_timeout_s())
+        except FrameTimeout as e:
+            self._die(e, counter="rpc_timeouts")
+            return []
+        except FrameCRCError as e:
+            self._die(e, counter="frame_crc_errors")
+            return []
+        except (FrameError, WorkerOpError, OSError, ValueError) as e:
+            # FrameEOF / EPIPE: the worker was KILLED — the path a real
+            # SIGKILL takes; WorkerOpError: its engine raised
+            self._die(e)
+            return []
+        now = self._record_beat(t0, had_work)
+        if had_work:
+            self._n_busy_steps += 1
+            if self._grace_steps > 0:
+                self._grace_steps -= 1
+        for rid in reply.get("first", ()):
+            self._t_first[int(rid)] = now
+        return [self._harvest_finished(d, now)
+                for d in reply.get("finished", ())]
+
+    # -- harvest bookkeeping --
+
+    def _harvest_finished(self, d, now):
+        """Rebuild a FinishedRequest from its wire dict, restamp
+        TTFT/TPOT on the ROUTER's clock (worker clocks are unrelated,
+        and injected test clocks must stay authoritative), mirror the
+        latency histograms, and write the request record the in-process
+        engine would have written to the fleet sink."""
+        from avenir_tpu.serve.engine import FinishedRequest
+
+        f = FinishedRequest(**d)
+        rid = int(f.req_id)
+        st = self._submit_t.pop(rid, None)
+        self._deadline.pop(rid, None)
+        t_first = self._t_first.pop(rid, None)
+        if f.n_out >= 1 and t_first is None:
+            t_first = now  # finished the same step its first token landed
+        if f.n_out >= 1 and st is not None and t_first is not None:
+            f.ttft_ms = (t_first - st) * 1e3
+            self._reg.hist("ttft_ms").observe(f.ttft_ms)
+        else:
+            f.ttft_ms = None
+        # a finished request's LAST token always landed in its finishing
+        # step (stop/length by definition; deadline eviction keeps the
+        # final iteration's token) — `now` is its t_last
+        f.tpot_ms = ((now - t_first) / (f.n_out - 1) * 1e3
+                     if f.n_out > 1 and t_first is not None else 0.0)
+        if f.n_out > 1:
+            self._reg.hist("tpot_ms").observe(f.tpot_ms)
+        record = {
+            "kind": "request", "t": time.time(), "id": rid,
+            "n_prompt": f.n_prompt, "n_out": f.n_out,
+            "finish_reason": f.finish_reason,
+        }
+        if f.ttft_ms is not None:
+            record["ttft_ms"] = f.ttft_ms
+        if f.n_out > 1:
+            record["tpot_ms"] = f.tpot_ms
+        self.sink.write(record)
+        return f
+
+    def _apply_counter_deltas(self, totals):
+        """Mirror the worker registry's counter movement into the fleet
+        registry (the worker process has its own registry; deltas keep
+        one authoritative story parent-side without double counting)."""
+        for key, total in totals.items():
+            seen = self._counters_seen.get(key, 0.0)
+            if total > seen:
+                self._reg.counter(key).add(total - seen)
+            self._counters_seen[key] = total
+
+    # -- state transitions --
+
+    def revive(self):
+        """From `dead`: RESPAWN — a fresh worker process, handshaken,
+        rejoining EMPTY (the router already requeued everything the
+        corpse held, so re-prefill failover keeps completed outputs
+        bit-identical). From `draining`: just un-drain. Raises if the
+        spawn/handshake fails — the supervisor counts that as another
+        death and backs off."""
+        if self.state == DEAD:
+            self._teardown(kill=True)
+            self._counters_seen = {}
+            self._submit_t = {}
+            self._t_first = {}
+            self._deadline = {}
+            self._durs = []
+            self._n_busy_steps = 0
+            self._seen_buckets = set()  # a fresh process compiles anew
+            self._grace_steps = 2
+            self._stalled = False
+            self.last_error = None
+            self._spawn()
+            try:
+                self.finish_handshake()
+            except Exception:
+                self._teardown(kill=True)
+                raise
+        self.state = HEALTHY
+        self.last_beat = self._clock()
+
+
+def _parent_platform():
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:  # noqa: BLE001 — jax not imported yet: let the
+        return ""      # worker pick its own default
+
+
+class RespawnSupervisor:
+    """Respawns dead process replicas with capped exponential backoff.
+
+    The delay schedule is `utils/retry.RetryPolicy` — the same policy
+    object the checkpoint IO retries use, injectable for tests. Each
+    death schedules the next respawn attempt at `now +
+    policy.delay_s(consecutive_failures)`; a respawn that itself fails
+    (spawn error, handshake refusal) counts as another failure. Past
+    `max_respawns` consecutive failures the supervisor GIVES UP on that
+    replica — a crash-looping worker (a deterministic bug, a poisoned
+    chip) must not be respawned forever, and `Router.drain()` only
+    fails loud once no replica has attempts left. A replica that stays
+    healthy for `reset_after_s` earns its failure budget back."""
+
+    def __init__(self, *, policy=None, max_respawns=5, reset_after_s=60.0,
+                 clock=None, registry=None, echo=print):
+        self.policy = policy if policy is not None else RetryPolicy(
+            attempts=max_respawns + 1, base_s=0.25, cap_s=15.0,
+            jitter=0.25)
+        self.max_respawns = int(max_respawns)
+        self.reset_after_s = float(reset_after_s)
+        self._clock = clock if clock is not None else time.perf_counter
+        self._reg = registry if registry is not None else get_registry()
+        self._echo = echo
+        self._st = {}  # replica_id -> {failures, deaths_seen, next_t, up_t}
+
+    def _rec(self, rep):
+        return self._st.setdefault(rep.replica_id, {
+            "failures": 0, "deaths_seen": rep.deaths,
+            "next_t": 0.0, "up_t": None})
+
+    def exhausted(self, rep):
+        return self._rec(rep)["failures"] > self.max_respawns
+
+    def pending(self):
+        """Any dead replica with respawn budget left? (Router.drain's
+        wait-vs-fail-loud decision.)"""
+        return any(rep.state == DEAD and not self.exhausted(rep)
+                   for rep in self._reps)
+
+    def attach(self, replicas):
+        self._reps = list(replicas)
+        for rep in self._reps:
+            # snapshot deaths NOW: a death between attach and the first
+            # poll must read as new, not as the baseline
+            self._rec(rep)
+        return self
+
+    def poll(self, now):
+        """Schedule newly observed deaths, respawn what is due, refund
+        the budget of replicas that stayed up. Called once per router
+        step. Returns the replicas respawned this call."""
+        respawned = []
+        for rep in self._reps:
+            st = self._rec(rep)
+            if rep.state != DEAD:
+                if st["up_t"] is None:
+                    st["up_t"] = now
+                elif (st["failures"]
+                      and now - st["up_t"] >= self.reset_after_s):
+                    st["failures"] = 0
+                continue
+            st["up_t"] = None
+            if rep.deaths > st["deaths_seen"]:
+                # newly observed death(s): one backoff step each
+                st["deaths_seen"] = rep.deaths
+                st["failures"] += 1
+                if st["failures"] > self.max_respawns:
+                    self._echo(
+                        f"[supervisor] replica {rep.replica_id} exceeded "
+                        f"{self.max_respawns} consecutive respawns — "
+                        f"giving up (last error: {rep.last_error!r})")
+                    continue
+                st["next_t"] = now + self.policy.delay_s(st["failures"])
+            if self.exhausted(rep) or now < st["next_t"]:
+                continue
+            try:
+                rep.revive()
+            except Exception as e:  # noqa: BLE001 — spawn/handshake
+                st["failures"] += 1  # failure = another backoff step
+                if st["failures"] > self.max_respawns:
+                    self._echo(
+                        f"[supervisor] replica {rep.replica_id} respawn "
+                        f"failed terminally: {e!r}")
+                else:
+                    st["next_t"] = now + self.policy.delay_s(
+                        st["failures"])
+                    self._echo(
+                        f"[supervisor] replica {rep.replica_id} respawn "
+                        f"failed ({e!r}); retrying in "
+                        f"{st['next_t'] - now:.2f}s")
+                continue
+            self._reg.counter("replica_respawns").add(1)
+            respawned.append(rep)
+            self._echo(f"[supervisor] replica {rep.replica_id} respawned "
+                       f"(attempt {st['failures']}, pid {rep.pid})")
+        return respawned

@@ -52,19 +52,24 @@ DRAINING = "draining"
 DEAD = "dead"
 
 
-class Replica:
-    """One engine in the fleet, with the router-facing health surface."""
+class ReplicaGone(RuntimeError):
+    """Raised by a replica's dispatch surface when the backing worker
+    died mid-operation (process backend) — the router requeues the
+    request and moves on instead of crashing the fleet step."""
 
-    def __init__(self, model, replica_id, *, n_slots=4, max_seq_len=None,
-                 detokenize=None, registry=None, sink=None, seed=0,
-                 clock=None, stall_floor_secs=10.0, stall_factor=10.0):
+
+class ReplicaHealth:
+    """The router-facing health surface shared by the in-process
+    `Replica` and the process-isolated `serve/proc.ProcReplica`
+    (ISSUE 8): state machine, heartbeat bookkeeping, and the
+    obs/watchdog.py stall-threshold rule. Subclasses provide `busy`,
+    `step()` and the engine/dispatch surface; `_on_dead()` is the
+    death hook (ProcReplica SIGKILLs its worker corpse there)."""
+
+    def __init__(self, replica_id, *, clock, stall_floor_secs=10.0,
+                 stall_factor=10.0):
         self.replica_id = int(replica_id)
-        self.engine = Engine(
-            model, n_slots=n_slots, max_seq_len=max_seq_len,
-            detokenize=detokenize, registry=registry, sink=sink,
-            seed=seed, clock=clock,
-        )
-        self._clock = self.engine._clock
+        self._clock = clock
         self.state = HEALTHY
         self.stall_floor_secs = float(stall_floor_secs)
         self.stall_factor = float(stall_factor)
@@ -73,6 +78,77 @@ class Replica:
         self._stalled = False  # fault-injected wedge (no beats, no work)
         self.deaths = 0
         self.last_error = None  # the exception that killed us, if any
+
+    def median_step_secs(self):
+        return statistics.median_low(self._durs) if self._durs else 0.0
+
+    def _record_beat(self, t0, had_work):
+        """Stamp a heartbeat after a completed step; busy steps also
+        enter the duration stats (idle no-ops must not — a mostly-idle
+        replica's ~0 median would degrade the stall threshold to its
+        bare floor and make slow replicas look fast to the router's
+        deadline-slack placement penalty)."""
+        now = self._clock()
+        self.last_beat = now
+        if had_work:
+            self._durs.append(now - t0)
+            if len(self._durs) > 64:
+                del self._durs[:32]
+        return now
+
+    # -- health --
+
+    def stall_threshold_secs(self):
+        """obs/watchdog.py's threshold rule: max(floor, factor x median
+        completed-step time) — scale-free across model sizes."""
+        return max(self.stall_floor_secs,
+                   self.stall_factor * self.median_step_secs())
+
+    def check_health(self, now):
+        """Declare a silent stall: HOLDING WORK with no heartbeat within
+        the threshold. An idle replica is exempt — with nothing admitted
+        there is no progress to expect (and another replica's long
+        compile delaying the fleet loop must not read as this one's
+        death); a wedged-but-idle replica is caught the moment work
+        lands on it and fails to move. Returns the (updated) state."""
+        if (self.state != DEAD and self.busy
+                and now - self.last_beat > self.stall_threshold_secs()):
+            self.mark_dead()
+        return self.state
+
+    # -- state transitions --
+
+    def drain(self):
+        """Stop new admissions; in-flight work keeps stepping."""
+        if self.state == HEALTHY:
+            self.state = DRAINING
+
+    def mark_dead(self):
+        """Abrupt death (step failure, declared stall, or a chaos kill)."""
+        if self.state != DEAD:
+            self.state = DEAD
+            self.deaths += 1
+            self._on_dead()
+
+    def _on_dead(self):
+        """Death hook for subclasses (the in-process replica leaves its
+        engine state readable; a process replica reaps its corpse)."""
+
+
+class Replica(ReplicaHealth):
+    """One engine in the fleet, with the router-facing health surface."""
+
+    def __init__(self, model, replica_id, *, n_slots=4, max_seq_len=None,
+                 detokenize=None, registry=None, sink=None, seed=0,
+                 clock=None, stall_floor_secs=10.0, stall_factor=10.0):
+        self.engine = Engine(
+            model, n_slots=n_slots, max_seq_len=max_seq_len,
+            detokenize=detokenize, registry=registry, sink=sink,
+            seed=seed, clock=clock,
+        )
+        super().__init__(replica_id, clock=self.engine._clock,
+                         stall_floor_secs=stall_floor_secs,
+                         stall_factor=stall_factor)
 
     # -- capacity surface the router routes on --
 
@@ -101,9 +177,6 @@ class Replica:
         """Holds admitted-but-unfinished work (any state)."""
         eng = self.engine
         return bool(eng._live or eng.sched.queue_depth or eng._pending)
-
-    def median_step_secs(self):
-        return statistics.median_low(self._durs) if self._durs else 0.0
 
     # -- stepping --
 
@@ -135,53 +208,10 @@ class Replica:
             self.last_error = e
             self.mark_dead()
             return []
-        now = self._clock()
-        self.last_beat = now
-        if had_work:
-            # idle no-op steps still heartbeat but must not enter the
-            # duration stats: a mostly-idle replica's median would
-            # collapse to ~0, degrading the stall threshold to its bare
-            # floor and making a slow replica look fast to the router's
-            # deadline-slack placement penalty
-            self._durs.append(now - t0)
-            if len(self._durs) > 64:
-                del self._durs[:32]
+        self._record_beat(t0, had_work)
         return finished
 
-    # -- health --
-
-    def stall_threshold_secs(self):
-        """obs/watchdog.py's threshold rule: max(floor, factor x median
-        completed-step time) — scale-free across model sizes."""
-        return max(self.stall_floor_secs,
-                   self.stall_factor * self.median_step_secs())
-
-    def check_health(self, now):
-        """Declare a silent stall: HOLDING WORK with no heartbeat within
-        the threshold. An idle replica is exempt — with nothing admitted
-        there is no progress to expect (and another replica's long
-        compile delaying the fleet loop must not read as this one's
-        death); a wedged-but-idle replica is caught the moment work
-        lands on it and fails to move. Returns the (updated) state."""
-        if (self.state != DEAD and self.busy
-                and now - self.last_beat > self.stall_threshold_secs()):
-            self.mark_dead()
-        return self.state
-
     # -- state transitions --
-
-    def drain(self):
-        """Stop new admissions; in-flight work keeps stepping."""
-        if self.state == HEALTHY:
-            self.state = DRAINING
-
-    def mark_dead(self):
-        """Abrupt death (step failure, declared stall, or a chaos kill).
-        Engine host state is left in place so the router can still read
-        it; `revive()` resets it."""
-        if self.state != DEAD:
-            self.state = DEAD
-            self.deaths += 1
 
     def revive(self):
         """From `dead`: a restarted replica rejoins empty — fresh

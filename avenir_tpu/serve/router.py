@@ -33,8 +33,13 @@ Orca-style iteration-level scheduling (serve/scheduler.py) stays the
 per-replica substrate; vLLM's continuous-batching serving stack is the
 reference for the fleet shape (PAPERS.md). Synchronous and network-free
 like the engine: `step()` is one fleet iteration (health check ->
-expire -> dispatch -> step replicas -> harvest), `drain()` runs it to
-empty. A transport in front of this owns no scheduling logic.
+respawn -> expire -> dispatch -> step replicas -> harvest), `drain()`
+runs it to empty. A transport in front of this owns no scheduling
+logic — which is what lets `backend='process'` (ISSUE 8) swap each
+replica for its own OS process (serve/proc.py over the serve/frames.py
+pipe protocol) without changing ONE failover/admission/fair-share
+decision: the same tests pass over both backends, and a real SIGKILL
+is now a routable event instead of a fleet crash.
 """
 
 import dataclasses
@@ -47,9 +52,16 @@ import jax
 
 from avenir_tpu.obs import NullSink, get_registry
 from avenir_tpu.serve.engine import FinishedRequest
-from avenir_tpu.serve.replica import DEAD, DRAINING, HEALTHY, Replica
+from avenir_tpu.serve.replica import (
+    DEAD,
+    DRAINING,
+    HEALTHY,
+    Replica,
+    ReplicaGone,
+)
 
 PRIORITIES = ("interactive", "batch")
+BACKENDS = ("inproc", "process")
 
 
 @dataclasses.dataclass
@@ -90,23 +102,75 @@ class Router:
     def __init__(self, model, *, n_replicas=2, n_slots=4, max_seq_len=None,
                  detokenize=None, registry=None, sink=None, seed=0,
                  clock=None, weights=None, queue_limits=None,
-                 stall_floor_secs=10.0, stall_factor=10.0):
+                 stall_floor_secs=10.0, stall_factor=10.0,
+                 backend="inproc", model_spec=None, supervise=False,
+                 respawn_policy=None, max_respawns=5, proc_kwargs=None):
         """`weights`: dispatch shares per priority class (default
         interactive 4 : batch 1). `queue_limits`: max queued per class
         before shedding (default 16/64 x fleet slots). `clock` is shared
-        with every replica engine (injectable for tests)."""
+        with every replica engine (injectable for tests).
+
+        `backend` (ISSUE 8): 'inproc' keeps replicas as engine wrappers
+        in this process; 'process' puts each replica in its OWN OS
+        process (serve/proc.py + serve/worker.py) so a real SIGKILL
+        kills one replica, not the fleet. The router's failover,
+        admission and fair-share semantics are IDENTICAL over both —
+        only the replica class changes. For 'process', `model_spec`
+        overrides the default spec derived from `model` (pass a
+        {"kind": "checkpoint", "out_dir": ...} spec to keep big weights
+        off the handshake pipe); `supervise=True` auto-respawns dead
+        workers with capped exponential backoff (`respawn_policy`, a
+        utils/retry.RetryPolicy) up to `max_respawns` consecutive
+        failures per replica; `proc_kwargs` forwards extra ProcReplica
+        knobs (rpc_slack_secs, compile_grace_secs, env)."""
         assert n_replicas >= 1
+        assert backend in BACKENDS, f"unknown backend {backend!r}"
         self._clock = clock if clock is not None else time.perf_counter
         self._reg = registry if registry is not None else get_registry()
         self.sink = sink if sink is not None else NullSink()
-        self.replicas = [
-            Replica(model, i, n_slots=n_slots, max_seq_len=max_seq_len,
-                    detokenize=detokenize, registry=self._reg,
-                    sink=self.sink, seed=seed, clock=self._clock,
-                    stall_floor_secs=stall_floor_secs,
-                    stall_factor=stall_factor)
-            for i in range(n_replicas)
-        ]
+        self.backend = backend
+        self._supervisor = None
+        if backend == "process":
+            from avenir_tpu.serve.proc import (
+                ProcReplica,
+                RespawnSupervisor,
+                model_spec_from_model,
+            )
+
+            spec = model_spec if model_spec is not None \
+                else model_spec_from_model(model)
+            self.replicas = [
+                ProcReplica(spec, i, n_slots=n_slots,
+                            max_seq_len=max_seq_len,
+                            detokenize=detokenize, registry=self._reg,
+                            sink=self.sink, seed=seed, clock=self._clock,
+                            stall_floor_secs=stall_floor_secs,
+                            stall_factor=stall_factor,
+                            defer_handshake=True,
+                            **(proc_kwargs or {}))
+                for i in range(n_replicas)
+            ]
+            for r in self.replicas:  # workers warmed up concurrently
+                r.finish_handshake()
+            if supervise:
+                self._supervisor = RespawnSupervisor(
+                    policy=respawn_policy, max_respawns=max_respawns,
+                    clock=self._clock, registry=self._reg,
+                ).attach(self.replicas)
+        else:
+            assert not supervise, (
+                "supervised respawn is the process backend's restart "
+                "story; in-process replicas are revived explicitly "
+                "(revive_replica)")
+            self.replicas = [
+                Replica(model, i, n_slots=n_slots,
+                        max_seq_len=max_seq_len,
+                        detokenize=detokenize, registry=self._reg,
+                        sink=self.sink, seed=seed, clock=self._clock,
+                        stall_floor_secs=stall_floor_secs,
+                        stall_factor=stall_factor)
+                for i in range(n_replicas)
+            ]
         self.T_max = self.replicas[0].engine.T_max
         self.detokenize = detokenize
         self.weights = dict(weights or {"interactive": 4.0, "batch": 1.0})
@@ -185,12 +249,48 @@ class Router:
         for rep in self.replicas:
             if rep.state != DEAD and rep.check_health(now) == DEAD:
                 self._failover(rep)
+        if self._supervisor is not None:
+            # respawn BEFORE dispatch so a freshly revived worker can
+            # take work this very step (it rejoins empty; its former
+            # assignments were requeued at death) — and credit every
+            # live replica the blocking time: a respawn's spawn +
+            # handshake takes seconds, during which no peer can beat,
+            # and a small stall floor would otherwise false-kill
+            # healthy replicas right after every supervised restart
+            t_sup = self._clock()
+            self._supervisor.poll(now)
+            dt_sup = self._clock() - t_sup
+            if dt_sup > 0:
+                for rep in self.replicas:
+                    if rep.state != DEAD:
+                        rep.last_beat += dt_sup
         self._expire_queued(now, finished)
         self._dispatch_all(now)
         for rep in self.replicas:
             was_dead = rep.state == DEAD
+            t_before = self._clock()
+            # median BEFORE the step: a fresh worker's first (compiling)
+            # step otherwise becomes its own median, zeroing the slack
+            # exactly when the credit matters most
+            med_before = rep.median_step_secs()
             for f in rep.step():
                 finished.append(self._harvest(rep, f))
+            dt = self._clock() - t_before
+            # credit every OTHER live replica the ANOMALOUS part of the
+            # time this step consumed: the fleet loop is single-threaded,
+            # so while one replica compiles (or a process worker's RPC
+            # runs out its hang-detection timeout) no peer gets a chance
+            # to beat — reading the router's own blocking as peer silence
+            # false-kills healthy replicas (the process chaos drill
+            # caught exactly this). Only the excess over the stepping
+            # replica's own median is credited: crediting ordinary step
+            # time too would let a genuinely stalled peer age only at
+            # loop-overhead speed, making detection latency unbounded
+            slack = dt - max(med_before, 1e-3)
+            if slack > 0:
+                for other in self.replicas:
+                    if other is not rep and other.state != DEAD:
+                        other.last_beat += slack
             if rep.state == DEAD and not was_dead:
                 # died inside this step (serve_step_fail): nothing it
                 # held finished — requeue all of it right away
@@ -208,25 +308,50 @@ class Router:
         total = sum(r.n_slots for r in self.replicas)
         self._reg.gauge("slot_occupancy").set(
             sum(len(r.engine._live) for r in self.replicas) / total)
+        alive = [r for r in self.replicas if r.state != DEAD]
+        if alive:
+            # oldest heartbeat across the live fleet: a rising value is
+            # a stall FORMING — visible before the threshold declares it
+            self._reg.gauge("heartbeat_age_s").set(
+                max(self._clock() - r.last_beat for r in alive))
         return finished
 
     def drain(self, max_steps=None):
         """Step until every accepted request reached a terminal state.
         Raises if no non-dead replica remains while work is still open
-        (a fleet with nothing to run it on cannot drain — revive one)."""
+        (a fleet with nothing to run it on cannot drain — revive one).
+        Under a supervisor (process backend), an all-dead fleet with
+        respawn budget left WAITS OUT the backoff window instead — the
+        work is queued, a worker is coming back, and failing loud here
+        would turn one survivable crash into a dropped drain; only a
+        supervisor that has exhausted its retries makes all-dead final
+        (ISSUE 8 satellite)."""
         bound = max_steps or (
             20 + len(self._pending) + 2 * len(self._open)
             + 4 * sum(r.max_new_tokens for r in self._open.values()))
         out = []
         steps = 0
+        waits = 0
         while self._pending or self._open:
             if (self._open and not self._pending
                     and all(r.state == DEAD for r in self.replicas)):
+                if (self._supervisor is not None
+                        and self._supervisor.pending()
+                        and waits < 20_000):
+                    # bounded wait: the supervisor's next attempt is on
+                    # a real-time backoff clock — don't burn the step
+                    # bound spinning, and don't spin hot either
+                    waits += 1
+                    time.sleep(0.01)
+                    out.extend(self.step())
+                    continue
                 causes = "; ".join(
                     f"replica {r.replica_id}: {r.last_error!r}"
                     for r in self.replicas if r.last_error is not None)
                 raise RuntimeError(
                     "all replicas dead with open requests — revive one"
+                    + (" (supervisor exhausted its respawn budget)"
+                       if self._supervisor is not None else "")
                     + (f" (causes of death: {causes})" if causes else ""))
             out.extend(self.step())
             steps += 1
@@ -234,6 +359,12 @@ class Router:
                 raise RuntimeError(
                     f"router failed to drain within {bound} iterations")
         return out
+
+    def close(self):
+        """Shut down process-backend workers (no-op for inproc)."""
+        for r in self.replicas:
+            if hasattr(r, "close"):
+                r.close()
 
     # -- fleet controls (chaos harness / operator surface) --
 
@@ -382,12 +513,21 @@ class Router:
                 return
             req = self._queues[c].popleft()
             rep = self._pick_replica(req, now)
-            eng_rid = rep.engine.submit(
-                req.prompt, max_new_tokens=req.max_new_tokens,
-                temperature=req.temperature, top_k=req.top_k,
-                stop_tokens=req.stop_tokens, rng=req.rng,
-                deadline_ms=req.deadline_ms, submit_t=req.submit_t,
-            )
+            try:
+                eng_rid = rep.engine.submit(
+                    req.prompt, max_new_tokens=req.max_new_tokens,
+                    temperature=req.temperature, top_k=req.top_k,
+                    stop_tokens=req.stop_tokens, rng=req.rng,
+                    deadline_ms=req.deadline_ms, submit_t=req.submit_t,
+                )
+            except ReplicaGone:
+                # the worker died UNDER the dispatch (process backend):
+                # the request never landed — front of its class queue,
+                # next loop pass picks a different (live) replica; the
+                # corpse's earlier (older) in-flight work requeues ahead
+                self._queues[req.priority].appendleft(req)
+                self._failover(rep)
+                continue
             req.dispatch_t = self._clock()
             self._where[req.rid] = rep.replica_id
             self._by_replica[rep.replica_id][eng_rid] = req.rid

@@ -96,6 +96,17 @@ class FCFSScheduler:
                                 if r.req_id not in dead)
         return expired
 
+    def remove(self, req_ids):
+        """Pop (and return) the queued requests with these ids — the
+        queue-side half of host-driven eviction (Engine.evict). The
+        queue representation stays this class's business."""
+        req_ids = set(req_ids)
+        removed = [r for r in self._queue if r.req_id in req_ids]
+        if removed:
+            self._queue = deque(r for r in self._queue
+                                if r.req_id not in req_ids)
+        return removed
+
     def take_admissions(self):
         """Pop (request, slot) pairs while both a queued request and a
         free slot exist. FCFS: no reordering, no lookahead — a too-long

@@ -1,0 +1,260 @@
+"""Serve worker process: one Engine behind a frame-RPC loop (ISSUE 8
+tentpole, part 2).
+
+    python -m avenir_tpu.serve.worker
+
+docs/SERVING.md promised "one process per chip in a deployment"; this
+is that process. It owns exactly one `serve.Engine` and speaks the
+`serve/frames.py` protocol over its stdin/stdout pipes — it makes NO
+scheduling, failover or admission decisions (those stay in the parent's
+Router, which is why the router's semantics are identical over both
+backends). The parent is `serve/proc.ProcReplica`.
+
+Protocol (one request frame in, one reply frame out, strictly serial —
+the parent's per-op timeout is the liveness check, so a worker that
+cannot reply IS a dead worker):
+
+    hello      (pickle) proto version + model spec + engine kwargs.
+               The model arrives as (family, config dataclass, numpy
+               state) and is rebuilt with `nnx.update`, so worker
+               weights are BIT-identical to the parent's — the fleet's
+               failover parity contract depends on it. A `checkpoint`
+               spec loads ckpt.pt from disk instead (big models should
+               not ride a pipe). Replies {ok, proto, t_max, pid}.
+    submit     enqueue one request; rng rides as raw uint32 key data.
+               `age_ms` backdates submit_t onto THIS process's clock
+               (pipes do not share a clock with the parent).
+    step       one engine iteration; replies finished records, the
+               engine heartbeat (`Engine.stats()`), which requests got
+               their FIRST token this step (the parent stamps latency
+               on its own clock), and the worker's counter totals (the
+               parent mirrors deltas into the fleet registry).
+    ping       liveness probe (the only idempotent op — the only one
+               the parent ever retries).
+    arm_fault  install a seeded FaultInjector spec in THIS worker
+               (chaos harness targeting; env AVENIR_FAULTS also works
+               but applies to every worker spawned with it).
+    shutdown   reply, then exit 0.
+
+Fault sites consulted here (the chaos drill's production paths):
+
+    worker_kill   SIGKILL this process mid-step — the real thing, not
+                  an injected exception; the parent sees pipe EOF
+    worker_hang   stop replying forever (a wedged collective); only
+                  the parent's RPC timeout can tell
+    frame_corrupt flip a byte of an outgoing payload after its CRC is
+                  computed (serve/frames.py writer) — trips the
+                  parent's CRC check
+
+Every human-readable byte goes to stderr: fd 1 is dup'd for frames and
+then redirected to stderr, so a stray print() (jax warnings, model
+chatter) can never desync the frame stream.
+"""
+
+import os
+import signal
+import sys
+import time
+
+from avenir_tpu.serve.frames import PROTO_VERSION, FrameEOF, FrameStream
+
+
+def _build_model(spec):
+    """Model from a handshake spec. Imports live here, after the frame
+    fds are secured, so import-time chatter lands on stderr."""
+    import jax
+    from flax import nnx
+
+    kind = spec.get("kind")
+    if kind == "state":
+        family = spec["family"]
+        if family == "gpt":
+            from avenir_tpu.models.gpt import GPT as cls
+        elif family == "llama":
+            from avenir_tpu.models.llama import Llama as cls
+        elif family == "mixtral":
+            from avenir_tpu.models.mixtral import Mixtral as cls
+        else:
+            raise ValueError(f"unknown model family {family!r}")
+        model = cls(spec["config"], rngs=nnx.Rngs(0))
+        # the parent's weights, bit-for-bit — init seed is irrelevant
+        nnx.update(model, jax.tree.map(jax.numpy.asarray, spec["state"]))
+        return model
+    if kind == "checkpoint":
+        from avenir_tpu.checkpoint.io import load_checkpoint
+        from avenir_tpu.sampling import model_from_checkpoint
+
+        model, _family = model_from_checkpoint(
+            load_checkpoint(spec["out_dir"]))
+        return model
+    raise ValueError(f"unknown model spec kind {kind!r}")
+
+
+def _serve(stream):
+    """Handshake, then the op loop. Returns the exit code."""
+    from avenir_tpu.utils.faults import FaultInjector, get_injector, \
+        set_injector
+
+    hello = stream.read(timeout_s=600.0)
+    hseq = hello.get("seq")
+    if hello.get("op") != "hello":
+        stream.write({"ok": False, "seq": hseq,
+                      "error": f"expected hello, got {hello.get('op')!r}"})
+        return 2
+    if hello.get("proto") != PROTO_VERSION:
+        # the frame layer already rejects a mismatched frame VERSION;
+        # this op-level echo catches a peer whose frames parse but whose
+        # message vocabulary moved — same policy: refuse loudly
+        stream.write({
+            "ok": False, "seq": hseq,
+            "error": (f"hello proto {hello.get('proto')} != worker proto "
+                      f"{PROTO_VERSION} — upgrade both sides together"),
+        })
+        return 2
+
+    import jax  # noqa: F401  (engine import below needs the runtime up)
+
+    from avenir_tpu.obs import get_registry
+    from avenir_tpu.serve.engine import Engine
+
+    ekw = dict(hello.get("engine") or {})
+    reg = get_registry()
+    engine = Engine(
+        _build_model(hello["model"]),
+        n_slots=int(ekw.get("n_slots", 4)),
+        max_seq_len=ekw.get("max_seq_len"),
+        detokenize=ekw.get("detokenize"),
+        seed=int(ekw.get("seed", 0)),
+        registry=reg,
+    )
+    stream.write({"ok": True, "seq": hseq, "proto": PROTO_VERSION,
+                  "t_max": engine.T_max, "n_slots": engine.n_slots,
+                  "pid": os.getpid()})
+
+    def hb():
+        return engine.stats()
+
+    while True:
+        req = stream.read(timeout_s=None)  # the parent paces the loop
+        op = req.get("op")
+        seq = req.get("seq")
+
+        def send(obj):
+            # every reply echoes its request's seq, so a parent that
+            # retried a timed-out op (ping) can discard the late reply
+            # to the first attempt instead of desyncing request/reply
+            # alignment for every RPC after it
+            obj["seq"] = seq
+            stream.write(obj)
+
+        try:
+            if op == "step":
+                inj = get_injector()
+                if inj.should_fire("worker_kill"):
+                    # the REAL failure: no goodbye frame, no flush — the
+                    # parent learns from pipe EOF, exactly like an OOM
+                    # kill or a preempted node
+                    os.kill(os.getpid(), signal.SIGKILL)
+                if inj.should_fire("worker_hang"):
+                    while True:  # a wedge, not an exit: the process
+                        time.sleep(3600)  # lives on, silently useless
+                pre = {int(lv.req.req_id): len(lv.emitted)
+                       for lv in engine._live.values()}
+                # parent-named expiry FIRST: deadline clocks live in
+                # the parent (Engine.evict docstring), and an evicted
+                # slot is free for this very step's admissions
+                finished = engine.evict(req.get("expire") or ())
+                finished += engine.step()
+                post = {int(lv.req.req_id): len(lv.emitted)
+                        for lv in engine._live.values()}
+                first = [rid for rid, n in post.items()
+                         if n >= 1 and pre.get(rid, 0) == 0]
+                first += [int(f.req_id) for f in finished
+                          if f.n_out >= 1 and pre.get(int(f.req_id), 0) == 0]
+                send({
+                    "ok": True,
+                    "finished": [_fin_dict(f) for f in finished],
+                    "first": first,
+                    "hb": hb(),
+                    "counters": reg.counters(),
+                })
+            elif op == "submit":
+                rng = None
+                if req.get("rng") is not None:
+                    rng = jax.random.wrap_key_data(
+                        jax.numpy.asarray(req["rng"], jax.numpy.uint32))
+                submit_t = None
+                if req.get("age_ms") is not None:
+                    submit_t = engine._clock() - float(req["age_ms"]) / 1e3
+                rid = engine.submit(
+                    req["prompt"],
+                    max_new_tokens=int(req["max_new_tokens"]),
+                    temperature=float(req.get("temperature", 1.0)),
+                    top_k=req.get("top_k"),
+                    stop_tokens=tuple(req.get("stop_tokens") or ()),
+                    rng=rng,
+                    deadline_ms=req.get("deadline_ms"),
+                    submit_t=submit_t,
+                )
+                send({"ok": True, "rid": int(rid), "hb": hb(),
+                      "counters": reg.counters()})
+            elif op == "ping":
+                send({"ok": True, "hb": hb(), "pid": os.getpid()})
+            elif op == "arm_fault":
+                # CONSTRUCT (validate) first — a bad spec must become an
+                # error reply, not raise after an ok was already written
+                # (one reply per request, always); INSTALL after the
+                # reply goes out, so an armed frame_corrupt hits a real
+                # production frame (the next step reply), not the ack of
+                # its own arming
+                inj_new = FaultInjector(req.get("spec", ""),
+                                        seed=int(req.get("seed", 0)))
+                send({"ok": True})
+                set_injector(inj_new)
+            elif op == "shutdown":
+                send({"ok": True})
+                return 0
+            else:
+                send({"ok": False, "error": f"unknown op {op!r}"})
+        except Exception as e:  # noqa: BLE001 — a step failure is a
+            # ROUTABLE event: report it and let the parent decide (it
+            # marks this replica dead and fails the work over); only
+            # protocol-level breakage should kill the loop itself
+            send({"ok": False, "error": f"{type(e).__name__}: {e}"})
+
+
+def _fin_dict(f):
+    import dataclasses
+
+    return dataclasses.asdict(f)
+
+
+def main():
+    # frames own fd 1; anything that prints (jax, warnings, the model)
+    # is redirected to stderr so it cannot desync the stream. When
+    # spawned by serve/proc.py the BOOTSTRAP did this before ANY
+    # package import (import-time stdout chatter would otherwise land
+    # on the frame pipe) and left the frame fd in the env; a manual
+    # `python -m avenir_tpu.serve.worker` falls back to doing it here.
+    fd_env = os.environ.get("AVENIR_WORKER_FRAME_FD")
+    if fd_env is not None:
+        frame_out = int(fd_env)
+    else:
+        frame_out = os.dup(1)
+        os.dup2(2, 1)
+        sys.stdout = sys.stderr
+    from avenir_tpu.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+    stream = FrameStream(0, frame_out)
+    try:
+        sys.exit(_serve(stream))
+    except (FrameEOF, BrokenPipeError):
+        # the parent closed the pipes (teardown of a replica it already
+        # declared dead, or the parent itself died) — nothing left to
+        # serve and nobody to tell: exit quietly, not with a traceback
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

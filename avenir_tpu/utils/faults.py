@@ -31,6 +31,17 @@ Sites consulted by the production IO paths:
     replica_stall        wedge a serve replica: it keeps "running" but
                          stops working AND stops heartbeating, until
                          the router's stall detector declares it dead
+    worker_kill          SIGKILL a serve WORKER PROCESS mid-step
+                         (serve/worker.py) — a real kill, not an
+                         injected exception: the parent ProcReplica
+                         sees pipe EOF and fails the work over
+    worker_hang          wedge a serve worker process: it stops
+                         replying forever; only the parent's per-op
+                         RPC timeout can tell (serve/proc.py)
+    frame_corrupt        flip one byte of an outgoing frame payload
+                         AFTER its CRC is computed (serve/frames.py
+                         writer) — trips the reader's CRC check, which
+                         is treated as replica death, never retried
 
 The default injector (no env var) is inert: `enabled()` is a dict
 lookup returning False, so the hot paths pay nothing. Inject faults in

@@ -1,0 +1,463 @@
+"""Process-isolated serve fleet tests (ISSUE 8): the frame protocol
+fails loud on every corruption mode (fast, tier-1), the supervisor's
+backoff schedule is exact (fast), and the full router semantics — the
+parity/failover/fair-share suite of tests/test_serve_router.py —
+survive REAL worker processes, real SIGKILLs, silent hangs and pipe
+corruption (slow: every case spawns worker processes that pay a jax
+import and their own compiles).
+
+Budget notes: the slow cases share one module-scoped model + reference
+set (same construction as test_serve_router's, so a worker rebuilt
+from the shipped state is bit-identical); every prompt stays in one
+power-of-2 bucket so each worker pays one prefill + one decode
+compile.
+"""
+
+import os
+import signal
+import struct
+import time
+
+import pytest
+
+from avenir_tpu.serve.frames import (
+    HEADER_SIZE,
+    MAGIC,
+    PROTO_VERSION,
+    PT_JSON,
+    PT_PICKLE,
+    FrameCRCError,
+    FrameEOF,
+    FrameProtocolError,
+    FrameStream,
+    FrameTimeout,
+    encode_frame,
+)
+from avenir_tpu.utils.faults import FaultInjector, set_injector
+from avenir_tpu.utils.retry import RetryPolicy
+
+
+def _pipe_pair():
+    """Two FrameStreams talking to each other over two os.pipe()s."""
+    r1, w1 = os.pipe()
+    r2, w2 = os.pipe()
+    return FrameStream(r1, w2), FrameStream(r2, w1), (r1, w1, r2, w2)
+
+
+def _close_all(fds):
+    for fd in fds:
+        try:
+            os.close(fd)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------
+# frame protocol (fast, tier-1: stdlib only, no processes)
+# ---------------------------------------------------------------------
+
+
+def test_frame_roundtrip_json_and_pickle():
+    a, b, fds = _pipe_pair()
+    try:
+        msg = {"op": "step", "n": 3, "nested": {"x": [1, 2, 3]},
+               "text": "héllo"}
+        a.write(msg, ptype=PT_JSON)
+        assert b.read(timeout_s=5.0) == msg
+        import numpy as np
+
+        obj = {"op": "hello", "arr": np.arange(7, dtype=np.uint32),
+               "cfg": ("tuple", 1)}
+        b.write(obj, ptype=PT_PICKLE)
+        got = a.read(timeout_s=5.0)
+        assert got["op"] == "hello" and got["cfg"] == ("tuple", 1)
+        assert (got["arr"] == obj["arr"]).all()
+        # several frames back to back stay framed (no desync)
+        for i in range(5):
+            a.write({"i": i})
+        assert [b.read(timeout_s=5.0)["i"] for _ in range(5)] \
+            == list(range(5))
+    finally:
+        _close_all(fds)
+
+
+def test_frame_crc_trip_fails_loud_and_is_distinct():
+    """An armed frame_corrupt flips a payload byte AFTER the CRC is
+    computed — the reader must raise FrameCRCError, not garbage-parse
+    (and not any other FrameError: the fleet treats CRC as corruption,
+    which is never retried)."""
+    a, b, fds = _pipe_pair()
+    prev = set_injector(FaultInjector("frame_corrupt:n=1", seed=0))
+    try:
+        a.write({"op": "step", "payload": "x" * 200})
+        with pytest.raises(FrameCRCError):
+            b.read(timeout_s=5.0)
+        # the injector is one-shot: the stream pair itself still works
+        a.write({"ok": True})
+        assert b.read(timeout_s=5.0) == {"ok": True}
+    finally:
+        set_injector(prev)
+        _close_all(fds)
+
+
+def test_frame_version_mismatch_fails_loud():
+    """A peer speaking a different frame version is refused at the
+    HEADER — no payload is interpreted, no guess is made."""
+    a, b, fds = _pipe_pair()
+    try:
+        frame = bytearray(encode_frame({"op": "hello"}))
+        assert frame[:4] == MAGIC
+        frame[4] = PROTO_VERSION + 1  # the version byte
+        os.write(fds[3], bytes(frame))
+        with pytest.raises(FrameProtocolError, match="version mismatch"):
+            b.read(timeout_s=5.0)
+    finally:
+        _close_all(fds)
+
+
+def test_frame_bad_magic_and_oversize_fail_loud():
+    a, b, fds = _pipe_pair()
+    try:
+        os.write(fds[3], b"NOPE" + b"\x00" * (HEADER_SIZE - 4))
+        with pytest.raises(FrameProtocolError, match="magic"):
+            b.read(timeout_s=5.0)
+    finally:
+        _close_all(fds)
+    a, b, fds = _pipe_pair()
+    try:
+        hdr = struct.pack(">4sBBII", MAGIC, PROTO_VERSION, PT_JSON,
+                          (1 << 30) + 1, 0)
+        os.write(fds[3], hdr)
+        with pytest.raises(FrameProtocolError, match="MAX_FRAME_BYTES"):
+            b.read(timeout_s=5.0)
+    finally:
+        _close_all(fds)
+
+
+def test_frame_timeout_and_eof():
+    a, b, fds = _pipe_pair()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(FrameTimeout):
+            b.read(timeout_s=0.05)
+        assert time.monotonic() - t0 < 2.0
+        # half a frame then EOF: the kill-mid-write case
+        full = encode_frame({"op": "step"})
+        os.write(fds[3], full[: len(full) // 2])
+        os.close(fds[3])
+        with pytest.raises(FrameEOF):
+            b.read(timeout_s=5.0)
+    finally:
+        _close_all(fds)
+
+
+# ---------------------------------------------------------------------
+# respawn supervisor schedule (fast: fake replicas, fake clock)
+# ---------------------------------------------------------------------
+
+
+class _FakeRep:
+    def __init__(self):
+        self.replica_id = 0
+        self.state = "healthy"
+        self.deaths = 0
+        self.last_error = None
+        self.pid = 123
+        self.revives = 0
+        self.fail_next_revive = False
+
+    def die(self):
+        self.state = "dead"
+        self.deaths += 1
+
+    def revive(self):
+        if self.fail_next_revive:
+            raise RuntimeError("spawn failed")
+        self.state = "healthy"
+        self.revives += 1
+
+
+def test_supervisor_backoff_schedule_and_exhaustion():
+    from avenir_tpu.obs import MetricsRegistry
+    from avenir_tpu.serve.proc import RespawnSupervisor
+
+    reg = MetricsRegistry()
+    rep = _FakeRep()
+    sup = RespawnSupervisor(
+        policy=RetryPolicy(attempts=9, base_s=1.0, cap_s=4.0, jitter=0.0),
+        max_respawns=2, clock=lambda: 0.0, registry=reg,
+        echo=lambda *a: None).attach([rep])
+
+    rep.die()
+    sup.poll(0.0)            # death observed: next attempt at +1.0
+    assert rep.state == "dead" and sup.pending()
+    sup.poll(0.5)            # inside the backoff window: nothing
+    assert rep.revives == 0
+    sup.poll(1.0)            # due: respawn #1
+    assert rep.revives == 1 and rep.state == "healthy"
+
+    rep.die()
+    sup.poll(1.1)            # second consecutive death: delay doubles
+    sup.poll(2.9)
+    assert rep.revives == 1  # 1.1 + 2.0 = 3.1 not reached yet
+    sup.poll(3.2)
+    assert rep.revives == 2
+
+    rep.die()                # third consecutive death: budget (2) blown
+    sup.poll(3.3)
+    assert not sup.pending() and sup.exhausted(rep)
+    sup.poll(99.0)           # given up: never respawned again
+    assert rep.revives == 2
+    assert reg.snapshot()["counters"]["replica_respawns"] == 2.0
+
+
+def test_supervisor_failed_respawn_counts_and_budget_resets():
+    from avenir_tpu.obs import MetricsRegistry
+    from avenir_tpu.serve.proc import RespawnSupervisor
+
+    reg = MetricsRegistry()
+    rep = _FakeRep()
+    sup = RespawnSupervisor(
+        policy=RetryPolicy(attempts=9, base_s=1.0, cap_s=4.0, jitter=0.0),
+        max_respawns=3, reset_after_s=10.0, clock=lambda: 0.0,
+        registry=reg, echo=lambda *a: None).attach([rep])
+    rep.die()
+    sup.poll(0.0)
+    rep.fail_next_revive = True
+    sup.poll(1.0)            # attempt raises -> another backoff step
+    assert rep.revives == 0 and sup.pending()
+    rep.fail_next_revive = False
+    sup.poll(1.5)            # 1.0 + delay(2)=2.0 -> due at 3.0
+    assert rep.revives == 0
+    sup.poll(3.0)
+    assert rep.revives == 1
+    # healthy long enough: the failure budget is refunded
+    sup.poll(4.0)
+    sup.poll(14.1)
+    rep.die()
+    sup.poll(14.2)           # first failure again -> base delay (1.0)
+    sup.poll(15.2)
+    assert rep.revives == 2
+
+
+# ---------------------------------------------------------------------
+# process-backend fleet (slow: real workers, real kills)
+# ---------------------------------------------------------------------
+
+import tests.test_serve_router as trs  # noqa: E402  (helpers + cases)
+
+
+@pytest.fixture(scope="module")
+def pfix():
+    import numpy as np
+    from flax import nnx
+
+    from avenir_tpu.models.gpt import GPT
+
+    model = GPT(trs.GPT_TINY, rngs=nnx.Rngs(0))
+    return model, trs._mk_requests(model, np.random.default_rng(3), 6)
+
+
+@pytest.fixture()
+def _close_routers():
+    """Reap every process-backend router a test creates — leaked worker
+    processes would outlive the suite."""
+    created = []
+    yield created
+    for router in created:
+        try:
+            router.close()
+        except Exception:
+            pass
+
+
+def _mk_router(created, model, **kw):
+    from avenir_tpu.serve import Router
+
+    kw.setdefault("backend", "process")
+    router = Router(model, **kw)
+    created.append(router)
+    return router
+
+
+@pytest.mark.slow
+def test_process_sigkill_mid_decode_bit_parity(pfix, _close_routers):
+    """THE tentpole oracle: a REAL SIGKILL to a worker process
+    mid-decode loses nothing — the parent sees pipe EOF, requeues the
+    corpse's work, re-prefills on the survivor, and every completed
+    stream is bit-identical to one-shot generation."""
+    from avenir_tpu.obs import MetricsRegistry
+
+    model, reqs = pfix
+    reg = MetricsRegistry()
+    router = _mk_router(_close_routers, model, n_replicas=2, n_slots=2,
+                        max_seq_len=32, registry=reg, seed=0)
+    refs = trs._submit_all(router, reqs[:4])
+    for _ in range(3):
+        router.step()  # dispatched + first tokens on both workers
+    victim = next(r for r in router.replicas if r.busy)
+    os.kill(victim.pid, signal.SIGKILL)
+    done = router.drain()
+    assert len(done) == 4
+    trs._assert_parity(done, refs)
+    assert victim.state == "dead" and victim.deaths == 1
+    moved = [f for f in done if f.failovers > 0]
+    assert moved and all(f.replica != victim.replica_id for f in moved)
+    assert reg.snapshot()["counters"]["serve_failovers"] == len(moved)
+
+
+@pytest.mark.slow
+def test_process_hang_detected_by_rpc_timeout(pfix, _close_routers):
+    """A wedged worker (worker_hang: alive, silent) is detected by the
+    per-op RPC timeout, SIGKILLed, and its work moves — parity holds."""
+    from avenir_tpu.obs import MetricsRegistry
+
+    model, reqs = pfix
+    reg = MetricsRegistry()
+    router = _mk_router(_close_routers, model, n_replicas=2, n_slots=1,
+                        max_seq_len=32, registry=reg, seed=0,
+                        stall_floor_secs=0.5,
+                        proc_kwargs={"rpc_slack_secs": 1.0})
+    # warm both workers past the compile grace first
+    warm = trs._submit_all(router, reqs[4:6])
+    done = router.drain()
+    trs._assert_parity(done, warm)
+    assert all(r._n_busy_steps >= 2 for r in router.replicas)
+    refs = trs._submit_all(router, reqs[:2])
+    router.step()  # both dispatched
+    victim = next(r for r in router.replicas if r.busy)
+    victim.arm_fault("worker_hang:n=1", seed=0)
+    done = router.drain()
+    assert len(done) == 2
+    trs._assert_parity(done, refs)
+    assert victim.state == "dead"
+    snap = reg.snapshot()["counters"]
+    assert snap["rpc_timeouts"] == 1
+    assert snap["serve_failovers"] >= 1
+
+
+@pytest.mark.slow
+def test_process_frame_corruption_is_death_not_retry(pfix, _close_routers):
+    """An armed frame_corrupt trips the parent's CRC check on a real
+    step reply: counted, fatal for the replica, work failed over with
+    parity — and never retried."""
+    from avenir_tpu.obs import MetricsRegistry
+
+    model, reqs = pfix
+    reg = MetricsRegistry()
+    router = _mk_router(_close_routers, model, n_replicas=2, n_slots=1,
+                        max_seq_len=32, registry=reg, seed=0)
+    refs = trs._submit_all(router, reqs[:2])
+    router.step()
+    victim = next(r for r in router.replicas if r.busy)
+    victim.arm_fault("frame_corrupt:n=1", seed=0)
+    done = router.drain()
+    assert len(done) == 2
+    trs._assert_parity(done, refs)
+    assert victim.state == "dead"
+    assert "CRC" in str(victim.last_error)
+    assert reg.snapshot()["counters"]["frame_crc_errors"] == 1
+
+
+@pytest.mark.slow
+def test_drain_waits_out_respawn_backoff_then_fails_loud(
+        pfix, _close_routers):
+    """ISSUE 8 satellite: drain() with zero healthy replicas but a
+    respawn pending waits out the backoff window (bounded) and
+    completes; with the budget exhausted it fails loud instead."""
+    from avenir_tpu.obs import MetricsRegistry
+
+    model, reqs = pfix
+    reg = MetricsRegistry()
+    router = _mk_router(
+        _close_routers, model, n_replicas=1, n_slots=2, max_seq_len=32,
+        registry=reg, seed=0, supervise=True, max_respawns=3,
+        respawn_policy=RetryPolicy(attempts=4, base_s=0.2, cap_s=1.0,
+                                   jitter=0.0))
+    kw, ref = reqs[0]
+    rid = router.submit(**kw)
+    router.step()
+    os.kill(router.replicas[0].pid, signal.SIGKILL)
+    done = {f.req_id: f for f in router.drain()}  # waits, respawns, serves
+    assert done[rid].tokens == ref
+    assert reg.snapshot()["counters"]["replica_respawns"] == 1
+
+    # budget exhausted -> all-dead is FINAL and loud
+    reg2 = MetricsRegistry()
+    router2 = _mk_router(
+        _close_routers, model, n_replicas=1, n_slots=2, max_seq_len=32,
+        registry=reg2, seed=0, supervise=True, max_respawns=0)
+    router2.submit(**reqs[1][0])
+    router2.step()
+    os.kill(router2.replicas[0].pid, signal.SIGKILL)
+    with pytest.raises(RuntimeError, match="all replicas dead"):
+        router2.drain()
+
+
+@pytest.mark.slow
+def test_respawn_backoff_soak_repeated_kills(pfix, _close_routers):
+    """A worker SIGKILLed again and again keeps coming back on the
+    growing backoff schedule until the killing stops — then the queue
+    drains with bit parity. The restart loop, soaked."""
+    from avenir_tpu.obs import MetricsRegistry
+
+    model, reqs = pfix
+    reg = MetricsRegistry()
+    router = _mk_router(
+        _close_routers, model, n_replicas=1, n_slots=2, max_seq_len=32,
+        registry=reg, seed=0, supervise=True, max_respawns=6,
+        respawn_policy=RetryPolicy(attempts=7, base_s=0.1, cap_s=0.5,
+                                   jitter=0.0))
+    refs = trs._submit_all(router, reqs[:3])
+    kills = 0
+    finished = []
+    for _ in range(3000):
+        rep = router.replicas[0]
+        if kills < 3 and rep.state == "healthy" and rep.busy:
+            os.kill(rep.pid, signal.SIGKILL)
+            kills += 1
+        finished.extend(router.step())
+        if kills >= 3 and not router.open_requests:
+            break
+        time.sleep(0.005)
+    finished.extend(router.drain())
+    done = {f.req_id: f for f in finished}
+    assert kills == 3
+    for rid, ref in refs.items():
+        assert done[rid].tokens == ref
+    snap = reg.snapshot()["counters"]
+    assert snap["replica_respawns"] >= 3
+    assert router.replicas[0].deaths == 3
+
+
+_ROUTER_CASES = [
+    trs.test_router_parity_across_replicas,
+    trs.test_router_failover_bit_parity_step_fault,
+    trs.test_router_stall_detected_and_failed_over,
+    trs.test_router_fair_share_no_starvation,
+    trs.test_router_admission_control_sheds,
+    trs.test_router_sheds_on_projected_wait_vs_deadline,
+    trs.test_router_rejects_overlong_without_crashing,
+    trs.test_router_failover_past_deadline_times_out_not_lost,
+    trs.test_replica_state_machine_drain_and_revive,
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("case", _ROUTER_CASES, ids=lambda c: c.__name__)
+def test_router_suite_over_process_backend(case, pfix, _close_routers,
+                                           monkeypatch):
+    """The ISSUE 8 acceptance bar: the ENTIRE router semantics suite —
+    parity, failover, stall detection, fair-share, shedding, rejection,
+    deadline orphaning, the state machine — passes UNCHANGED over
+    `backend='process'`. Same assertions, real worker processes."""
+    from avenir_tpu.serve import Router
+
+    class _ProcessRouter(Router):
+        def __init__(self, model, **kw):
+            kw.setdefault("backend", "process")
+            super().__init__(model, **kw)
+            _close_routers.append(self)
+
+    monkeypatch.setattr(trs, "Router", _ProcessRouter)
+    case(pfix)

@@ -13,21 +13,34 @@ The claims this drill checks are concrete (docs/SERVING.md):
   3. FAIR-SHARE: while batch traffic saturates the fleet, interactive
      p99 TTFT stays bounded (and well under batch p99).
 
-Replica deaths come through BOTH production paths: the
-`serve_step_fail` fault site (an engine step raising mid-decode, seeded
-via utils/faults) and abrupt `kill_replica` calls at seeded step
-indices (the SIGKILL analogue). Dead replicas are revived a fixed
-number of router steps later, like a supervisor restarting a pod.
+Replica deaths come through the production paths of the chosen
+backend (ISSUE 8):
+
+  --backend=inproc (default): the `serve_step_fail` fault site (an
+    engine step raising mid-decode), the `replica_stall` silent wedge,
+    and abrupt `kill_replica` calls at seeded step indices (the
+    SIGKILL analogue). Dead replicas are revived a fixed number of
+    router steps later, like a supervisor restarting a pod.
+
+  --backend=process: each replica is a REAL worker process, and the
+    kills are real too — `os.kill(pid, SIGKILL)` mid-decode (>= 3 of
+    them), an armed `worker_hang` wedge (caught by the RPC timeout),
+    and an armed `frame_corrupt` CRC trip. Recovery is the
+    RespawnSupervisor respawning dead workers with capped backoff —
+    nothing in the drill revives anything by hand.
 
 Emits a BENCH-style JSON report; exits non-zero if any assertion
 fails, so CI can gate on it.
 
     python tools/chaos_serve.py --seed=0 --kills=3 --out=BENCH_chaos_serve.json
+    python tools/chaos_serve.py --backend=process --seed=0 --kills=5 \
+        --out=BENCH_chaos_proc.json
 """
 
 import json
 import os
 import random
+import signal
 import sys
 import time
 
@@ -47,18 +60,27 @@ def _parse_args():
 def main():
     t_start = time.time()
     a = _parse_args()
+    backend = a.get("backend", "inproc")
+    assert backend in ("inproc", "process"), backend
     cfg = {
+        "backend": backend,
         "seed": int(a.get("seed", 0)),
         "n_requests": int(a.get("n_requests", 60)),
         "n_replicas": int(a.get("n_replicas", 2)),
         "n_slots": int(a.get("n_slots", 2)),
-        "kills": int(a.get("kills", 3)),
+        # process mode cycles sigkill/hang/sigkill/corrupt/sigkill, so
+        # the default 5 delivers the >= 3 real SIGKILLs the drill's
+        # acceptance asks for plus one of each fault
+        "kills": int(a.get("kills", 5 if backend == "process" else 3)),
         "rate": float(a.get("rate", 200.0)),
         "max_new": int(a.get("max_new_tokens", 8)),
         "batch_frac": float(a.get("batch_frac", 0.7)),
         "deadline_frac": float(a.get("deadline_frac", 0.25)),
         "revive_after": int(a.get("revive_after", 15)),
-        "ttft_bound_ms": float(a.get("ttft_bound_ms", 2500.0)),
+        # process kills pay respawn (fresh jax import + compiles) and
+        # hang detection (RPC timeout) windows inside TTFT tails
+        "ttft_bound_ms": float(a.get(
+            "ttft_bound_ms", 30_000.0 if backend == "process" else 2500.0)),
         "out": a.get("out", ""),
     }
     rng = random.Random(cfg["seed"])
@@ -104,9 +126,23 @@ def main():
                          "ref": [int(t) for t in ref]})
 
     reg = reset_registry()
-    router = Router(model, n_replicas=cfg["n_replicas"],
-                    n_slots=cfg["n_slots"], max_seq_len=32, registry=reg,
-                    seed=cfg["seed"], stall_floor_secs=0.5)
+    if backend == "process":
+        from avenir_tpu.utils.retry import RetryPolicy
+
+        router = Router(model, n_replicas=cfg["n_replicas"],
+                        n_slots=cfg["n_slots"], max_seq_len=32,
+                        registry=reg, seed=cfg["seed"],
+                        stall_floor_secs=0.5, backend="process",
+                        supervise=True,
+                        respawn_policy=RetryPolicy(
+                            attempts=8, base_s=0.25, cap_s=4.0,
+                            jitter=0.25,
+                            rng=random.Random(cfg["seed"])))
+    else:
+        router = Router(model, n_replicas=cfg["n_replicas"],
+                        n_slots=cfg["n_slots"], max_seq_len=32,
+                        registry=reg, seed=cfg["seed"],
+                        stall_floor_secs=0.5)
 
     # warmup: one request per replica pays every compile (prefill bucket
     # + decode step) BEFORE the clock starts, so TTFT measures the
@@ -115,18 +151,26 @@ def main():
         router.submit([1 + r, 2, 3], max_new_tokens=2, top_k=32)
     router.drain()
 
-    # seeded kill schedule: step index -> mode, cycling all three death
-    # paths — abrupt kill_replica (the SIGKILL analogue), the
-    # serve_step_fail site (step exception mid-decode), and the
+    # seeded kill schedule: step index -> mode, cycling every death
+    # path of the chosen backend so the drill proves every DETECTION
+    # path. inproc: abrupt kill_replica (the SIGKILL analogue), the
+    # serve_step_fail site (step exception mid-decode), the
     # replica_stall site (silent wedge, caught by the heartbeat
-    # threshold) — so the drill proves every detection path
-    kill_steps = sorted(rng.sample(range(4, 4 + 12 * cfg["kills"]),
-                                   cfg["kills"]))
-    kill_plan = {s: ("kill", "fault", "stall")[i % 3]
+    # threshold). process: REAL os.kill SIGKILLs (pipe EOF), an armed
+    # worker_hang (RPC timeout), an armed frame_corrupt (CRC trip).
+    # process modes SIGKILL-first: late planned steps can fall past the
+    # drain (kill steps only tick while work is open), and the >= 3
+    # real kills are the acceptance bar — hang/corrupt ride behind
+    modes = (("sigkill", "sigkill", "sigkill", "hang", "corrupt")
+             if backend == "process" else ("kill", "fault", "stall"))
+    span = (6 if backend == "process" else 12) * cfg["kills"]
+    kill_steps = sorted(rng.sample(range(4, 4 + span), cfg["kills"]))
+    kill_plan = {s: modes[i % len(modes)]
                  for i, s in enumerate(kill_steps)}
     prev_inj = set_injector(FaultInjector("", seed=cfg["seed"]))
 
     report = {"tool": "chaos_serve", "seed": cfg["seed"],
+              "backend": backend,
               "config": {k: cfg[k] for k in
                          ("n_requests", "n_replicas", "n_slots", "kills",
                           "rate", "max_new", "batch_frac",
@@ -135,6 +179,7 @@ def main():
               "kills": [], "ok": True}
     done, submitted, step_n = [], 0, 0
     death_step = {}
+    pending_kills = []  # planned kills deferred past all-dead windows
     t0 = time.perf_counter()
     try:
         while len(done) < cfg["n_requests"]:
@@ -150,44 +195,96 @@ def main():
                 submitted += 1
             if router.open_requests or router._pending:
                 step_n += 1
-                mode = kill_plan.get(step_n)
+                if step_n in kill_plan:
+                    # queue rather than fire-and-forget: a kill whose
+                    # step lands in an all-dead window must still be
+                    # DELIVERED once something is alive to kill, or the
+                    # drill under-counts its own chaos
+                    pending_kills.append(kill_plan[step_n])
+                # deliver at most one pending kill per step; a kill is
+                # popped and RECORDED only once it actually landed —
+                # an arm RPC racing the victim's natural death, or a
+                # corpse with no pid, re-tries next step (the report's
+                # kills[] must only claim chaos that was delivered)
                 alive = [r.replica_id for r in router.replicas
                          if r.state != "dead"]
-                if mode and len(alive) > 0:
+                if pending_kills and len(alive) > 0:
+                    mode = pending_kills[0]
+                    delivered = False
+                    victim = None
                     if mode == "kill":
                         # only the abrupt kill names a victim; the fault
                         # sites fire on whichever replica steps next, so
                         # attributing them to a sampled id would lie
                         victim = rng.choice(alive)
                         router.kill_replica(victim)
+                        delivered = True
+                    elif mode == "sigkill":
+                        # the real thing: the worker process dies with
+                        # no goodbye frame; the router learns from pipe
+                        # EOF on its next RPC
+                        victim = rng.choice(alive)
+                        pid = router.replicas[victim].pid
+                        if pid is not None:
+                            os.kill(pid, signal.SIGKILL)
+                            delivered = True
+                    elif mode in ("hang", "corrupt"):
+                        # arm a one-shot worker-side fault over RPC on a
+                        # WARMED victim (a cold, just-respawned worker is
+                        # still under the RPC compile grace, which would
+                        # stretch hang detection past the soak's
+                        # patience): the victim wedges (RPC timeout) or
+                        # corrupts its next reply frame (CRC trip)
+                        warmed = [i for i in alive if router.replicas[i]
+                                  ._n_busy_steps >= 2]
+                        site = ("worker_hang" if mode == "hang"
+                                else "frame_corrupt")
+                        if warmed:
+                            victim = rng.choice(warmed)
+                            try:
+                                router.replicas[victim].arm_fault(
+                                    f"{site}:n=1", seed=cfg["seed"])
+                                delivered = True
+                            except Exception as e:  # died under the arm
+                                print(f"[chaos-serve] arm {site} on "
+                                      f"replica {victim} failed ({e!r}); "
+                                      "re-queuing")
                     else:
                         # arm a one-shot fault: the next consulting
                         # replica raises (fault) or silently wedges
                         # until the stall threshold declares it (stall)
-                        victim = None
                         site = ("serve_step_fail" if mode == "fault"
                                 else "replica_stall")
                         set_injector(FaultInjector(
                             f"{site}:n=1", seed=cfg["seed"]))
-                    report["kills"].append(
-                        {"step": step_n, "mode": mode, "replica": victim})
-                    print(f"[chaos-serve] step {step_n}: {mode} "
-                          f"(replica {victim}, "
-                          f"{router.open_requests} open)")
-                for r in router.replicas:
-                    if r.state == "dead" and r.replica_id not in death_step:
-                        death_step[r.replica_id] = step_n
-                    if (r.state == "dead" and step_n
-                            >= death_step.get(r.replica_id, step_n)
-                            + cfg["revive_after"]):
-                        router.revive_replica(r.replica_id)
-                        death_step.pop(r.replica_id, None)
-                        print(f"[chaos-serve] step {step_n}: revived "
-                              f"replica {r.replica_id}")
+                        delivered = True
+                    if delivered:
+                        pending_kills.pop(0)
+                        report["kills"].append(
+                            {"step": step_n, "mode": mode,
+                             "replica": victim})
+                        print(f"[chaos-serve] step {step_n}: {mode} "
+                              f"(replica {victim}, "
+                              f"{router.open_requests} open)")
+                if backend == "inproc":
+                    # hand-driven revives; the process backend's
+                    # recovery is the RespawnSupervisor inside step()
+                    for r in router.replicas:
+                        if (r.state == "dead"
+                                and r.replica_id not in death_step):
+                            death_step[r.replica_id] = step_n
+                        if (r.state == "dead" and step_n
+                                >= death_step.get(r.replica_id, step_n)
+                                + cfg["revive_after"]):
+                            router.revive_replica(r.replica_id)
+                            death_step.pop(r.replica_id, None)
+                            print(f"[chaos-serve] step {step_n}: revived "
+                                  f"replica {r.replica_id}")
                 done.extend(router.step())
             elif submitted < cfg["n_requests"]:
                 time.sleep(min(0.005, arrivals[submitted] - now))
-            assert time.perf_counter() - t0 < 300, "chaos soak wedged"
+            assert time.perf_counter() - t0 < (
+                900 if backend == "process" else 300), "chaos soak wedged"
     finally:
         set_injector(prev_inj)
     wall = time.perf_counter() - t0
@@ -231,6 +328,10 @@ def main():
                    and (p50_b is None or p50_i <= p50_b))
     zero_lost = not lost
     bit_identical = mism == 0
+    n_sigkills = sum(k["mode"] == "sigkill" for k in report["kills"])
+    # the process drill's acceptance: the kills must be REAL — at least
+    # 3 SIGKILLed worker processes survived via failover + respawn
+    sigkills_ok = backend != "process" or n_sigkills >= 3
     report.update({
         "wall_s": round(wall, 2),
         "submitted": submitted,
@@ -245,18 +346,25 @@ def main():
         "shed": counters.get("serve_shed", 0.0),
         "timeouts": counters.get("serve_timeouts", 0.0),
         "replica_deaths": sum(r.deaths for r in router.replicas),
+        "real_sigkills": n_sigkills,
+        "respawns": counters.get("replica_respawns", 0.0),
+        "rpc_timeouts": counters.get("rpc_timeouts", 0.0),
+        "frame_crc_errors": counters.get("frame_crc_errors", 0.0),
         "ttft_ms": {
             "interactive": {"p50": p50_i, "p99": p99_i, "n": len(it)},
             "batch": {"p50": p50_b, "p99": p99_b, "n": len(bt)},
         },
         "fairness_ok": fairness_ok,
     })
-    report["ok"] = zero_lost and bit_identical and fairness_ok
-    print(f"[chaos-serve] {submitted} submitted, {served} served "
-          f"bit_identical={bit_identical}, "
+    report["ok"] = (zero_lost and bit_identical and fairness_ok
+                    and sigkills_ok)
+    print(f"[chaos-serve] backend={backend}: {submitted} submitted, "
+          f"{served} served bit_identical={bit_identical}, "
           f"{len(by_rid) - served} explicit timeout/shed, "
           f"lost={len(lost)}, deaths={report['replica_deaths']}, "
-          f"failovers={report['failovers']:.0f}")
+          f"failovers={report['failovers']:.0f}, "
+          f"real_sigkills={n_sigkills}, "
+          f"respawns={report['respawns']:.0f}")
     print(f"[chaos-serve] ttft interactive p50/p99 "
           f"{p50_i if p50_i is not None else float('nan'):.1f}/"
           f"{p99_i if p99_i is not None else float('nan'):.1f} ms vs "
@@ -269,6 +377,7 @@ def main():
     if cfg["out"]:
         with open(cfg["out"], "w") as f:
             f.write(line + "\n")
+    router.close()  # reap process-backend workers
     sys.exit(0 if report["ok"] else 1)
 
 

@@ -1,6 +1,6 @@
-"""Seeded-Poisson load generator for the serve fleet (ISSUE 2 + 6).
+"""Seeded-Poisson load generator for the serve fleet (ISSUE 2 + 6 + 8).
 
-Drives `avenir_tpu/serve.Router` (N in-process replicas over one model;
+Drives `avenir_tpu/serve.Router` (N replicas over one model;
 `--n_replicas=1` is the single-engine case) with exponential
 interarrivals on the wall clock and reports TTFT / TPOT p50/p99,
 goodput, and per-priority-class SLO attainment — the fraction of
@@ -9,9 +9,22 @@ mix (prompt lengths, budgets, priorities, arrival times) is fully
 determined by --seed; by default the model is a tiny random-init GPT so
 the bench runs anywhere (pass --out_dir to serve a trained ckpt.pt).
 
+`--backend=process` (ISSUE 8) runs each replica as its own worker
+process; `--kills=K` delivers K replica kills at evenly spaced
+completion milestones (REAL SIGKILLs to worker processes under the
+process backend, `kill_replica` under inproc) and reports **failover
+MTTR**: kill -> the first re-dispatched request's first token on a
+surviving replica (estimated from per-request TTFT, which counts from
+ORIGINAL submission and — because failover discards the dead attempt's
+tokens — ends at the re-dispatched first token). Process-backend kills
+recover via the respawn supervisor; inproc kills are revived a fixed
+number of steps later.
+
     python tools/serve_bench.py --n_requests=64 --rate=20 --n_slots=4 \
         --n_replicas=2 --batch_frac=0.5 --slo_ttft_ms=500 \
         --max_new_tokens=32 --metrics_log=/tmp/serve/metrics.jsonl
+    python tools/serve_bench.py --backend=process --n_replicas=2 \
+        --kills=1 --n_requests=48 --rate=30
 
 --metrics_log writes an obs JSONL (run_meta / request / run_end) that
 `python tools/obs_report.py <log>` summarizes.
@@ -69,6 +82,12 @@ def main():
     top_k = int(args.get("top_k", 50))
     out_dir = args.get("out_dir")
     metrics_log = args.get("metrics_log")
+    backend = args.get("backend", "inproc")
+    kills = int(args.get("kills", 0))
+    assert backend in ("inproc", "process"), backend
+    assert kills == 0 or n_replicas >= 2, (
+        "--kills needs >= 2 replicas (a surviving replica is what "
+        "failover MTTR measures)")
 
     from flax import nnx
 
@@ -107,7 +126,12 @@ def main():
                     exist_ok=True)
         sink = JsonlSink(metrics_log)
     router = Router(model, n_replicas=n_replicas, n_slots=n_slots,
-                    registry=reg, sink=sink, seed=seed)
+                    registry=reg, sink=sink, seed=seed, backend=backend,
+                    # the supervisor is the process backend's recovery
+                    # story; inproc kills are revived below
+                    supervise=(backend == "process" and kills > 0),
+                    stall_floor_secs=float(args.get("stall_floor_secs",
+                                                    10.0)))
 
     load_rng = np.random.default_rng(seed)
     arrivals = np.cumsum(load_rng.exponential(1.0 / rate, n_requests))
@@ -123,18 +147,54 @@ def main():
                 type(model).__name__.lower(), "n_slots": n_slots,
                 "n_replicas": n_replicas, "rate": rate,
                 "n_requests": n_requests, "seed": seed})
+    # kill schedule: evenly spaced completion milestones (the fleet is
+    # warm and loaded when the axe falls, so MTTR measures failover,
+    # not compile)
+    kill_at = [(j + 1) * n_requests // (kills + 1) for j in range(kills)]
+    kill_wall = []       # perf_counter stamp of each delivered kill
+    submit_wall = {}     # rid -> perf_counter stamp at submit
+    import random as _random
+
+    kill_rng = _random.Random(seed)
+    revive_due = {}      # inproc: replica_id -> step index to revive at
     t0 = time.perf_counter()
     submitted = 0
+    step_n = 0
     done = []
     while len(done) < n_requests:
         now = time.perf_counter() - t0
         while submitted < n_requests and arrivals[submitted] <= now:
-            router.submit(prompts[submitted], max_new_tokens=max_new,
-                          temperature=1.0, top_k=top_k,
-                          priority=priorities[submitted])
+            rid = router.submit(prompts[submitted], max_new_tokens=max_new,
+                                temperature=1.0, top_k=top_k,
+                                priority=priorities[submitted])
+            submit_wall[rid] = time.perf_counter()
             submitted += 1
+        if len(kill_wall) < kills and len(done) >= kill_at[len(kill_wall)]:
+            alive = [r for r in router.replicas if r.state != "dead"]
+            # a meaningful MTTR needs a victim HOLDING work (an idle
+            # kill has nothing to fail over) and a survivor to fail
+            # over TO; otherwise defer to a later step
+            busy = [r for r in alive if r.busy]
+            if len(alive) >= 2 and busy:
+                victim = kill_rng.choice(busy)
+                if backend == "process":
+                    import os as _os
+                    import signal as _signal
+
+                    _os.kill(victim.pid, _signal.SIGKILL)
+                else:
+                    router.kill_replica(victim.replica_id)
+                    revive_due[victim.replica_id] = step_n + 30
+                kill_wall.append(time.perf_counter())
+                print(f"[serve_bench] killed replica {victim.replica_id} "
+                      f"({backend}) after {len(done)} completions")
+        for rid_, due in list(revive_due.items()):
+            if step_n >= due:
+                router.revive_replica(rid_)
+                revive_due.pop(rid_)
         if router.open_requests or router._pending:
             done.extend(router.step())
+            step_n += 1
         elif submitted < n_requests:
             time.sleep(min(0.005, arrivals[submitted] - now))
     wall = time.perf_counter() - t0
@@ -147,7 +207,8 @@ def main():
     counters = reg.snapshot()["counters"]
     tokens_out = counters["tokens_out"]
     print(f"requests: {n_requests} at {rate:.1f} req/s (seed {seed}), "
-          f"{n_replicas} replica(s) x {n_slots} slots, wall {wall:.2f}s")
+          f"{n_replicas} {backend} replica(s) x {n_slots} slots, "
+          f"wall {wall:.2f}s")
     print(f"ttft: p50 {_pct(ttfts, 0.50):.1f} ms  "
           f"p99 {_pct(ttfts, 0.99):.1f} ms")
     print(f"tpot: p50 {_pct(tpots, 0.50):.2f} ms  "
@@ -168,14 +229,36 @@ def main():
               f"(ttft<={slo_ttft_ms:.0f}ms & tpot<={slo_tpot_ms:.0f}ms)  "
               f"ttft p99 {_pct(cls_ttft, 0.99):.1f} ms"
               + (f"  shed/rejected/timeout: {refused}" if refused else ""))
-    n_prefills = sum(len(r.engine.traces["prefill"])
-                     for r in router.replicas)
-    n_steps = sum(len(r.engine.traces["step"]) for r in router.replicas)
-    print(f"compiles: {n_prefills} prefill bucket(s) "
-          f"+ {n_steps} decode step(s) across {n_replicas} replica(s)")
+    if kill_wall:
+        # failover MTTR: kill -> first re-dispatched token. A failover
+        # survivor's TTFT counts from ORIGINAL submission and ends at
+        # its first token on the replica that finished it (the dead
+        # attempt's tokens were discarded), so submit stamp + TTFT is
+        # that re-dispatched first-token instant.
+        first_tok = [(submit_wall[f.req_id] + f.ttft_ms / 1e3)
+                     for f in done
+                     if f.failovers > 0 and f.ttft_ms is not None
+                     and f.req_id in submit_wall]
+        mttrs = []
+        for tk in kill_wall:
+            after = [t - tk for t in first_tok if t > tk]
+            mttrs.append(min(after) if after else None)
+        shown = ["n/a" if m is None else f"{m * 1e3:.0f}" for m in mttrs]
+        print(f"failover mttr (kill -> first re-dispatched token): "
+              f"{', '.join(shown)} ms over {len(kill_wall)} kill(s)  "
+              f"[failovers {counters.get('serve_failovers', 0.0):.0f}, "
+              f"respawns {counters.get('replica_respawns', 0.0):.0f}]")
+    if backend == "inproc":
+        n_prefills = sum(len(r.engine.traces["prefill"])
+                         for r in router.replicas)
+        n_steps = sum(len(r.engine.traces["step"])
+                      for r in router.replicas)
+        print(f"compiles: {n_prefills} prefill bucket(s) "
+              f"+ {n_steps} decode step(s) across {n_replicas} replica(s)")
     if metrics_log:
         print(f"metrics: {metrics_log} "
               f"(summarize: python tools/obs_report.py {metrics_log})")
+    router.close()
 
 
 if __name__ == "__main__":
