@@ -169,7 +169,9 @@ def _write_cache(kc, vc, k, v, pos):
 # weights/semantics are the model's; parity is pinned by tests) ----
 
 
-def _gpt_block_step(blk, x, kc, vc, pos, q_pos):
+def _gpt_block_step(blk, x, kc, vc, pos, q_pos, kv_ops=None):
+    write, attend = kv_ops if kv_ops is not None else (_write_cache,
+                                                      _attend_cached)
     B, T, C = x.shape
     h = blk.ln_1(x).astype(x.dtype)
     qkv = blk.attn.c_attn(h)
@@ -178,16 +180,18 @@ def _gpt_block_step(blk, x, kc, vc, pos, q_pos):
     q = q.reshape(B, T, H, C // H)
     k = k.reshape(B, T, H, C // H)
     v = v.reshape(B, T, H, C // H)
-    kc, vc = _write_cache(kc, vc, k, v, pos)
-    y = _attend_cached(q, kc, vc, q_pos).reshape(B, T, C)
+    kc, vc = write(kc, vc, k, v, pos)
+    y = attend(q, kc, vc, q_pos).reshape(B, T, C)
     x = x + blk.attn.c_proj(y)
     x = x + blk.mlp(blk.ln_2(x).astype(x.dtype))
     return x, kc, vc
 
 
-def _llama_layer_step(lyr, x, kc, vc, pos, q_pos, cos, sin):
+def _llama_layer_step(lyr, x, kc, vc, pos, q_pos, cos, sin, kv_ops=None):
     from avenir_tpu.ops import apply_rope
 
+    write, attend = kv_ops if kv_ops is not None else (_write_cache,
+                                                      _attend_cached)
     B, T, C = x.shape
     attn = lyr.self_attn
     h = lyr.input_layernorm(x).astype(x.dtype)
@@ -197,8 +201,8 @@ def _llama_layer_step(lyr, x, kc, vc, pos, q_pos, cos, sin):
     positions = q_pos if q_pos.ndim == 2 else jnp.broadcast_to(q_pos[None], (B, T))
     q = apply_rope(q, cos, sin, positions=positions)
     k = apply_rope(k, cos, sin, positions=positions)
-    kc, vc = _write_cache(kc, vc, k, v, pos)
-    y = _attend_cached(q, kc, vc, q_pos)
+    kc, vc = write(kc, vc, k, v, pos)
+    y = attend(q, kc, vc, q_pos)
     x = x + attn.o_proj(y.reshape(B, T, attn.n_head * attn.head_dim))
     h2 = lyr.post_attention_layernorm(x).astype(x.dtype)
     if hasattr(lyr, "block_sparse_moe"):
@@ -245,11 +249,16 @@ def _take_last(x, last_index):
     return jax.lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)
 
 
-def _forward_cached(model, idx, cache, pos, last_index=None):
+def _forward_cached(model, idx, cache, pos, last_index=None, kv_ops=None):
     """Forward `idx` (B, T) at absolute start position `pos` — a scalar
     shared by the batch, or a (B,) vector of per-row positions (serve
     slot pool) — reading and writing the cache. Returns (fp32 logits at
-    `last_index` (default: the last position), new cache)."""
+    `last_index` (default: the last position), new cache).
+
+    `kv_ops`: optional (write, attend) pair replacing the dense
+    `_write_cache`/`_attend_cached` — the paged-KV serve pool
+    (serve/pages.py) routes cache reads/writes through a page table
+    this way, so one forward serves both cache layouts."""
     B, T = idx.shape
     if getattr(pos, "ndim", 0) == 1:
         q_pos = pos[:, None] + jnp.arange(T)[None]  # (B, T)
@@ -258,7 +267,11 @@ def _forward_cached(model, idx, cache, pos, last_index=None):
     if hasattr(model, "wte"):  # GPT
         wpe = model.wpe(q_pos)
         x = model.wte(idx) + (wpe if q_pos.ndim == 2 else wpe[None])
-        x, cache = _run_layers(model, x, cache, pos, q_pos, _gpt_block_step)
+        x, cache = _run_layers(
+            model, x, cache, pos, q_pos,
+            lambda blk, h, kc, vc, p, qp: _gpt_block_step(
+                blk, h, kc, vc, p, qp, kv_ops=kv_ops),
+        )
         x = model.ln_f(_take_last(x, last_index)).astype(x.dtype)
         logits = model.wte.attend(x)
     else:  # Llama / Mixtral
@@ -272,7 +285,7 @@ def _forward_cached(model, idx, cache, pos, last_index=None):
         x, cache = _run_layers(
             model, x, cache, pos, q_pos,
             lambda lyr, h, kc, vc, p, qp: _llama_layer_step(
-                lyr, h, kc, vc, p, qp, cos, sin),
+                lyr, h, kc, vc, p, qp, cos, sin, kv_ops=kv_ops),
         )
         x = model.norm(_take_last(x, last_index)).astype(x.dtype)
         logits = model.lm_head(x)

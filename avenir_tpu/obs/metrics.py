@@ -202,7 +202,29 @@ METRIC_SCHEMA = {
         "before the threshold declares it"),
     "slot_occupancy": (
         "gauge", "1",
-        "fraction of KV slots live after the last engine step"),
+        "fraction of KV slots live (decoding or mid-chunked-prefill) "
+        "after the last engine step"),
+    # -- paged KV (serve/pages.py, kv_impl='paged') --
+    "kv_pages_free": (
+        "gauge", "1",
+        "allocatable KV pages after the last paged-engine step: the "
+        "free list plus cached (ref-0 but prefix-registered, evictable "
+        "LRU) pages"),
+    "kv_page_util": (
+        "gauge", "1",
+        "fraction of the KV page pool referenced by live requests "
+        "after the last paged-engine step (cached prefix pages count "
+        "as free — they are reclaimable)"),
+    "prefix_hit_rate": (
+        "gauge", "1",
+        "cumulative fraction of admitted prompt tokens served from "
+        "shared prefix pages instead of being recomputed (paged KV "
+        "prefix sharing; 0 with prefix_sharing off)"),
+    "prefill_chunks": (
+        "counter", "1",
+        "chunked-prefill dispatches by the paged engine (each computes "
+        "at most prefill_chunk prompt tokens, so long prompts never "
+        "stall a decode tick)"),
     "ttft_ms": (
         "hist", "ms", "submit -> first token, per finished request"),
     "tpot_ms": (

@@ -116,6 +116,14 @@ def summarize(records):
             "ttft_p99_ms": percentile(ttfts, 0.99),
             "tpot_p50_ms": percentile(tpots, 0.50),
             "tpot_p99_ms": percentile(tpots, 0.99),
+            # paged KV (ISSUE 9): chunk counter from counters, pool
+            # pressure from the run_end record's gauge snapshot (when
+            # the bench wrote one — gauges are points, not totals)
+            "prefill_chunks": counters.get("prefill_chunks", 0.0),
+            "kv_page_util": (end.get("gauges") or {}).get("kv_page_util"),
+            "kv_pages_free": (end.get("gauges") or {}).get("kv_pages_free"),
+            "prefix_hit_rate": (end.get("gauges")
+                                or {}).get("prefix_hit_rate"),
         }
     return {
         "serve": serve,
@@ -258,6 +266,15 @@ def format_report(s):
         if sv["tpot_p50_ms"] is not None:
             lines.append(f"  tpot: p50 {sv['tpot_p50_ms']:.2f} ms  "
                          f"p99 {sv['tpot_p99_ms']:.2f} ms")
+        if sv.get("prefill_chunks") or sv.get("kv_page_util") is not None:
+            bits = [f"chunks {sv['prefill_chunks']:.0f}"]
+            if sv.get("kv_page_util") is not None:
+                bits.append(f"page util {sv['kv_page_util']:.0%}")
+            if sv.get("kv_pages_free") is not None:
+                bits.append(f"pages free {sv['kv_pages_free']:.0f}")
+            if sv.get("prefix_hit_rate") is not None:
+                bits.append(f"prefix hit {sv['prefix_hit_rate']:.0%}")
+            lines.append("  paging: " + "   ".join(bits))
     return "\n".join(lines)
 
 

@@ -1,0 +1,144 @@
+"""Paged decode attention for TPU (ISSUE 9): one query token per
+sequence attending a page-table-indirected KV cache.
+
+The serve engine's paged pool (serve/pages.py) keeps KV in a fixed pool
+of (page_size,) token blocks; a sequence's cache is whichever pages its
+table row names. The reference implementation gathers the table's pages
+into a contiguous (B, P*page_size, H_kv, D) view and runs the dense
+masked attention — exact, CPU-testable, but the gather materializes the
+whole padded window in HBM every decode step. This kernel is the
+vLLM-PagedAttention shape of the same computation, built on scalar
+prefetch:
+
+  - the page table and per-row lengths ride as SCALAR-PREFETCH
+    operands, so each grid step's BlockSpec index_map dereferences
+    `tables[b, p]` and DMAs exactly that physical page HBM->VMEM —
+    the indirection costs an SMEM read, not a gather;
+  - grid (B, H_kv, P) with the page dim innermost ("arbitrary"):
+    online-softmax statistics (m, l, acc) carry across a row's page
+    steps in fp32 VMEM scratch, Mosaic double-buffers the page DMAs;
+  - pages past a row's length skip ALL compute via pl.when (the DMA
+    still lands — bandwidth on a dead page is cheaper than a pipeline
+    bubble); the partial last page masks positions >= length;
+  - GQA: the G = H // H_kv query heads sharing a kv head are one
+    (G, D) block, so K/V are read once per kv head — never repeated.
+
+Numerics: online softmax in fp32, like ops/pallas/flash_attention.py —
+numerically equivalent to the reference, NOT bitwise (the engine's
+bit-parity contract is pinned on the reference path; this kernel has
+its own closeness tests, the same contract split as `attn_impl`).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
+                         o_ref, acc_ref, m_ref, l_ref, *, page_size):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    n_p = pl.num_programs(2)
+    ps = page_size
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = lengths_ref[b]
+
+    @pl.when(p * ps < length)
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32)        # (G, D)
+        k = k_ref[0, :, 0, :].astype(jnp.float32)  # (ps, D)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        d = q.shape[-1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (d ** -0.5)                            # (G, ps)
+        k_pos = p * ps + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos < length, s, NEG_INF)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(pexp, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            pexp, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:, :1] = m_new
+        l_ref[:, :1] = l_new
+
+    @pl.when(p == n_p - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, tables, lengths, *,
+                    interpret=False):
+    """q: (B, H, D) single decode token per row; k_pages/v_pages:
+    (n_pages, page_size, H_kv, D); tables: (B, P) int32 logical->
+    physical page map; lengths: (B,) int32 attendable positions per row
+    (the row's current pos + 1 — its own just-written token included).
+    Returns (B, H, D) in q's dtype. Rows whose table entries past
+    ceil(length/page_size) are garbage are safe: those pages are never
+    attended (compute-skipped and masked)."""
+    B, H, D = q.shape
+    n_pages, ps, h_kv, _ = k_pages.shape
+    P = tables.shape[1]
+    assert tables.shape == (B, P) and lengths.shape == (B,)
+    assert H % h_kv == 0, (H, h_kv)
+    G = H // h_kv
+    qg = q.reshape(B, h_kv, G, D)
+    # physical page indices must stay in range for the BlockSpec DMA:
+    # pad/garbage table entries are CLAMPED host-side by the caller's
+    # contract (serve tables only hold real page ids; 0-padded)
+    grid = (B, h_kv, P)
+
+    def q_index(b, h, p, tables_ref, lengths_ref):
+        return (b, h, 0, 0)
+
+    def kv_index(b, h, p, tables_ref, lengths_ref):
+        return (tables_ref[b, p], 0, h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, G, D), q_index),
+            pl.BlockSpec((1, ps, 1, D), kv_index),
+            pl.BlockSpec((1, ps, 1, D), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, D), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((G, D), jnp.float32),    # acc
+            pltpu.VMEM((G, 128), jnp.float32),  # m (col 0; lane-tiled)
+            pltpu.VMEM((G, 128), jnp.float32),  # l
+        ],
+    )
+    try:
+        params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    except (AttributeError, TypeError):
+        params = pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, page_size=ps),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, h_kv, G, D), q.dtype),
+        compiler_params=params,
+        interpret=interpret,
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      qg, k_pages, v_pages)
+    return out.reshape(B, H, D)

@@ -3,6 +3,11 @@ plus the multi-replica fleet layer over it (ISSUE 6).
 
 - slots.py:     fixed (L, n_slots, T_max, H_kv, D) KV slot pool + per-slot
                 decode state, donated through the jitted step
+- pages.py:     paged KV (ISSUE 9, kv_impl='paged'): ref-counted block
+                allocator with shared-prefix pages + copy-on-write,
+                fixed-width page tables (never retrace), chunked
+                prefill, gather-based reference paged attention (the
+                Pallas kernel lives in ops/pallas/paged_attention.py)
 - scheduler.py: FCFS admission, power-of-2 prompt bucketing (bounded
                 prefill compiles), iteration-level slot recycling
 - engine.py:    submit()/step()/drain() driver over the shared
@@ -28,6 +33,13 @@ router's failover semantics.
 """
 
 from avenir_tpu.serve.engine import Engine, FinishedRequest
+from avenir_tpu.serve.pages import (
+    AdmitPlan,
+    PageAllocator,
+    PagedPool,
+    init_paged_pool,
+    paged_kv_ops,
+)
 from avenir_tpu.serve.proc import (
     ProcReplica,
     RespawnSupervisor,
@@ -46,7 +58,9 @@ from avenir_tpu.serve.slots import SlotPool, init_slot_pool
 
 __all__ = [
     "Engine", "FinishedRequest", "FCFSScheduler", "Request", "SlotPool",
-    "init_slot_pool", "Replica", "ReplicaGone", "ProcReplica",
-    "RespawnSupervisor", "model_spec_from_model", "Router",
-    "RouterFinished", "PRIORITIES", "HEALTHY", "DRAINING", "DEAD",
+    "init_slot_pool", "PageAllocator", "AdmitPlan", "PagedPool",
+    "init_paged_pool", "paged_kv_ops", "Replica", "ReplicaGone",
+    "ProcReplica", "RespawnSupervisor", "model_spec_from_model",
+    "Router", "RouterFinished", "PRIORITIES", "HEALTHY", "DRAINING",
+    "DEAD",
 ]

@@ -64,6 +64,8 @@ from avenir_tpu.infer.decode import (
     init_cache,
 )
 from avenir_tpu.obs import NullSink, get_registry, span
+from avenir_tpu.serve.pages import PagedHost, PagedPool, \
+    init_paged_pool, paged_kv_ops
 from avenir_tpu.serve.scheduler import FCFSScheduler, Request
 from avenir_tpu.serve.slots import SlotPool, init_slot_pool
 
@@ -78,6 +80,9 @@ class FinishedRequest:
     text: Optional[str]        # detokenized, when a codec was given
     ttft_ms: Optional[float]   # None: timed out before the first token
     tpot_ms: float
+    # which limit a 'rejected' refusal hit: 'max_seq_len' (slab / model
+    # positions) or 'page_budget' (paged: max_pages_per_seq * page_size)
+    reject_limit: Optional[str] = None
 
 
 class _Live:
@@ -101,7 +106,21 @@ class Engine:
 
     def __init__(self, model, *, n_slots=4, max_seq_len=None,
                  detokenize: Optional[Callable] = None, registry=None,
-                 sink=None, seed=0, clock=None):
+                 sink=None, seed=0, clock=None, kv_impl="slab",
+                 page_size=16, n_pages=None, max_pages_per_seq=None,
+                 prefill_chunk=None, prefix_sharing=True,
+                 paged_attn_impl="auto"):
+        """`kv_impl` (ISSUE 9, the attn_impl/loss_impl pattern):
+        'slab' keeps the fixed per-slot KV columns (serve/slots.py);
+        'paged' stores KV in a pool of `n_pages` blocks of `page_size`
+        tokens behind per-slot page tables (serve/pages.py) — prompts
+        prefill in `prefill_chunk`-token chunks, shared prefixes attach
+        by refcount (`prefix_sharing`) with copy-on-write, and
+        admission is page-budget-based instead of slot-count-based.
+        `n_pages` defaults to the slab's KV footprint (n_slots * T_max
+        tokens); `max_pages_per_seq` (default ceil(T_max/page_size))
+        fixes the page-table width so allocation never retraces.
+        `paged_attn_impl` = reference | pallas | auto (pallas on TPU)."""
         # one clock for submit timestamps, TTFT/TPOT, and deadline
         # expiry — injectable so the deadline tests drive time instead
         # of sleeping through it
@@ -113,6 +132,8 @@ class Engine:
         assert self.T_max <= cfg.block_size, (
             f"max_seq_len {self.T_max} > model block_size {cfg.block_size}"
         )
+        assert kv_impl in ("slab", "paged"), f"unknown kv_impl {kv_impl!r}"
+        self.kv_impl = kv_impl
         self.detokenize = detokenize
         self._reg = registry if registry is not None else get_registry()
         self.sink = sink if sink is not None else NullSink()
@@ -122,18 +143,44 @@ class Engine:
         self._tick_s = []   # recent decode-tick durations (clock secs)
         self._next_id = 0
         self._base_rng = jax.random.key(seed)
-        self.traces = {"prefill": [], "step": []}
+        self.traces = {"prefill": [], "step": [], "cow": []}
 
         n_kv = getattr(cfg, "n_kv_head", cfg.n_head)
         head_dim = cfg.n_embd // cfg.n_head
         from avenir_tpu.models.common import resolve_dtype
 
         kv_dtype = resolve_dtype(cfg.compute_dtype)
-        self.pool = init_slot_pool(
-            n_layer=cfg.n_layer, n_slots=self.n_slots, max_t=self.T_max,
-            n_kv_head=n_kv, head_dim=head_dim, vocab_size=cfg.vocab_size,
-            dtype=kv_dtype,
-        )
+        if kv_impl == "paged":
+            self.page_size = int(page_size)
+            assert self.page_size >= 1
+            # equal-HBM default: the paged pool spends exactly the KV
+            # bytes the slab would have — the capacity win is layout
+            self.n_pages = int(n_pages if n_pages is not None
+                               else max(1, (self.n_slots * self.T_max)
+                                        // self.page_size))
+            self.max_pages_per_seq = int(
+                max_pages_per_seq if max_pages_per_seq is not None
+                else -(-self.T_max // self.page_size))
+            self.prefill_chunk = int(prefill_chunk or 4 * self.page_size)
+            self._paged = PagedHost(
+                n_pages=self.n_pages, page_size=self.page_size,
+                n_slots=self.n_slots,
+                max_pages_per_seq=self.max_pages_per_seq,
+                prefill_chunk=self.prefill_chunk,
+                prefix_sharing=prefix_sharing)
+            self.pool = init_paged_pool(
+                n_layer=cfg.n_layer, n_slots=self.n_slots,
+                n_pages=self.n_pages, page_size=self.page_size,
+                n_kv_head=n_kv, head_dim=head_dim,
+                vocab_size=cfg.vocab_size, dtype=kv_dtype,
+            )
+        else:
+            self._paged = None
+            self.pool = init_slot_pool(
+                n_layer=cfg.n_layer, n_slots=self.n_slots,
+                max_t=self.T_max, n_kv_head=n_kv, head_dim=head_dim,
+                vocab_size=cfg.vocab_size, dtype=kv_dtype,
+            )
         if getattr(cfg, "n_experts", 0):
             cap = max(1, int(-(-cfg.n_experts_per_tok * self.n_slots
                                * cfg.capacity_factor // cfg.n_experts)))
@@ -152,6 +199,9 @@ class Engine:
         # Call refresh_state() after mutating weights in place.
         graphdef, self._state = nnx.split(model)
         traces = self.traces
+        if kv_impl == "paged":
+            self._build_paged_fns(graphdef, traces, paged_attn_impl)
+            return
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def _admit(state, pool, idx_pad, slot, last_index, key_data, temp,
@@ -202,7 +252,119 @@ class Engine:
 
         self._admit, self._step_fn = _admit, _step
 
+    def _build_paged_fns(self, graphdef, traces, paged_attn_impl):
+        """The paged pool's three jitted entry points (ISSUE 9):
+        chunk-prefill (the ONLY prefill form — a short prompt is one
+        chunk), the batched decode step over page tables, and the COW
+        page copy. Compile budget: one trace per chunk bucket + one
+        decode step + one COW copy for the engine's lifetime — page
+        tables and the chunk's start/length/valid-count are all traced
+        arguments, so pages allocating and freeing never retrace."""
+        resolved = paged_attn_impl
+        if resolved == "auto":
+            resolved = ("pallas" if jax.default_backend() == "tpu"
+                        else "reference")
+        assert resolved in ("reference", "pallas"), paged_attn_impl
+        self.paged_attn_impl = resolved
+        attend_fn = None
+        if resolved == "pallas":
+            from avenir_tpu.ops.pallas.paged_attention import \
+                paged_attention
+
+            def attend_fn(q, kc, vc, q_pos, tables):
+                # decode-only fast path: q_pos is the (B, 1) per-row
+                # position vector, so row b may attend pos+1 tokens
+                lengths = (q_pos[:, -1] + 1).astype(jnp.int32)
+                return paged_attention(q[:, 0], kc, vc, tables,
+                                       lengths)[:, None]
+
+        n_pg, ps, P = self.n_pages, self.page_size, self.max_pages_per_seq
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _chunk(state, pool, idx, table_row, slot, start, n_real,
+                   key_data, temp, top_k):
+            traces["prefill"].append(idx.shape)
+            m = nnx.merge(graphdef, state)
+            kv = paged_kv_ops(table_row[None], n_pages=n_pg, page_size=ps,
+                              n_real=n_real)
+            logits, cache = _forward_cached(
+                m, idx, KVCache(pool.k, pool.v), start,
+                last_index=n_real - 1, kv_ops=kv)
+            # one UNIFORM chunk fn — no is-final flag: logits/rng/pos/
+            # sampling params splice every chunk (idempotent until the
+            # final chunk, whose splice is the one decode samples from),
+            # so a prompt of any length costs ladder-bounded compiles
+            upd = jax.lax.dynamic_update_slice
+            return PagedPool(
+                k=cache.k, v=cache.v,
+                logits=upd(pool.logits, logits, (slot, 0)),
+                rng=upd(pool.rng, key_data[None], (slot, 0)),
+                pos=upd(pool.pos,
+                        (start + n_real)[None].astype(jnp.int32), (slot,)),
+                temperature=upd(pool.temperature, temp[None], (slot,)),
+                top_k=upd(pool.top_k, top_k[None], (slot,)),
+            )
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _step(state, pool, active, tables):
+            traces["step"].append(True)
+            m = nnx.merge(graphdef, state)
+            keys = jax.random.wrap_key_data(pool.rng)
+            keys, toks = _sample_rows(keys, pool.logits, pool.temperature,
+                                      pool.top_k)
+            kv = paged_kv_ops(tables, n_pages=n_pg, page_size=ps,
+                              write_mask=active, attend_fn=attend_fn)
+            logits, cache = _forward_cached(m, toks[:, None],
+                                            KVCache(pool.k, pool.v),
+                                            pool.pos, kv_ops=kv)
+            pos = jnp.where(active, pool.pos + 1, pool.pos)
+            return toks, PagedPool(
+                k=cache.k, v=cache.v, logits=logits,
+                rng=jax.random.key_data(keys), pos=pos,
+                temperature=pool.temperature, top_k=pool.top_k,
+            )
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _cow(pool, src, dst):
+            traces["cow"].append(True)
+            return pool._replace(
+                k=pool.k.at[:, dst].set(pool.k[:, src]),
+                v=pool.v.at[:, dst].set(pool.v[:, src]))
+
+        self._chunk_fn, self._step_fn, self._cow_fn = _chunk, _step, _cow
+
     # ---- API ----
+
+    @property
+    def max_total_tokens(self):
+        """The submit-time length limit: prompt + max_new_tokens must
+        fit this. Slab: T_max. Paged: also the per-sequence page budget
+        (max_pages_per_seq * page_size) AND the whole pool (a request
+        whose worst case exceeds n_pages could block the FCFS head
+        forever waiting on pages that cannot exist) — whichever binds."""
+        if self._paged is None:
+            return self.T_max
+        return min(self.T_max,
+                   min(self.max_pages_per_seq, self.n_pages)
+                   * self.page_size)
+
+    @property
+    def limit_name(self):
+        """Which limit `max_total_tokens` is — carried on rejection
+        records so a caller knows WHAT to raise (ISSUE 9 satellite)."""
+        if (self._paged is not None
+                and min(self.max_pages_per_seq, self.n_pages)
+                * self.page_size <= self.T_max):
+            return "page_budget"
+        return "max_seq_len"
+
+    @property
+    def open_work(self):
+        """Admitted-or-queued work this engine still owes output for
+        (mid-chunked-prefill slots included — they hold pages and a
+        slot but are not yet in the live map)."""
+        return bool(self._live or self.sched.queue_depth or self._pending
+                    or (self._paged is not None and self._paged.prefill))
 
     def refresh_state(self):
         """Re-snapshot the model's parameters (after in-place weight
@@ -225,7 +387,7 @@ class Engine:
         back in every reply frame so its parent-side ProcReplica can
         mirror the scheduler surface the router routes on
         (serve/proc.py) without a second RPC."""
-        return {
+        s = {
             "n_slots": self.n_slots,
             "free": self.sched.free_slots,
             "queue": self.sched.queue_depth,
@@ -234,6 +396,20 @@ class Engine:
             "pending": len(self._pending),
             "tick_s": self.tick_estimate_s(),
         }
+        if self._paged is not None:
+            # the heartbeat carries the page budget (ISSUE 9 satellite):
+            # a parent-side ProcReplica mirrors these so the router and
+            # the obs surface see fleet paging pressure without an RPC
+            a = self._paged.alloc.stats()
+            s["prefilling"] = len(self._paged.prefill)
+            s["kv"] = {
+                "impl": "paged",
+                "n_pages": a["n_pages"],
+                "pages_free": a["free"] + a["cached"],
+                "page_util": a["util"],
+                "prefix_hit_rate": self._paged.prefix_hit_rate(),
+            }
+        return s
 
     def submit(self, prompt, *, max_new_tokens, temperature=1.0,
                top_k=None, stop_tokens=(), rng=None, deadline_ms=None,
@@ -248,28 +424,33 @@ class Engine:
         router's failover path uses it so TTFT and the deadline keep
         counting from the ORIGINAL submission, not the resubmission.
 
-        A prompt+budget that cannot fit `max_seq_len` is NOT an engine
-        crash (ISSUE 6 satellite): it finishes immediately with
+        A prompt+budget that cannot fit the engine's limit is NOT an
+        engine crash (ISSUE 6 satellite): it finishes immediately with
         finish_reason='rejected' (`serve_rejected` counter) — bad user
-        input on a shared engine must never take the fleet down."""
+        input on a shared engine must never take the fleet down. The
+        limit is budget-aware (ISSUE 9 satellite): `max_seq_len` under
+        the slab, `max_pages_per_seq * page_size` under paged KV —
+        the rejection record's `reject_limit` names which one fired."""
         prompt = tuple(int(t) for t in prompt)
         assert prompt, "empty prompt"
         assert max_new_tokens >= 1
         assert deadline_ms is None or deadline_ms > 0
         rid = self._next_id
         self._next_id += 1
-        if len(prompt) + max_new_tokens > self.T_max:
+        if len(prompt) + max_new_tokens > self.max_total_tokens:
             self._reg.counter("serve_rejected").add(1)
             rec = FinishedRequest(
                 req_id=rid, tokens=list(prompt), n_prompt=len(prompt),
                 n_out=0, finish_reason="rejected",
                 text="" if self.detokenize is not None else None,
-                ttft_ms=None, tpot_ms=0.0,
+                ttft_ms=None, tpot_ms=0.0, reject_limit=self.limit_name,
             )
             self.sink.write({
                 "kind": "request", "t": time.time(), "id": rid,
                 "n_prompt": len(prompt), "n_out": 0,
                 "finish_reason": "rejected",
+                "reject_limit": self.limit_name,
+                "limit_tokens": self.max_total_tokens,
             })
             self._pending.append(rec)
             return rid
@@ -290,6 +471,8 @@ class Engine:
         """One scheduler iteration: expire, admit, one batched decode
         dispatch, harvest. Returns the requests that finished this
         iteration (including timeouts)."""
+        if self._paged is not None:
+            return self._step_paged()
         state = self._state
         V = self.pool.logits.shape[-1]
         finished = self._pending
@@ -323,38 +506,8 @@ class Engine:
                 toks, self.pool = self._step_fn(state, self.pool,
                                                 jnp.asarray(active))
                 toks = np.asarray(toks)  # the per-iteration D2H fence
-            now = self._clock()
-            self._tick_s.append(now - t_tick)
-            if len(self._tick_s) > 64:
-                del self._tick_s[:32]
-            self._reg.counter("tokens_out").add(len(self._live))
-            for slot in sorted(self._live):
-                live = self._live[slot]
-                tok = int(toks[slot])
-                live.emitted.append(tok)
-                if live.t_first is None:
-                    live.t_first = now
-                    self._reg.hist("ttft_ms").observe(
-                        (now - live.req.submit_t) * 1e3)
-                live.t_last = now
-                if self.detokenize is not None:
-                    live.text += self.detokenize([tok])
-                hit_stop = tok in live.req.stop_tokens
-                if hit_stop or len(live.emitted) >= live.req.max_new_tokens:
-                    finished.append(self._finish(
-                        slot, live, "stop" if hit_stop else "length"))
-            # deadline eviction AFTER harvest: this iteration's token is
-            # kept (the request pays for it either way), then the slot
-            # is recycled — surviving co-tenants are untouched, so their
-            # streams stay bit-identical to a one-shot run (the same
-            # argument as stop-token recycling; parity-tested)
-            now = self._clock()
-            for slot in sorted(self._live):
-                live = self._live[slot]
-                if live.req.expired(now):
-                    finished.append(self._finish(slot, live, "timeout"))
-        self._reg.gauge("queue_depth").set(self.sched.queue_depth)
-        self._reg.gauge("slot_occupancy").set(len(self._live) / self.n_slots)
+            self._harvest_tokens(toks, t_tick, finished)
+        self._set_gauges()
         assert len(self.traces["prefill"]) <= len(self.sched.ladder), (
             "prefill compiles escaped the bucket ladder"
         )
@@ -362,6 +515,145 @@ class Engine:
             "the decode step retraced — a slot-pool shape leaked"
         )
         return finished
+
+    def _step_paged(self):
+        """One paged-KV scheduler iteration (ISSUE 9): expire, admit
+        (page-budget-based), advance chunked prefills within this
+        tick's token budget, one batched decode dispatch over the page
+        tables, harvest. The decode dispatch is identical in shape
+        every tick no matter how pages moved — tables and the live mask
+        are traced arguments."""
+        state = self._state
+        pg = self._paged
+        V = self.pool.logits.shape[-1]
+        finished = self._pending
+        self._pending = []
+        now = self._clock()
+        for req in self.sched.expire_queued(
+                now, lookahead_s=self.tick_estimate_s()):
+            finished.append(self._finish_queued_timeout(req))
+        # deadline expiry for mid-prefill slots BEFORE spending another
+        # chunk on them — a hopeless prefill must not burn compute
+        for slot in sorted(pg.prefill):
+            if pg.prefill[slot].req.expired(now):
+                finished.append(self._finish_prefilling_timeout(slot))
+        # token-budget admission: pages, not slot count, are the scarce
+        # resource — the scheduler's FCFS head blocks until the
+        # allocator can cover its worst case (prompt + max_new, minus
+        # attached prefix pages)
+        for req, slot in self.sched.take_admissions(can_admit=pg.try_admit):
+            pg.start_prefill(slot, req)
+        # chunked prefill: at most `prefill_chunk` prompt tokens
+        # computed per tick across all prefilling slots (oldest
+        # admission first), so a long prompt spreads over ticks and can
+        # never stall the co-tenants' decode dispatch below
+        budget = self.prefill_chunk
+        for slot in list(pg.prefill):
+            if budget <= 0:
+                break
+            st = pg.prefill[slot]
+            req = st.req
+            start = st.next
+            n_real = min(budget, st.n_prompt - start)
+            cow = pg.prepare_chunk(req.req_id, start, n_real)
+            if cow is not None:
+                self.pool = self._cow_fn(self.pool, jnp.int32(cow[0]),
+                                         jnp.int32(cow[1]))
+            t_pad = pg.chunk_bucket(n_real)
+            idx = np.zeros((1, t_pad), np.int32)
+            idx[0, :n_real] = req.prompt[start:start + n_real]
+            k_eff = V if req.top_k is None else max(1, min(int(req.top_k),
+                                                           V))
+            with span("serve_prefill", registry=self._reg):
+                self.pool = self._chunk_fn(
+                    state, self.pool, jnp.asarray(idx),
+                    jnp.asarray(pg.table_row(req.req_id)),
+                    jnp.int32(slot), jnp.int32(start), jnp.int32(n_real),
+                    jax.random.key_data(req.rng),
+                    jnp.float32(req.temperature), jnp.int32(k_eff),
+                )
+            self._reg.counter("prefill_chunks").add(1)
+            st.next = start + n_real
+            budget -= n_real
+            pg.register_progress(slot)
+            if st.next >= st.n_prompt:
+                # prefill done — the slot joins THIS tick's decode (the
+                # slab engine's admission->decode-same-tick semantics)
+                pg.finish_prefill(slot)
+                self._live[slot] = _Live(req)
+        if self._live:
+            for slot in sorted(self._live):
+                live = self._live[slot]
+                cow = pg.ensure_decode_page(
+                    live.req.req_id,
+                    len(live.req.prompt) + len(live.emitted))
+                if cow is not None:
+                    self.pool = self._cow_fn(self.pool, jnp.int32(cow[0]),
+                                             jnp.int32(cow[1]))
+            active = np.zeros((self.n_slots,), bool)
+            active[list(self._live)] = True
+            t_tick = self._clock()
+            with span("serve_decode", registry=self._reg):
+                toks, self.pool = self._step_fn(
+                    state, self.pool, jnp.asarray(active),
+                    jnp.asarray(pg.tables_array()))
+                toks = np.asarray(toks)  # the per-iteration D2H fence
+            self._harvest_tokens(toks, t_tick, finished)
+        self._set_gauges()
+        a = pg.alloc.stats()
+        self._reg.gauge("kv_pages_free").set(a["free"] + a["cached"])
+        self._reg.gauge("kv_page_util").set(a["util"])
+        self._reg.gauge("prefix_hit_rate").set(pg.prefix_hit_rate())
+        assert len(self.traces["prefill"]) <= len(pg.chunk_ladder), (
+            "prefill-chunk compiles escaped the chunk ladder"
+        )
+        assert len(self.traces["step"]) <= 1, (
+            "the paged decode step retraced — a shape leaked (page "
+            "tables must ride as traced arguments)"
+        )
+        assert len(self.traces["cow"]) <= 1, "the COW copy retraced"
+        return finished
+
+    def _harvest_tokens(self, toks, t_tick, finished):
+        """Post-decode harvest shared by both KV impls: per-slot token
+        append/detokenize, stop/budget checks, then deadline eviction
+        AFTER harvest — this iteration's token is kept (the request
+        pays for it either way), then the slot is recycled; surviving
+        co-tenants are untouched, so their streams stay bit-identical
+        to a one-shot run (the same argument as stop-token recycling;
+        parity-tested)."""
+        now = self._clock()
+        self._tick_s.append(now - t_tick)
+        if len(self._tick_s) > 64:
+            del self._tick_s[:32]
+        self._reg.counter("tokens_out").add(len(self._live))
+        for slot in sorted(self._live):
+            live = self._live[slot]
+            tok = int(toks[slot])
+            live.emitted.append(tok)
+            if live.t_first is None:
+                live.t_first = now
+                self._reg.hist("ttft_ms").observe(
+                    (now - live.req.submit_t) * 1e3)
+            live.t_last = now
+            if self.detokenize is not None:
+                live.text += self.detokenize([tok])
+            hit_stop = tok in live.req.stop_tokens
+            if hit_stop or len(live.emitted) >= live.req.max_new_tokens:
+                finished.append(self._finish(
+                    slot, live, "stop" if hit_stop else "length"))
+        now = self._clock()
+        for slot in sorted(self._live):
+            live = self._live[slot]
+            if live.req.expired(now):
+                finished.append(self._finish(slot, live, "timeout"))
+
+    def _set_gauges(self):
+        self._reg.gauge("queue_depth").set(self.sched.queue_depth)
+        occupied = len(self._live)
+        if self._paged is not None:
+            occupied += len(self._paged.prefill)
+        self._reg.gauge("slot_occupancy").set(occupied / self.n_slots)
 
     def evict(self, rids):
         """Host-driven expiry (ISSUE 8): a process worker's PARENT owns
@@ -379,27 +671,61 @@ class Engine:
             live = self._live[slot]
             if live.req.req_id in rids:
                 out.append(self._finish(slot, live, "timeout"))
+        if self._paged is not None:
+            for slot in sorted(self._paged.prefill):
+                if self._paged.prefill[slot].req.req_id in rids:
+                    out.append(self._finish_prefilling_timeout(slot))
         out.extend(self._finish_queued_timeout(r)
                    for r in self.sched.remove(rids))
+        if self._paged is not None:
+            # eviction is the page-leak-prone path (ISSUE 9 satellite):
+            # every eviction re-proves the allocator's refcount/freed
+            # partition from the live tables
+            self._paged.audit()
         return out
 
     def drain(self):
         """Run steps until queue and slots are empty; returns every
-        request finished along the way."""
-        bound = 2 + len(self._pending) + sum(
-            r.max_new_tokens
-            for r in ([lv.req for lv in self._live.values()]
-                      + list(self.sched._queue))
-        ) + self.sched.queue_depth  # admission-wait iterations
+        request finished along the way. Under paged KV the drained
+        allocator is AUDITED: refcounts must sum to zero live pages and
+        the free/cached lists must account for the whole pool — a page
+        leak fails loud here, not as slow capacity loss (ISSUE 9)."""
+        open_reqs = ([lv.req for lv in self._live.values()]
+                     + list(self.sched._queue))
+        prefill_ticks = 0
+        if self._paged is not None:
+            open_reqs += [st.req for st in self._paged.prefill.values()]
+            chunk = self.prefill_chunk
+            # chunked prefill spreads each prompt over ceil(len/chunk)
+            # ticks, and budget-blocked admission can wait behind every
+            # earlier request's ticks — double the linear bound
+            prefill_ticks = sum(-(-len(r.prompt) // chunk) + 1
+                                for r in open_reqs)
+        bound = 2 + len(self._pending) + self.sched.queue_depth + 2 * (
+            prefill_ticks
+            + sum(r.max_new_tokens for r in open_reqs))
         out = []
         steps = 0
-        while self._pending or self.sched.queue_depth or self._live:
+        while self.open_work:
             out.extend(self.step())
             steps += 1
             if steps > bound:
                 raise RuntimeError(
                     f"engine failed to drain within {bound} iterations")
+        if self._paged is not None:
+            self._paged.audit(expect_empty=True)
         return out
+
+    def reset_host_state(self):
+        """Rejoin-empty reset (serve/replica.py revive): fresh
+        scheduler, live map and prefill state cleared, paged allocator
+        re-initialized. KV contents are NOT scrubbed — stale rows/pages
+        stay masked until overwritten (the slot-hygiene invariant)."""
+        self._live.clear()
+        self._pending = []
+        self.sched = FCFSScheduler(self.n_slots, self.T_max)
+        if self._paged is not None:
+            self._paged.reset()
 
     # ---- internals ----
 
@@ -407,6 +733,12 @@ class Engine:
         req = live.req
         del self._live[slot]
         self.sched.release(slot)
+        if self._paged is not None:
+            # deref this request's pages: owned unregistered ones free,
+            # registered prefix pages it held become cached/evictable,
+            # shared pages just drop a refcount; the reservation tail
+            # (stop-token early finishes) is returned too
+            self._paged.release(slot)
         # restore the slot's sampling params to the pool default (k=V =
         # "no top-k") — a recycled-but-empty slot must not keep its last
         # request's finite k, or the _sample_rows runtime sort-skip
@@ -443,6 +775,16 @@ class Engine:
             record["tpot_ms"] = tpot_ms
         self.sink.write(record)
         return rec
+
+    def _finish_prefilling_timeout(self, slot):
+        """Deadline death mid-chunked-prefill (paged only): no token was
+        ever produced, so the record is the queued-timeout shape — but
+        the slot and every page (including the unspent reservation)
+        free immediately."""
+        st = self._paged.prefill[slot]
+        self._paged.release(slot)   # pops the prefill state + pages
+        self.sched.release(slot)
+        return self._finish_queued_timeout(st.req)
 
     def _finish_queued_timeout(self, req):
         """A request whose deadline passed while it was still QUEUED: it

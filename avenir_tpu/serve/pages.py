@@ -1,0 +1,640 @@
+"""Paged KV subsystem (ISSUE 9 tentpole): block allocator, shared-prefix
+pages with copy-on-write, chunked prefill, paged attention.
+
+The slab pool (`serve/slots.py`) charges every slot a full `T_max` KV
+column — a 30-token request pays for thousands of positions it never
+writes, which caps concurrent users per chip far below what HBM allows.
+This module replaces the slab with the TPU-discipline version of vLLM's
+PagedAttention plus SGLang-style shared-prefix reuse:
+
+  - **pages**: ONE pool of `n_pages` KV blocks of `page_size` tokens,
+    shape (L, n_pages, page_size, H_kv, D). A sequence's KV lives in
+    whichever pages its page table names — near-zero fragmentation
+    (any free page serves any request; the only waste is the tail of
+    the last page, < page_size tokens per sequence).
+  - **page tables**: per-slot rows padded to a fixed `max_pages_per_seq`
+    width and passed to the jitted step as a TRACED argument (like the
+    live mask), so pages allocating and freeing never changes a
+    compiled shape and never retraces — the same never-retrace
+    discipline as every other slot array.
+  - **host allocator** (`PageAllocator`): pure host state — free list,
+    per-page refcounts, reservation accounting (admission is refused
+    unless the worst-case page need is covered, so decode can never
+    hit an out-of-pages wall mid-request), and the prefix registry.
+  - **shared prefixes**: full pages of prompt tokens register in a
+    rolling-hash chain (dict-keyed by (parent node, page tokens), so a
+    chain node IS the exact token prefix — no hash collisions). A new
+    prompt walks the chain and attaches matching pages by refcount
+    instead of recomputing/rewriting them; a partially matching page
+    can also be attached (the masked-tail-exactness argument makes the
+    divergent tail unattendable) and is **copied on the first
+    divergent write** (COW). Freed registered pages stay cached and
+    evictable (LRU) until the pool needs them — a fleet of users
+    sharing one system prompt pays for its KV once.
+  - **chunked prefill**: admission forwards a long prompt at most
+    `prefill_chunk` tokens per engine tick, so prefill can never stall
+    a decode tick for the co-tenant slots. Chunked prefill is
+    BIT-IDENTICAL to one-shot prefill on this backend (per-position
+    computations are row-independent; pinned by tests/test_pages.py),
+    which is what lets attached shared pages — computed under someone
+    else's chunk boundaries — stand in for recomputation exactly.
+  - **paged attention**: the reference implementation gathers the
+    table's pages back into a (B, P*page_size, H_kv, D) view and
+    reuses the dense `_attend_cached` (bit-identical to the slab path;
+    CPU-testable); the TPU path is the Pallas kernel in
+    `ops/pallas/paged_attention.py` (numerically equivalent, not
+    bitwise — same contract as `attn_impl='pallas'`).
+
+Engine wiring lives in `serve/engine.py` behind the `kv_impl={slab,
+paged}` knob (the `attn_impl`/`loss_impl` pattern). The correctness
+oracle is unchanged: per-request bit-parity with one-shot
+`generate_cached`, prefix sharing on or off.
+"""
+
+import dataclasses
+from bisect import insort
+from collections import OrderedDict
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from avenir_tpu.infer.decode import _attend_cached, bucket_ladder, \
+    prompt_bucket
+from avenir_tpu.serve.slots import key_data_width
+
+ROOT = -1  # the prefix chain's root node id (no parent page)
+
+
+# ---------------------------------------------------------------------------
+# Host-side block allocator
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class PageRef:
+    """One page-table entry. `owned` pages are writable; a shared
+    (attached) page must be COWed before its first divergent write."""
+
+    page: int
+    owned: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmitPlan:
+    """What admission decided for one request: which prefix pages it
+    attaches, how many prompt positions they cover (`shared_len` — the
+    chunked prefill starts there), and the worst-case new-page need the
+    reservation covers."""
+
+    shared_len: int
+    shared_pages: Tuple[int, ...]   # full-page chain matches, in order
+    partial: Optional[int]          # partially matching page, if any
+    total_pages: int                # ceil((prompt + max_new) / page_size)
+    new_pages: int                  # total_pages - len(shared_pages)
+
+
+class PageAllocator:
+    """Ref-counted fixed-pool page allocator with prefix sharing + COW.
+
+    Pure host state (no jax): allocation decisions cost no dispatches,
+    and the device only ever sees the resulting page-table arrays.
+
+    Accounting model (the leak-audit contract, `audit()`):
+
+      every page is in exactly ONE of three states —
+        free       on the free list, content garbage
+        cached     refcount 0 but still registered in the prefix chain
+                   (evictable LRU; reused for prefix hits until evicted)
+        live       refcount >= 1 — referenced by that many live page
+                   tables (shared pages count once per table)
+
+    Admission reserves the WORST-CASE new-page need (prompt + max_new,
+    minus fully attached prefix pages) against `available()` = free +
+    cached - outstanding reservations, so `alloc()` during prefill or
+    decode can never fail mid-request — the paged engine has no
+    preemption path and must never need one.
+    """
+
+    def __init__(self, n_pages, page_size, prefix_sharing=True):
+        assert n_pages >= 1 and page_size >= 1
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self.prefix_sharing = bool(prefix_sharing)
+        self._free = list(range(self.n_pages))  # sorted: deterministic
+        self._ref = {}            # page -> refcount (absent == 0)
+        self._evictable = OrderedDict()  # registered ref-0 pages, LRU
+        self._node = {}           # page -> (parent, tokens) while registered
+        self._children = {}       # parent -> {tokens: page}
+        self._tables = {}         # rid -> [PageRef, ...]
+        self._reserved = {}       # rid -> pages still owed to this request
+        self._chain = {}          # rid -> current chain node (registration)
+        self.cow_copies = 0
+        self.prefix_hits = 0      # requests that attached >= 1 page
+
+    # -- capacity --
+
+    def available(self):
+        """Pages an admission may still promise: free + evictable
+        cached, minus what outstanding reservations already own."""
+        return (len(self._free) + len(self._evictable)
+                - sum(self._reserved.values()))
+
+    def stats(self):
+        live = self.n_pages - len(self._free) - len(self._evictable)
+        return {
+            "n_pages": self.n_pages,
+            "free": len(self._free),
+            "cached": len(self._evictable),
+            "live": live,
+            "util": live / self.n_pages,
+            "reserved": sum(self._reserved.values()),
+            "cow_copies": self.cow_copies,
+        }
+
+    # -- prefix matching --
+
+    def plan(self, prompt, max_new):
+        """Match `prompt` against the prefix chain (no state change).
+        Full pages match exactly along the chain; the first non-matching
+        position may still land inside a registered page whose tokens
+        share a prefix — that page attaches PARTIALLY (its divergent
+        tail stays masked, exactly like slab padding) and is COWed on
+        the request's first write into it. `shared_len` is capped at
+        len(prompt) - 1: at least one prompt position must be computed
+        to produce the last-token logits decode samples from."""
+        prompt = tuple(int(t) for t in prompt)
+        ps = self.page_size
+        total = -(-(len(prompt) + int(max_new)) // ps)
+        shared, i, cur = [], 0, ROOT
+        partial = None
+        if self.prefix_sharing:
+            while i + ps <= len(prompt) - 1:
+                page = self._children.get(cur, {}).get(prompt[i:i + ps])
+                if page is None:
+                    break
+                shared.append(page)
+                cur = page
+                i += ps
+            cap = len(prompt) - 1 - i
+            best_m = 0
+            for toks, page in self._children.get(cur, {}).items():
+                m = 0
+                for a, b in zip(toks, prompt[i:]):
+                    if a != b:
+                        break
+                    m += 1
+                m = min(m, cap)
+                if m > best_m:
+                    best_m, partial = m, page
+            if best_m == 0:
+                partial = None
+            shared_len = i + best_m
+        else:
+            shared_len = 0
+        return AdmitPlan(
+            shared_len=shared_len, shared_pages=tuple(shared),
+            partial=partial, total_pages=total,
+            new_pages=total - len(shared),
+        )
+
+    # -- admission / release --
+
+    def admit(self, rid, prompt, max_new):
+        """Try to admit: returns the AdmitPlan (prefix pages attached,
+        reservation taken, table seeded) or None when the worst-case
+        page need is not covered — the scheduler's token-budget
+        admission check. A False path mutates nothing."""
+        assert rid not in self._tables, f"rid {rid} already admitted"
+        plan = self.plan(prompt, max_new)
+        # attaching a CACHED (ref-0) prefix page revives it to live,
+        # shrinking the reclaimable pool by one without consuming a
+        # reservation — the admission check must charge for those too,
+        # or outstanding reservations could exceed free+cached and a
+        # later alloc() for an already-admitted request would crash
+        attach = list(plan.shared_pages)
+        if plan.partial is not None:
+            attach.append(plan.partial)
+        cached_attached = sum(1 for p in attach if p in self._evictable)
+        if self.available() < plan.new_pages + cached_attached:
+            return None
+        self._reserved[rid] = plan.new_pages
+        table = []
+        for page in plan.shared_pages:
+            self._incref(page)
+            table.append(PageRef(page, owned=False))
+        if plan.partial is not None:
+            self._incref(plan.partial)
+            table.append(PageRef(plan.partial, owned=False))
+        self._tables[rid] = table
+        self._chain[rid] = plan.shared_pages[-1] if plan.shared_pages \
+            else ROOT
+        if plan.shared_len:
+            self.prefix_hits += 1
+        return plan
+
+    def free_seq(self, rid):
+        """Release a finished/evicted request: every table entry is
+        dereferenced (registered pages whose refcount hits 0 become
+        cached/evictable, unregistered ones go straight to the free
+        list) and the unused tail of its reservation is returned."""
+        for entry in self._tables.pop(rid, []):
+            self._decref(entry.page)
+        self._reserved.pop(rid, None)
+        self._chain.pop(rid, None)
+
+    def table(self, rid):
+        return self._tables[rid]
+
+    # -- page movement --
+
+    def alloc(self, rid):
+        """One fresh owned page for `rid`, appended to its table. Always
+        succeeds for an admitted request (the reservation guarantees
+        it — an AssertionError here is an accounting bug, not load)."""
+        page = self._take(rid)
+        self._ref[page] = 1
+        self._tables[rid].append(PageRef(page, owned=True))
+        return page
+
+    def ensure_writable(self, rid, slot_idx):
+        """COW: make table entry `slot_idx` writable. Owned entries are
+        a no-op (None); a shared entry is replaced by a fresh page and
+        the (src, dst) physical pair is returned — the caller must copy
+        the page's KV on device before the next write."""
+        entry = self._tables[rid][slot_idx]
+        if entry.owned:
+            return None
+        src = entry.page
+        dst = self._take(rid)
+        self._ref[dst] = 1
+        self._tables[rid][slot_idx] = PageRef(dst, owned=True)
+        self._decref(src)
+        self.cow_copies += 1
+        return (src, dst)
+
+    def register(self, rid, slot_idx, tokens):
+        """Register table entry `slot_idx` — a page now fully covered
+        by prompt tokens — as a prefix-chain node under `rid`'s current
+        chain position. If an identical node already exists (two equal
+        prompts racing), the chain advances through the existing page
+        and the duplicate stays private. Registered pages are immutable
+        by construction: requests only ever write at their sequence
+        tail, which lies beyond every fully-covered prompt page."""
+        if not self.prefix_sharing:
+            return
+        tokens = tuple(int(t) for t in tokens)
+        assert len(tokens) == self.page_size
+        parent = self._chain.get(rid, ROOT)
+        if parent != ROOT and parent not in self._node:
+            # the chain node this request was riding is gone: a dedup
+            # hop landed it on a CACHED page (ref 0, not in this
+            # request's table) that eviction reclaimed mid-prefill.
+            # Registering under the stale id could resurrect as a
+            # wrong-prefix match once the page id is reused and
+            # re-registered — stop chaining this request instead (a
+            # conservative miss, never a wrong hit)
+            return
+        kids = self._children.setdefault(parent, {})
+        existing = kids.get(tokens)
+        if existing is not None:
+            self._chain[rid] = existing
+            return
+        entry = self._tables[rid][slot_idx]
+        if not entry.owned:
+            # a fully attached shared page IS the chain node already
+            self._chain[rid] = entry.page
+            return
+        self._node[entry.page] = (parent, tokens)
+        kids[tokens] = entry.page
+        self._chain[rid] = entry.page
+
+    # -- internals --
+
+    def _incref(self, page):
+        n = self._ref.get(page, 0)
+        if n == 0:
+            self._evictable.pop(page, None)  # cached -> live
+        self._ref[page] = n + 1
+
+    def _decref(self, page):
+        n = self._ref.get(page, 0)
+        assert n >= 1, f"double free of page {page}"
+        if n > 1:
+            self._ref[page] = n - 1
+            return
+        self._ref.pop(page)
+        if page in self._node:
+            self._evictable[page] = None   # keep for future prefix hits
+        else:
+            insort(self._free, page)
+
+    def _take(self, rid):
+        assert self._reserved.get(rid, 0) > 0, (
+            f"page alloc for rid {rid} without reservation — admission "
+            "under-counted its worst case (allocator bug)")
+        if not self._free:
+            assert self._evictable, (
+                "no free or evictable page despite a live reservation — "
+                "reservation accounting is broken")
+            self._evict(next(iter(self._evictable)))  # LRU victim
+        self._reserved[rid] -= 1
+        return self._free.pop(0)
+
+    def _evict(self, page):
+        """Reclaim a cached (ref-0, registered) page: drop it and its
+        whole registered subtree from the chain — a chain with a hole
+        in the middle must not match past it — freeing any cached
+        descendants along the way (live descendants just lose their
+        registration and free normally later)."""
+        self._evictable.pop(page)
+        parent, toks = self._node.pop(page)
+        self._children.get(parent, {}).pop(toks, None)
+        for child in list(self._children.pop(page, {}).values()):
+            self._deregister_subtree(child)
+        insort(self._free, page)
+
+    def _deregister_subtree(self, page):
+        self._node.pop(page)
+        for child in list(self._children.pop(page, {}).values()):
+            self._deregister_subtree(child)
+        if page in self._evictable:
+            self._evictable.pop(page)
+            insort(self._free, page)
+
+    # -- the leak audit --
+
+    def audit(self):
+        """Recompute every invariant from first principles and assert it
+        (drain()/evict call this — a page leak must fail loud, not
+        slowly strangle capacity). Returns the stats dict."""
+        want = {}
+        for table in self._tables.values():
+            for entry in table:
+                want[entry.page] = want.get(entry.page, 0) + 1
+        for page in range(self.n_pages):
+            assert self._ref.get(page, 0) == want.get(page, 0), (
+                f"page {page}: refcount {self._ref.get(page, 0)} != "
+                f"{want.get(page, 0)} live table references — page leak")
+        live = set(want)
+        free, cached = set(self._free), set(self._evictable)
+        assert len(free) == len(self._free), "duplicate free-list entry"
+        assert not (free & cached) and not (free & live) \
+            and not (cached & live), "page in two states at once"
+        assert free | cached | live == set(range(self.n_pages)), (
+            f"pages vanished: {set(range(self.n_pages)) - free - cached - live}")
+        for page in cached:
+            assert page in self._node, "cached page lost its registration"
+        for page, (parent, toks) in self._node.items():
+            assert self._children[parent][toks] == page, (
+                "prefix chain linkage broken")
+        assert sum(self._reserved.values()) <= len(free) + len(cached), (
+            "outstanding reservations exceed reclaimable pages")
+        return self.stats()
+
+
+# ---------------------------------------------------------------------------
+# Device-side paged pool + KV ops
+# ---------------------------------------------------------------------------
+
+
+class PagedPool(NamedTuple):
+    """The paged analogue of `slots.SlotPool`, donated through the
+    jitted step exactly the same way: KV lives in pages instead of
+    per-slot columns, everything else is per-slot decode state. Page
+    tables are NOT part of the pool — the host passes them as a traced
+    argument each dispatch (they are tiny, change on every allocation,
+    and a traced arg can never retrace)."""
+
+    k: jax.Array            # (L, n_pages, page_size, H_kv, D)
+    v: jax.Array            # (L, n_pages, page_size, H_kv, D)
+    logits: jax.Array       # (n_slots, V) fp32
+    rng: jax.Array          # (n_slots, key_words) uint32
+    pos: jax.Array          # (n_slots,) int32
+    temperature: jax.Array  # (n_slots,) f32
+    top_k: jax.Array        # (n_slots,) int32; V means "no top-k"
+
+
+def init_paged_pool(*, n_layer, n_slots, n_pages, page_size, n_kv_head,
+                    head_dim, vocab_size, dtype):
+    kv_shape = (n_layer, n_pages, page_size, n_kv_head, head_dim)
+    return PagedPool(
+        k=jnp.zeros(kv_shape, dtype),
+        v=jnp.zeros(kv_shape, dtype),
+        logits=jnp.zeros((n_slots, vocab_size), jnp.float32),
+        rng=jnp.zeros((n_slots, key_data_width()), jnp.uint32),
+        pos=jnp.zeros((n_slots,), jnp.int32),
+        temperature=jnp.ones((n_slots,), jnp.float32),
+        top_k=jnp.full((n_slots,), vocab_size, jnp.int32),
+    )
+
+
+def paged_kv_ops(tables, *, n_pages, page_size, n_real=None,
+                 write_mask=None, attend_fn=None):
+    """(write, attend) pair for `infer.decode._forward_cached` over a
+    paged layer cache of shape (n_pages, page_size, H_kv, D).
+
+    `tables` (B, P) int32 maps logical page slot -> physical page; pad
+    entries may be anything (their positions are masked by q_pos).
+    Writes route position p to (tables[b, p // page_size], p %
+    page_size); invalid rows are scattered to page index `n_pages`,
+    which jax's out-of-bounds scatter DROPS — the masking mechanism for
+    chunk padding (`n_real`) and inactive decode rows (`write_mask`).
+    Reads gather the table's pages into a (B, P*page_size, ...) view
+    and reuse the dense `_attend_cached` — bit-identical to the slab
+    path (tests pin it); `attend_fn`, when given, replaces the gather
+    for single-token queries (the Pallas decode kernel)."""
+    B, P = tables.shape
+    ps = page_size
+
+    def write(kc, vc, k, v, pos):
+        if getattr(pos, "ndim", 0) == 1:
+            # decode: (B, 1, H_kv, D) at per-row positions
+            page_slot = jnp.clip(pos // ps, 0, P - 1)
+            phys = jnp.take_along_axis(tables, page_slot[:, None],
+                                       axis=1)[:, 0]
+            if write_mask is not None:
+                phys = jnp.where(write_mask, phys, n_pages)  # dropped
+            off = pos % ps
+            kc = kc.at[phys, off].set(k[:, 0].astype(kc.dtype),
+                                      mode="drop")
+            vc = vc.at[phys, off].set(v[:, 0].astype(vc.dtype),
+                                      mode="drop")
+            return kc, vc
+        # chunk prefill: B == 1, scalar start position
+        T = k.shape[1]
+        offs = pos + jnp.arange(T)
+        page_slot = jnp.clip(offs // ps, 0, P - 1)
+        phys = tables[0][page_slot]
+        if n_real is not None:
+            phys = jnp.where(jnp.arange(T) < n_real, phys, n_pages)
+        kc = kc.at[phys, offs % ps].set(k[0].astype(kc.dtype),
+                                       mode="drop")
+        vc = vc.at[phys, offs % ps].set(v[0].astype(vc.dtype),
+                                       mode="drop")
+        return kc, vc
+
+    def attend(q, kc, vc, q_pos):
+        if attend_fn is not None and q.shape[1] == 1:
+            return attend_fn(q, kc, vc, q_pos, tables)
+        kg = kc[tables].reshape(B, P * ps, *kc.shape[-2:])
+        vg = vc[tables].reshape(B, P * ps, *vc.shape[-2:])
+        return _attend_cached(q, kg, vg, q_pos)
+
+    return write, attend
+
+
+# ---------------------------------------------------------------------------
+# Engine-side host driver
+# ---------------------------------------------------------------------------
+
+
+class _PrefillState:
+    """Per-slot chunked-prefill progress. `next` is the next prompt
+    position to compute (admission starts it at the plan's shared_len —
+    the prefix hit IS skipped compute); `reg_upto` the next page slot
+    to register once fully covered by prompt tokens."""
+
+    def __init__(self, req, plan):
+        self.req = req
+        self.n_prompt = len(req.prompt)
+        self.next = plan.shared_len
+        self.reg_upto = len(plan.shared_pages)
+
+
+class PagedHost:
+    """Host bookkeeping between the engine driver and the allocator:
+    admission plans, per-slot prefill progress, page-table staging, and
+    the paging metrics. Owns NO device state — the engine owns the pool
+    and the jitted functions; this object tells it which pages to touch.
+    """
+
+    def __init__(self, *, n_pages, page_size, n_slots, max_pages_per_seq,
+                 prefill_chunk, prefix_sharing=True):
+        self.alloc = PageAllocator(n_pages, page_size,
+                                   prefix_sharing=prefix_sharing)
+        self.page_size = int(page_size)
+        self.n_slots = int(n_slots)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self.prefill_chunk = int(prefill_chunk)
+        self.chunk_ladder = bucket_ladder(self.prefill_chunk)
+        self.prefill = {}     # slot -> _PrefillState (admission order)
+        self.rid_of = {}      # slot -> rid (prefilling or live)
+        self._plans = {}      # rid -> AdmitPlan (until prefill starts)
+        self.shared_tokens = 0
+        self.prompt_tokens = 0
+
+    # -- admission --
+
+    def try_admit(self, req):
+        """The scheduler's token-budget admission check (FCFS: a False
+        return blocks the queue head). True COMMITS allocator state —
+        the scheduler hands the request a slot in the same call."""
+        plan = self.alloc.admit(req.req_id, req.prompt,
+                                req.max_new_tokens)
+        if plan is None:
+            return False
+        self._plans[req.req_id] = plan
+        self.shared_tokens += plan.shared_len
+        self.prompt_tokens += len(req.prompt)
+        return True
+
+    def start_prefill(self, slot, req):
+        plan = self._plans.pop(req.req_id)
+        self.prefill[slot] = _PrefillState(req, plan)
+        self.rid_of[slot] = req.req_id
+
+    # -- chunked prefill --
+
+    def chunk_bucket(self, n):
+        """Pad target for a chunk of n real tokens — the chunk-size
+        analogue of the prompt-bucket ladder, bounding prefill compiles
+        at O(log prefill_chunk) for the engine's lifetime."""
+        return prompt_bucket(n, self.prefill_chunk)
+
+    def prepare_chunk(self, rid, start, n_real):
+        """Allocate the pages positions [start, start+n_real) need and
+        make the first written page owned. Returns the (src, dst) COW
+        copy to perform on device, or None — at most one per request,
+        on its first divergent write into a partially attached page."""
+        ps = self.page_size
+        first = start // ps
+        last = (start + n_real - 1) // ps
+        table = self.alloc.table(rid)
+        for _ in range(len(table), last + 1):
+            self.alloc.alloc(rid)
+        return self.alloc.ensure_writable(rid, first)
+
+    def register_progress(self, slot):
+        """Register every page slot newly covered end-to-end by prompt
+        tokens (chain order — parents before children)."""
+        st = self.prefill[slot]
+        ps = self.page_size
+        covered = min(st.next, st.n_prompt)
+        while (st.reg_upto + 1) * ps <= covered:
+            s = st.reg_upto
+            self.alloc.register(st.req.req_id, s,
+                                st.req.prompt[s * ps:(s + 1) * ps])
+            st.reg_upto += 1
+
+    def finish_prefill(self, slot):
+        del self.prefill[slot]  # rid_of persists while the slot is live
+
+    # -- decode --
+
+    def ensure_decode_page(self, rid, pos):
+        """Page coverage for a decode write at `pos`: allocate on a
+        page boundary; `ensure_writable` is a defensive no-op here (a
+        decode position's page was always written during prefill or
+        freshly allocated — both owned)."""
+        slot_idx = pos // self.page_size
+        table = self.alloc.table(rid)
+        while len(table) <= slot_idx:
+            self.alloc.alloc(rid)
+        return self.alloc.ensure_writable(rid, slot_idx)
+
+    # -- table staging --
+
+    def table_row(self, rid):
+        row = np.zeros((self.max_pages_per_seq,), np.int32)
+        for i, entry in enumerate(self.alloc.table(rid)):
+            row[i] = entry.page
+        return row
+
+    def tables_array(self):
+        out = np.zeros((self.n_slots, self.max_pages_per_seq), np.int32)
+        for slot, rid in self.rid_of.items():
+            for i, entry in enumerate(self.alloc.table(rid)):
+                out[slot, i] = entry.page
+        return out
+
+    # -- release / reset / metrics --
+
+    def release(self, slot):
+        rid = self.rid_of.pop(slot)
+        self.prefill.pop(slot, None)
+        self.alloc.free_seq(rid)
+
+    def reset(self):
+        """Rejoin-empty reset (replica revive): fresh allocator — the
+        page CONTENTS are stale-but-masked exactly like slab rows, but
+        the prefix chain must not survive into the new life (its pages
+        are about to be reallocated arbitrarily)."""
+        self.alloc = PageAllocator(self.alloc.n_pages, self.page_size,
+                                   prefix_sharing=self.alloc.prefix_sharing)
+        self.prefill.clear()
+        self.rid_of.clear()
+        self._plans.clear()
+
+    def prefix_hit_rate(self):
+        if not self.prompt_tokens:
+            return 0.0
+        return self.shared_tokens / self.prompt_tokens
+
+    def audit(self, *, expect_empty=False):
+        stats = self.alloc.audit()
+        if expect_empty:
+            assert stats["live"] == 0 and stats["reserved"] == 0, (
+                f"pages still live after drain: {stats} — page leak")
+        return stats

@@ -141,10 +141,15 @@ class _EngineProxy:
     def __init__(self, owner):
         self._owner = owner
         self.T_max = None          # set by the handshake
+        self.max_total_tokens = None   # effective submit limit (ISSUE 9)
+        self.limit_name = "max_seq_len"
+        self.kv_impl = "slab"
         self.n_slots = 0
         self.sched = _SchedView()
         self._live = {}            # engine rid -> tokens emitted so far
         self._pending = 0
+        self._prefilling = 0       # paged: slots mid-chunked-prefill
+        self.kv = None             # paged: page-budget heartbeat mirror
         self._tick_s = 0.0
 
     def tick_estimate_s(self):
@@ -160,6 +165,9 @@ class _EngineProxy:
         self._live = {int(k): int(v)
                       for k, v in (hb.get("live") or {}).items()}
         self._pending = int(hb.get("pending", 0))
+        self._prefilling = int(hb.get("prefilling", 0))
+        if hb.get("kv") is not None:
+            self.kv = dict(hb["kv"])  # page budget rides every beat
         self._tick_s = float(hb.get("tick_s", 0.0))
 
     def clear(self):
@@ -167,7 +175,9 @@ class _EngineProxy:
         self.sched.queue_depth = 0
         self._live = {}
         self._pending = 0
-        self._tick_s = 0.0
+        self._prefilling = 0
+        self.kv = None  # a corpse's page stats must not keep feeding
+        self._tick_s = 0.0  # the router's fleet paging gauges
 
 
 class ProcReplica(ReplicaHealth):
@@ -181,14 +191,16 @@ class ProcReplica(ReplicaHealth):
                  sink=None, seed=0, clock=None, stall_floor_secs=10.0,
                  stall_factor=10.0, rpc_slack_secs=5.0,
                  compile_grace_secs=300.0, env=None,
-                 defer_handshake=False):
+                 defer_handshake=False, engine_kwargs=None):
         super().__init__(
             replica_id,
             clock=clock if clock is not None else time.perf_counter,
             stall_floor_secs=stall_floor_secs, stall_factor=stall_factor)
         self._spec = model_spec
         self._ekw = {"n_slots": int(n_slots), "max_seq_len": max_seq_len,
-                     "detokenize": detokenize, "seed": int(seed)}
+                     "detokenize": detokenize, "seed": int(seed),
+                     # paged-KV knobs ride the hello (ISSUE 9)
+                     **(engine_kwargs or {})}
         self._reg = registry if registry is not None else get_registry()
         self.sink = sink if sink is not None else NullSink()
         self.rpc_slack_secs = float(rpc_slack_secs)
@@ -265,6 +277,10 @@ class ProcReplica(ReplicaHealth):
                 f"replica {self.replica_id} worker speaks proto "
                 f"{reply.get('proto')}, parent speaks {PROTO_VERSION}")
         self.engine.T_max = int(reply["t_max"])
+        self.engine.max_total_tokens = int(
+            reply.get("limit_tokens", reply["t_max"]))
+        self.engine.limit_name = reply.get("limit_name", "max_seq_len")
+        self.engine.kv_impl = reply.get("kv_impl", "slab")
         self.engine.n_slots = int(reply["n_slots"])
         self.engine.sched.free_slots = int(reply["n_slots"])
         self.last_beat = self._clock()
@@ -301,6 +317,14 @@ class ProcReplica(ReplicaHealth):
         # — a wedged worker must not linger half-alive (its pipes stay
         # readable and a later frame would desync the new stream)
         self._teardown(kill=True)
+        # drop the corpse's per-request bookkeeping NOW (ISSUE 9 leak
+        # audit): the router requeues its work onto OTHER replicas, so
+        # these rids will never be harvested here — without this, every
+        # failover leaked its submit_t/deadline/first-token entries
+        # until the next revive
+        self._submit_t = {}
+        self._t_first = {}
+        self._deadline = {}
 
     def close(self):
         """Graceful shutdown (drained replica, end of run)."""
@@ -339,7 +363,7 @@ class ProcReplica(ReplicaHealth):
     @property
     def busy(self):
         return bool(self.engine._live or self.engine.sched.queue_depth
-                    or self.engine._pending)
+                    or self.engine._pending or self.engine._prefilling)
 
     # -- RPC --
 

@@ -44,7 +44,6 @@ already rests on.
 import statistics
 
 from avenir_tpu.serve.engine import Engine
-from avenir_tpu.serve.scheduler import FCFSScheduler
 from avenir_tpu.utils.faults import get_injector
 
 HEALTHY = "healthy"
@@ -140,11 +139,12 @@ class Replica(ReplicaHealth):
 
     def __init__(self, model, replica_id, *, n_slots=4, max_seq_len=None,
                  detokenize=None, registry=None, sink=None, seed=0,
-                 clock=None, stall_floor_secs=10.0, stall_factor=10.0):
+                 clock=None, stall_floor_secs=10.0, stall_factor=10.0,
+                 engine_kwargs=None):
         self.engine = Engine(
             model, n_slots=n_slots, max_seq_len=max_seq_len,
             detokenize=detokenize, registry=registry, sink=sink,
-            seed=seed, clock=clock,
+            seed=seed, clock=clock, **(engine_kwargs or {}),
         )
         super().__init__(replica_id, clock=self.engine._clock,
                          stall_floor_secs=stall_floor_secs,
@@ -174,9 +174,10 @@ class Replica(ReplicaHealth):
 
     @property
     def busy(self):
-        """Holds admitted-but-unfinished work (any state)."""
-        eng = self.engine
-        return bool(eng._live or eng.sched.queue_depth or eng._pending)
+        """Holds admitted-but-unfinished work (any state) — including
+        paged-KV slots still mid-chunked-prefill, which hold pages and
+        a slot and must count for stall detection."""
+        return self.engine.open_work
 
     # -- stepping --
 
@@ -221,10 +222,11 @@ class Replica(ReplicaHealth):
         From `draining`: just un-drain — in-flight work is live and must
         NOT be dropped."""
         if self.state == DEAD:
-            eng = self.engine
-            eng._live.clear()
-            eng._pending = []
-            eng.sched = FCFSScheduler(eng.n_slots, eng.T_max)
+            # paged engines also re-init their allocator here: the page
+            # CONTENTS are stale-but-masked like slab rows, but the old
+            # life's prefix chain and refcounts must not survive into
+            # the new one (its pages are about to be reallocated)
+            self.engine.reset_host_state()
             self._stalled = False
             self._durs = []
             self.last_error = None

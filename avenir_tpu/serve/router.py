@@ -104,7 +104,8 @@ class Router:
                  clock=None, weights=None, queue_limits=None,
                  stall_floor_secs=10.0, stall_factor=10.0,
                  backend="inproc", model_spec=None, supervise=False,
-                 respawn_policy=None, max_respawns=5, proc_kwargs=None):
+                 respawn_policy=None, max_respawns=5, proc_kwargs=None,
+                 engine_kwargs=None):
         """`weights`: dispatch shares per priority class (default
         interactive 4 : batch 1). `queue_limits`: max queued per class
         before shedding (default 16/64 x fleet slots). `clock` is shared
@@ -122,7 +123,13 @@ class Router:
         workers with capped exponential backoff (`respawn_policy`, a
         utils/retry.RetryPolicy) up to `max_respawns` consecutive
         failures per replica; `proc_kwargs` forwards extra ProcReplica
-        knobs (rpc_slack_secs, compile_grace_secs, env)."""
+        knobs (rpc_slack_secs, compile_grace_secs, env).
+
+        `engine_kwargs` (ISSUE 9) forwards per-engine knobs to every
+        replica — the paged-KV ones (`kv_impl`, `page_size`, `n_pages`,
+        `max_pages_per_seq`, `prefill_chunk`, `prefix_sharing`,
+        `paged_attn_impl`) ride the process backend's hello handshake
+        unchanged, so a fleet of paged workers is one flag away."""
         assert n_replicas >= 1
         assert backend in BACKENDS, f"unknown backend {backend!r}"
         self._clock = clock if clock is not None else time.perf_counter
@@ -147,6 +154,7 @@ class Router:
                             stall_floor_secs=stall_floor_secs,
                             stall_factor=stall_factor,
                             defer_handshake=True,
+                            engine_kwargs=engine_kwargs,
                             **(proc_kwargs or {}))
                 for i in range(n_replicas)
             ]
@@ -168,10 +176,18 @@ class Router:
                         detokenize=detokenize, registry=self._reg,
                         sink=self.sink, seed=seed, clock=self._clock,
                         stall_floor_secs=stall_floor_secs,
-                        stall_factor=stall_factor)
+                        stall_factor=stall_factor,
+                        engine_kwargs=engine_kwargs)
                 for i in range(n_replicas)
             ]
-        self.T_max = self.replicas[0].engine.T_max
+        eng0 = self.replicas[0].engine
+        self.T_max = eng0.T_max
+        # budget-aware admission limit (ISSUE 9): under paged KV the
+        # per-sequence page budget binds, not T_max — the engine (or
+        # the worker's hello reply, for the process backend) says which
+        self.max_total_tokens = getattr(eng0, "max_total_tokens",
+                                        None) or eng0.T_max
+        self._limit_name = getattr(eng0, "limit_name", "max_seq_len")
         self.detokenize = detokenize
         self.weights = dict(weights or {"interactive": 4.0, "batch": 1.0})
         assert set(self.weights) == set(PRIORITIES)
@@ -212,9 +228,10 @@ class Router:
         if rng is None:
             rng = jax.random.fold_in(self._base_rng, rid)
         now = self._clock()
-        if len(prompt) + int(max_new_tokens) > self.T_max:
+        if len(prompt) + int(max_new_tokens) > self.max_total_tokens:
             self._reg.counter("serve_rejected").add(1)
-            self._refuse(rid, prompt, priority, "rejected")
+            self._refuse(rid, prompt, priority, "rejected",
+                         reject_limit=self._limit_name)
             return rid
         q = self._queues[priority]
         if len(q) >= self.queue_limits[priority]:
@@ -314,6 +331,29 @@ class Router:
             # a stall FORMING — visible before the threshold declares it
             self._reg.gauge("heartbeat_age_s").set(
                 max(self._clock() - r.last_beat for r in alive))
+        # paged-KV gauges get the same fleet-aggregate treatment as
+        # queue_depth above (N engines, one registry): pages_free sums,
+        # util/prefix-hit average over the replicas reporting them.
+        # Inproc replicas read their engine directly; process replicas
+        # read the heartbeat mirror (proxy.kv)
+        kvs = []
+        for r in self.replicas:
+            paged = getattr(r.engine, "_paged", None)
+            if paged is not None:
+                a = paged.alloc.stats()
+                kvs.append((a["free"] + a["cached"], a["util"],
+                            paged.prefix_hit_rate()))
+            elif getattr(r.engine, "kv", None):
+                kv = r.engine.kv
+                kvs.append((kv.get("pages_free", 0),
+                            kv.get("page_util", 0.0),
+                            kv.get("prefix_hit_rate", 0.0)))
+        if kvs:
+            self._reg.gauge("kv_pages_free").set(sum(k[0] for k in kvs))
+            self._reg.gauge("kv_page_util").set(
+                sum(k[1] for k in kvs) / len(kvs))
+            self._reg.gauge("prefix_hit_rate").set(
+                sum(k[2] for k in kvs) / len(kvs))
         return finished
 
     def drain(self, max_steps=None):
@@ -328,7 +368,12 @@ class Router:
         (ISSUE 8 satellite)."""
         bound = max_steps or (
             20 + len(self._pending) + 2 * len(self._open)
-            + 4 * sum(r.max_new_tokens for r in self._open.values()))
+            + 4 * sum(r.max_new_tokens for r in self._open.values())
+            # paged engines prefill in chunks: a long prompt takes up to
+            # ceil(len/chunk) extra ticks (chunk >= 1), and page-budget
+            # admission can hold the queue head while earlier requests
+            # drain — prompt length is the safe per-request overbound
+            + sum(len(r.prompt) for r in self._open.values()))
         out = []
         steps = 0
         waits = 0
@@ -429,20 +474,25 @@ class Router:
 
     # ---- internals ----
 
-    def _refuse(self, rid, prompt, priority, reason):
+    def _refuse(self, rid, prompt, priority, reason, reject_limit=None):
         """Terminal-at-the-door record ('rejected'/'shed'): no queue
-        entry, no slot, delivered from the next step()."""
+        entry, no slot, delivered from the next step(). A rejection
+        names which limit fired (`reject_limit`, ISSUE 9)."""
         self._pending.append(RouterFinished(
             req_id=rid, tokens=list(prompt), n_prompt=len(prompt),
             n_out=0, finish_reason=reason,
             text="" if self.detokenize is not None else None,
-            ttft_ms=None, tpot_ms=0.0, priority=priority,
+            ttft_ms=None, tpot_ms=0.0, reject_limit=reject_limit,
+            priority=priority,
         ))
-        self.sink.write({
+        record = {
             "kind": "request", "t": time.time(), "id": rid,
             "n_prompt": len(prompt), "n_out": 0, "finish_reason": reason,
             "priority": priority,
-        })
+        }
+        if reject_limit is not None:
+            record["reject_limit"] = reject_limit
+        self.sink.write(record)
 
     def _expire_queued(self, now, out):
         """Router-queue deadline sweep with one fleet tick of lookahead:

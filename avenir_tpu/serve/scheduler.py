@@ -107,13 +107,25 @@ class FCFSScheduler:
                                 if r.req_id not in req_ids)
         return removed
 
-    def take_admissions(self):
+    def take_admissions(self, can_admit=None):
         """Pop (request, slot) pairs while both a queued request and a
         free slot exist. FCFS: no reordering, no lookahead — a too-long
         request blocks the queue rather than being skipped (documented
-        policy; admission fairness over utilization)."""
+        policy; admission fairness over utilization).
+
+        `can_admit` (ISSUE 9): optional token-budget gate consulted on
+        the queue head before it is popped — the paged engine passes
+        the allocator's worst-case page check here, which turns
+        admission from slot-count-based into page-budget-based. A False
+        return BLOCKS the head (same FCFS policy: pages free as earlier
+        requests finish, so the head is served next, never starved).
+        NB: a True return may commit caller-side state (the paged
+        allocator reserves pages in the same call), so the pair is
+        always popped after a True."""
         out = []
         while self._queue and self._free:
+            if can_admit is not None and not can_admit(self._queue[0]):
+                break
             out.append((self._queue.popleft(), self._free.pop(0)))
         return out
 

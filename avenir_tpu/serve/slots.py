@@ -19,6 +19,14 @@ weight) until the moment it is overwritten.
 RNG is stored as raw uint32 key data (`jax.random.key_data` layout) and
 wrapped back into typed keys inside the step: raw data indexes/donates
 like any other array, with bit-exact round-tripping.
+
+This slab is the `kv_impl="slab"` default. `serve/pages.py` (ISSUE 9)
+is the paged alternative: same per-slot decode state, but KV lives in
+a pool of page_size-token blocks behind per-slot page tables — a slot
+then pays HBM for the tokens it actually holds instead of a full
+T_max column, shared prompt prefixes are stored once, and the slot
+hygiene invariant above carries over page-for-row (a page is only
+attendable at positions the owning sequence has already written).
 """
 
 from typing import NamedTuple

@@ -119,6 +119,13 @@ def _serve(stream):
 
     ekw = dict(hello.get("engine") or {})
     reg = get_registry()
+    # paged-KV knobs ride the handshake (ISSUE 9 satellite): the parent
+    # decides the kv_impl and page geometry, the worker only obeys —
+    # None values fall back to the Engine's own defaults
+    kv_kw = {k: ekw[k] for k in
+             ("kv_impl", "page_size", "n_pages", "max_pages_per_seq",
+              "prefill_chunk", "prefix_sharing", "paged_attn_impl")
+             if ekw.get(k) is not None}
     engine = Engine(
         _build_model(hello["model"]),
         n_slots=int(ekw.get("n_slots", 4)),
@@ -126,9 +133,13 @@ def _serve(stream):
         detokenize=ekw.get("detokenize"),
         seed=int(ekw.get("seed", 0)),
         registry=reg,
+        **kv_kw,
     )
     stream.write({"ok": True, "seq": hseq, "proto": PROTO_VERSION,
                   "t_max": engine.T_max, "n_slots": engine.n_slots,
+                  "limit_tokens": engine.max_total_tokens,
+                  "limit_name": engine.limit_name,
+                  "kv_impl": engine.kv_impl,
                   "pid": os.getpid()})
 
     def hb():

@@ -131,6 +131,8 @@ def test_mixtral_roundtrip_both_consumers(tmp_path):
                                atol=5e-4, rtol=5e-4)
 
 
+@pytest.mark.slow  # ~11-13s on this harness (trains a loop + exports);
+# far over the tier-1 budget test_zz_slow_guard enforces
 def test_export_from_trained_ckpt(tmp_path, char_dataset):
     """The CLI entry point: train 2 iters, convert out_dir/ckpt.pt, load
     the export back — logits match the checkpoint-restored model."""

@@ -1,0 +1,808 @@
+"""Paged-KV subsystem tests (avenir_tpu/serve/pages.py, ISSUE 9).
+
+Three layers, mirroring the subsystem:
+
+  1. the allocator as PURE HOST CODE — alloc/free/refcount/COW/prefix-
+     chain/eviction/reservation edge cases and the leak audit, no jax;
+  2. the device ops — paged scatter/gather vs the dense cache, bitwise;
+     the Pallas decode kernel in interpret mode vs the reference;
+  3. the paged ENGINE — the unchanged correctness oracle: per-request
+     bit-parity with one-shot `generate_cached` across GPT/Llama/
+     Mixtral, randomized arrivals, prefix sharing ON and OFF, chunked
+     prefill crossing page boundaries, compile counts pinned (no
+     retrace as pages allocate/free), budget-aware rejection, and
+     mid-chunked-prefill failover through the router.
+
+The prefix-sharing soak and the chaos-mid-prefill load test are marked
+slow. Like test_serve.py, models are single-layer (engine logic is
+depth-blind) and every request uses ONE max_new so one-shot references
+share decode compiles.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import nnx
+
+from avenir_tpu.infer.decode import _attend_cached, first_stop_index, \
+    generate_cached, trace_count
+from avenir_tpu.models.gpt import GPT, GPTConfig
+from avenir_tpu.models.llama import Llama, LlamaConfig
+from avenir_tpu.models.mixtral import Mixtral, MixtralConfig
+from avenir_tpu.obs import MetricsRegistry
+from avenir_tpu.serve import Engine, PageAllocator, Router
+from avenir_tpu.serve.pages import paged_kv_ops
+
+GPT_TINY = GPTConfig(block_size=64, vocab_size=64, n_layer=1, n_head=2,
+                     n_embd=32, dropout=0.0, bias=True, attn_impl="xla")
+LLAMA_KW = dict(block_size=64, vocab_size=64, n_layer=1, n_head=4,
+                n_kv_head=2, n_embd=32, ffn_hidden=64, dropout=0.0,
+                attn_impl="xla")
+MAX_NEW = 6
+PAGED_KW = dict(kv_impl="paged", page_size=8, n_pages=24,
+                prefill_chunk=8)
+
+
+# ---------------------------------------------------------------------------
+# 1. the allocator as pure host code
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_alloc_free_refcount():
+    a = PageAllocator(n_pages=6, page_size=4, prefix_sharing=False)
+    plan = a.admit(0, prompt=range(9), max_new=3)   # 12 tokens = 3 pages
+    assert plan is not None and plan.new_pages == 3
+    assert a.available() == 3                        # 6 - 3 reserved
+    p0, p1, p2 = a.alloc(0), a.alloc(0), a.alloc(0)
+    assert len({p0, p1, p2}) == 3
+    assert a.stats()["live"] == 3 and a.stats()["reserved"] == 0
+    with pytest.raises(AssertionError):              # reservation spent
+        a.alloc(0)
+    a.audit()
+    a.free_seq(0)
+    st = a.audit()
+    assert st["live"] == 0 and st["free"] == 6 and st["cached"] == 0
+
+
+def test_allocator_reservation_blocks_admission_and_reuse_is_exact():
+    """Token-budget admission: a request whose WORST CASE is not covered
+    is refused; interleaved alloc/free of odd sizes never strands a
+    page (fragmentation-free by construction — any page serves any
+    request)."""
+    a = PageAllocator(n_pages=8, page_size=4, prefix_sharing=False)
+    assert a.admit(0, prompt=range(10), max_new=2) is not None  # 3 pages
+    assert a.admit(1, prompt=range(17), max_new=3) is not None  # 5 pages
+    assert a.admit(2, prompt=range(2), max_new=1) is None       # over
+    # finish 0 early (stop token): only 1 of its 3 pages was used
+    a.alloc(0)
+    a.free_seq(0)
+    assert a.available() == 3
+    assert a.admit(2, prompt=range(9), max_new=3) is not None   # 3 pages
+    for _ in range(5):
+        a.alloc(1)
+    for _ in range(3):
+        a.alloc(2)
+    a.audit()
+    a.free_seq(1)
+    a.free_seq(2)
+    assert a.audit()["free"] == 8
+
+
+def test_allocator_prefix_full_and_partial_match():
+    a = PageAllocator(n_pages=10, page_size=4)
+    # request 0: 11-token prompt -> pages [0:4) [4:8) full, [8:11) tail
+    prompt = list(range(11))
+    assert a.admit(0, prompt, max_new=1).shared_len == 0
+    for slot in range(3):
+        a.alloc(0)
+    a.register(0, 0, prompt[0:4])
+    a.register(0, 1, prompt[4:8])
+    # request 1: identical first 10 tokens -> two full pages shared
+    plan = a.plan(prompt[:10] + [99], max_new=1)
+    assert len(plan.shared_pages) == 2 and plan.shared_len == 8
+    assert plan.partial is None  # tail [8:10] was never registered
+    # request 2: prompt is a PREFIX of request 0's (ends mid-page-1):
+    # full page 0 + a partial attach of page 1 (divergent tail masked)
+    plan = a.plan(prompt[:7], max_new=1)
+    assert len(plan.shared_pages) == 1 and plan.partial is not None
+    assert plan.shared_len == 6  # capped at len(prompt)-1
+    # request 3: diverges inside page 0 -> partial match of page 0
+    plan = a.plan([0, 1, 77, 78], max_new=1)
+    assert plan.shared_pages == () and plan.partial is not None
+    assert plan.shared_len == 2
+    # request 4: no common prefix at all
+    plan = a.plan([50, 51, 52, 53, 54], max_new=1)
+    assert plan.shared_pages == () and plan.partial is None
+    a.audit()
+
+
+def test_allocator_prefix_dedup_and_temporal_reuse():
+    """Two identical prompts register once (dedup chains through the
+    existing node); pages freed by their owner stay CACHED and match
+    later prompts until evicted."""
+    a = PageAllocator(n_pages=8, page_size=4)
+    prompt = list(range(8))
+    a.admit(0, prompt, max_new=1)
+    a.alloc(0), a.alloc(0), a.alloc(0)
+    a.register(0, 0, prompt[0:4])
+    a.register(0, 1, prompt[4:8])
+    first_pages = [e.page for e in a.table(0)][:2]
+    # a racing identical prompt that computed privately registers dup
+    a.admit(1, [7] * 9, max_new=1)   # no match (different tokens)
+    a.alloc(1), a.alloc(1), a.alloc(1)
+    a.register(1, 0, prompt[0:4])    # same tokens as 0's page 0: dedup
+    assert a._chain[1] == first_pages[0]
+    a.free_seq(0)
+    st = a.stats()
+    assert st["cached"] == 2         # 0's registered pages linger
+    plan = a.plan(prompt + [60], max_new=1)
+    assert list(plan.shared_pages) == first_pages  # temporal hit
+    a.free_seq(1)
+    a.audit()
+
+
+def test_allocator_cow_bookkeeping():
+    a = PageAllocator(n_pages=6, page_size=4)
+    prompt = list(range(9))
+    a.admit(0, prompt, max_new=3)
+    a.alloc(0), a.alloc(0), a.alloc(0)
+    a.register(0, 0, prompt[0:4])
+    a.register(0, 1, prompt[4:8])
+    shared_page = a.table(0)[1].page
+    # request 1 ends inside page 1 -> partial attach, COW on write
+    plan = a.admit(1, prompt[:7], max_new=2)
+    assert plan.partial == shared_page
+    assert not a.table(1)[1].owned
+    assert a._ref[shared_page] == 2
+    cow = a.ensure_writable(1, 1)
+    assert cow is not None and cow[0] == shared_page
+    assert a.table(1)[1].owned and a.table(1)[1].page == cow[1]
+    assert a._ref[shared_page] == 1 and a.cow_copies == 1
+    assert a.ensure_writable(1, 1) is None   # second write: owned
+    a.audit()
+    a.free_seq(0)
+    a.free_seq(1)
+    a.audit()
+
+
+def test_allocator_eviction_cascades_through_the_chain():
+    """Evicting a cached chain node deregisters its whole subtree —
+    a chain with a hole must never match past it — and frees cached
+    descendants; LIVE descendants just lose their registration."""
+    a = PageAllocator(n_pages=4, page_size=2)
+    prompt = [1, 2, 3, 4, 5, 6]
+    a.admit(0, prompt, max_new=2)   # 4 pages
+    for _ in range(4):
+        a.alloc(0)
+    for s in range(3):
+        a.register(0, s, prompt[2 * s:2 * s + 2])
+    a.free_seq(0)                   # 3 registered pages -> cached
+    assert a.stats()["cached"] == 3 and a.stats()["free"] == 1
+    # a new 4-page request must evict: LRU pops the chain ROOT page,
+    # whose whole subtree deregisters -> all 3 cached pages free
+    assert a.admit(1, [9, 9, 9, 9, 9], max_new=3) is not None
+    for _ in range(4):
+        a.alloc(1)
+    assert a._node == {} or all(p not in a._node for p in range(4)
+                                if a._ref.get(p, 0) == 0)
+    assert a.plan(prompt, max_new=1).shared_pages == ()  # chain gone
+    a.free_seq(1)
+    a.audit()
+
+
+def test_allocator_stale_chain_parent_never_resurrects():
+    """A dedup hop can land a request's chain on a CACHED page; if
+    eviction reclaims it mid-prefill, later registrations must STOP
+    (conservative miss) rather than chain under the stale id — which a
+    reused page could otherwise resurrect as a wrong-prefix match."""
+    a = PageAllocator(n_pages=8, page_size=2)
+    # request 1 is admitted BEFORE anything is registered (no match)
+    a.admit(1, [1, 2, 3, 4, 5, 6], max_new=2)
+    a.alloc(1)
+    # request 0 races ahead: registers [1,2], finishes -> node cached
+    a.admit(0, [1, 2, 9], max_new=1)
+    a.alloc(0), a.alloc(0)
+    a.register(0, 0, [1, 2])
+    cached_node = a.table(0)[0].page
+    a.free_seq(0)
+    # request 1's own [1,2] registration dedups onto the cached node
+    # (which its table does NOT reference -> not ref-held by it)
+    a.register(1, 0, [1, 2])
+    assert a._chain[1] == cached_node
+    # pool pressure evicts the cached node mid-prefill of request 1
+    a._evict(cached_node)
+    # request 1's next registration must refuse the stale parent
+    a.alloc(1)
+    a.register(1, 1, [3, 4])
+    assert a.table(1)[1].page not in a._node
+    assert a.plan([1, 2, 3, 4, 5], max_new=1).shared_pages == ()
+    a.free_seq(1)
+    a.audit()
+
+
+def test_allocator_admission_charges_for_cached_attaches():
+    """Attaching a CACHED prefix page revives it to live, shrinking the
+    reclaimable pool without consuming a reservation — admission must
+    charge for that, or a co-tenant's already-granted reservation
+    becomes unbackable (review finding: audit tripped 'reservations
+    exceed reclaimable pages' and alloc() crashed the engine)."""
+    a = PageAllocator(n_pages=3, page_size=4)
+    # request X registers 2 prefix pages, finishes -> 2 cached, 1 free
+    prompt = list(range(9))
+    a.admit(0, prompt, max_new=3)
+    a.alloc(0), a.alloc(0), a.alloc(0)
+    a.register(0, 0, prompt[0:4])
+    a.register(0, 1, prompt[4:8])
+    a.free_seq(0)
+    assert a.audit() == a.stats()  # 1 free + 2 cached, nothing live
+    # A reserves the 1 reclaimable page beyond the cached pair
+    assert a.admit(1, [9, 9, 9], max_new=1) is not None   # 1 page
+    # B shares X's prefix: new_pages=1 but it would ALSO revive both
+    # cached pages — 1 + 2 > available, so admission must refuse
+    assert a.admit(2, prompt[:8] + [7], max_new=3) is None
+    a.alloc(1)          # A's reservation must still be backable
+    a.audit()
+    a.free_seq(1)
+    # with A gone there is room: B admits, attaches, and runs clean
+    assert a.admit(2, prompt[:8] + [7], max_new=3) is not None
+    a.alloc(2)
+    a.audit()
+    a.free_seq(2)
+    a.audit()
+
+
+def test_proxy_clear_drops_kv_mirror():
+    """A dead worker's last heartbeat must not keep feeding the fleet
+    paging gauges: _EngineProxy.clear() drops the kv mirror with the
+    rest of the heartbeat state (review finding)."""
+    from avenir_tpu.serve.proc import _EngineProxy
+
+    proxy = _EngineProxy(owner=None)
+    proxy.update({"n_slots": 2, "free": 1, "queue": 0,
+                  "kv": {"impl": "paged", "pages_free": 24,
+                         "page_util": 0.5, "prefix_hit_rate": 0.3}})
+    assert proxy.kv["pages_free"] == 24
+    proxy.clear()
+    assert proxy.kv is None
+
+
+def test_allocator_audit_catches_a_leak():
+    a = PageAllocator(n_pages=4, page_size=4, prefix_sharing=False)
+    a.admit(0, range(6), max_new=2)
+    a.alloc(0)
+    a.audit()
+    a._ref[3] = 1  # a refcount with no table reference = leak
+    with pytest.raises(AssertionError, match="leak"):
+        a.audit()
+
+
+def test_scheduler_budget_admission_blocks_fcfs_head():
+    from avenir_tpu.serve.scheduler import FCFSScheduler, Request
+
+    sched = FCFSScheduler(4, 64)
+    for i in range(3):
+        sched.enqueue(Request(req_id=i, prompt=(1, 2, 3),
+                              max_new_tokens=4))
+    admitted = sched.take_admissions(
+        can_admit=lambda r: r.req_id == 0)
+    assert [r.req_id for r, _ in admitted] == [0]
+    assert sched.queue_depth == 2  # head 1 blocked, 2 NOT skipped past
+    admitted = sched.take_admissions(can_admit=lambda r: True)
+    assert [r.req_id for r, _ in admitted] == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# 2. device ops: paged scatter/gather + the Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def test_paged_ops_bitwise_match_dense_cache():
+    """Writing K/V through a shuffled page table and attending through
+    the gather view is BIT-identical to the dense cache — the device
+    half of the parity argument."""
+    from avenir_tpu.infer.decode import _write_cache
+
+    rng = np.random.default_rng(0)
+    B, Hkv, D, ps, P, n_pages = 3, 2, 8, 8, 4, 16
+    pos = jnp.asarray([5, 17, 30])
+    kd = jnp.asarray(rng.standard_normal((B, P * ps, Hkv, D)), jnp.float32)
+    vd = jnp.asarray(rng.standard_normal((B, P * ps, Hkv, D)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((B, 1, 4, D)), jnp.float32)
+    knew = jnp.asarray(rng.standard_normal((B, 1, Hkv, D)), jnp.float32)
+    vnew = jnp.asarray(rng.standard_normal((B, 1, Hkv, D)), jnp.float32)
+    # dense: write then attend
+    kdw, vdw = _write_cache(kd, vd, knew, vnew, pos)
+    ref = _attend_cached(q, kdw, vdw, pos[:, None])
+    # paged: scatter rows into a shuffled page layout, same ops
+    perm = rng.permutation(n_pages)[:B * P]
+    tables = np.zeros((B, P), np.int32)
+    kp = np.zeros((n_pages, ps, Hkv, D), np.float32)
+    vp = np.zeros((n_pages, ps, Hkv, D), np.float32)
+    for b in range(B):
+        for p in range(P):
+            pg = int(perm[b * P + p])
+            tables[b, p] = pg
+            kp[pg] = np.asarray(kd[b, p * ps:(p + 1) * ps])
+            vp[pg] = np.asarray(vd[b, p * ps:(p + 1) * ps])
+    write, attend = paged_kv_ops(jnp.asarray(tables), n_pages=n_pages,
+                                 page_size=ps,
+                                 write_mask=jnp.ones((B,), bool))
+    kpw, vpw = write(jnp.asarray(kp), jnp.asarray(vp), knew, vnew, pos)
+    got = attend(q, kpw, vpw, pos[:, None])
+    assert jnp.all(ref == got)
+    # masked write: an inactive row's scatter is dropped entirely
+    write2, _ = paged_kv_ops(jnp.asarray(tables), n_pages=n_pages,
+                             page_size=ps,
+                             write_mask=jnp.asarray([True, False, True]))
+    kp2, _ = write2(jnp.asarray(kp), jnp.asarray(vp), knew, vnew, pos)
+    assert jnp.all(kp2[tables[1, int(pos[1]) // ps]]
+                   == kp[tables[1, int(pos[1]) // ps]])
+
+
+@pytest.mark.parametrize("heads", [(4, 4), (4, 2)])
+def test_pallas_paged_attention_interpret(heads):
+    """The TPU paged-attention kernel (interpret mode) vs the gather
+    reference: MHA and GQA, partial last pages, garbage in unattended
+    pages."""
+    from avenir_tpu.ops.pallas.paged_attention import paged_attention
+
+    H, Hkv = heads
+    rng = np.random.default_rng(1)
+    B, D, ps, P, n_pages = 3, 16, 8, 4, 12
+    pos = jnp.asarray([0, 12, 30])  # incl. a single-token row
+    kp = jnp.asarray(rng.standard_normal((n_pages, ps, Hkv, D)),
+                     jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((n_pages, ps, Hkv, D)),
+                     jnp.float32)
+    tables = jnp.asarray(rng.integers(0, n_pages, (B, P)), jnp.int32)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
+    kg = kp[tables].reshape(B, P * ps, Hkv, D)
+    vg = vp[tables].reshape(B, P * ps, Hkv, D)
+    ref = _attend_cached(q, kg, vg, pos[:, None])[:, 0]
+    got = paged_attention(q[:, 0], kp, vp, tables, pos + 1,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# 3. the paged engine: the unchanged bit-parity oracle
+# ---------------------------------------------------------------------------
+
+
+def _mk_requests(model, rng, n, *, max_prompt=20, shared_prefix=None,
+                 combos=((0.8, None), (1.0, 5), (1.3, 16))):
+    """Requests with one-shot reference streams (the test_serve.py
+    recipe); `shared_prefix` prepends a common system prompt to every
+    other request so prefix sharing genuinely engages."""
+    reqs = []
+    for i in range(n):
+        t0 = int(rng.integers(3, max_prompt + 1))
+        prompt = [int(t) for t in rng.integers(0, 64, (t0,))]
+        if shared_prefix is not None and i % 2 == 0:
+            prompt = list(shared_prefix) + prompt[:6]
+        temp, top_k = combos[i % len(combos)]
+        kw = dict(prompt=prompt, max_new_tokens=MAX_NEW, temperature=temp,
+                  top_k=top_k, rng=jax.random.key(1000 + i))
+        y = np.asarray(generate_cached(
+            model, kw["rng"], jnp.asarray(prompt, jnp.int32)[None],
+            MAX_NEW, temperature=temp, top_k=top_k))[0]
+        stop = (int(y[len(prompt) + 1]),) if i % 3 == 0 else ()
+        n_keep = (first_stop_index(y[len(prompt):], stop) if stop
+                  else MAX_NEW)
+        reqs.append((kw | {"stop_tokens": stop},
+                     [int(t) for t in y[:len(prompt) + n_keep]]))
+    return reqs
+
+
+@pytest.fixture(scope="module")
+def gpt_fix():
+    model = GPT(GPT_TINY, rngs=nnx.Rngs(0))
+    return model, _mk_requests(model, np.random.default_rng(0), 8)
+
+
+@pytest.fixture(scope="module")
+def prefix_fix(gpt_fix):
+    """Shared-prefix request mix + references (module-scoped so the
+    reference decode compiles stay out of per-test call time — the
+    tier-1 slow-guard budget)."""
+    model, _ = gpt_fix
+    rng = np.random.default_rng(3)
+    prefix = [int(t) for t in rng.integers(0, 64, (17,))]
+    return model, _mk_requests(model, rng, 6, max_prompt=12,
+                               shared_prefix=prefix, combos=((1.0, 8),))
+
+
+@pytest.fixture(scope="module", params=["llama", "mixtral", "gpt_scan"])
+def family_fix(request):
+    """Per-family model + references, module-scoped for the same
+    slow-guard reason. Mixtral runs in the non-binding capacity regime
+    (cf*K >= E): there prefill NEVER drops tokens, so per-chunk token
+    counts cannot shift expert capacity — the chunked-prefill analogue
+    of the documented engine caveat."""
+    if request.param == "llama":
+        model = Llama(LlamaConfig(**LLAMA_KW), rngs=nnx.Rngs(0))
+    elif request.param == "mixtral":
+        model = Mixtral(MixtralConfig(n_experts=4, n_experts_per_tok=2,
+                                      capacity_factor=2.0, **LLAMA_KW),
+                        rngs=nnx.Rngs(0))
+    else:
+        model = GPT(dataclasses.replace(GPT_TINY, scan_layers=True),
+                    rngs=nnx.Rngs(0))
+    return model, _mk_requests(model, np.random.default_rng(2), 3,
+                               combos=((1.0, 8),))
+
+
+def _run_schedule(engine, reqs, bursts):
+    ids, results, pending = {}, {}, list(range(len(reqs)))
+    bursts = list(bursts)
+    while pending or engine.open_work:
+        take = bursts.pop(0) if bursts else len(pending)
+        for _ in range(min(take, len(pending))):
+            i = pending.pop(0)
+            ids[engine.submit(**reqs[i][0])] = i
+        for f in engine.step():
+            results[ids[f.req_id]] = f
+    return results
+
+
+def _assert_parity(results, reqs):
+    assert len(results) == len(reqs)
+    for i, (kw, ref) in enumerate(reqs):
+        got = results[i].tokens
+        assert got == ref, f"request {i} diverged:\n ref {ref}\n got {got}"
+
+
+def test_engine_paged_parity_randomized_arrivals(gpt_fix):
+    """The acceptance case: randomized bursts, fewer slots than
+    requests, chunked prefill (chunk < prompt) crossing page
+    boundaries, prefix sharing ON — every request bit-identical to its
+    one-shot reference; compile count pinned (chunk-ladder prefills +
+    ONE decode step + at most one COW copy) and the one-shot decode
+    ledger untouched by engine traffic."""
+    model, reqs = gpt_fix
+    ledger0 = trace_count()
+    engine = Engine(model, n_slots=3, max_seq_len=32,
+                    registry=MetricsRegistry(), **PAGED_KW)
+    results = _run_schedule(engine, reqs, bursts=[3, 0, 2, 1, 0, 2])
+    _assert_parity(results, reqs)
+    assert trace_count() == ledger0  # engine work never retraces decode
+    assert len(engine.traces["prefill"]) <= len(engine._paged.chunk_ladder)
+    assert len(engine.traces["step"]) == 1
+    assert len(engine.traces["cow"]) <= 1
+    assert engine.sched.n_recycled == len(reqs)
+    engine._paged.audit(expect_empty=True)
+
+
+def test_engine_paged_parity_no_sharing(gpt_fix):
+    """Same schedule with prefix_sharing OFF — parity must not depend
+    on the sharing machinery, and no COW can ever fire."""
+    model, reqs = gpt_fix
+    engine = Engine(model, n_slots=2, max_seq_len=32,
+                    registry=MetricsRegistry(),
+                    **(PAGED_KW | {"prefix_sharing": False}))
+    results = _run_schedule(engine, reqs, bursts=[2, 1, 2])
+    _assert_parity(results, reqs)
+    assert engine._paged.alloc.cow_copies == 0
+    assert engine._paged.prefix_hit_rate() == 0.0
+    engine._paged.audit(expect_empty=True)
+
+
+def test_engine_paged_prefix_sharing_hits_and_cow(prefix_fix):
+    """Requests sharing a long system prefix: later arrivals attach the
+    first's registered pages (concurrent AND after it finished —
+    temporal reuse through the cached list), COW fires on divergent
+    tails, and every stream stays bit-identical to one-shot."""
+    model, reqs = prefix_fix
+    engine = Engine(model, n_slots=2, max_seq_len=48,
+                    registry=MetricsRegistry(),
+                    **(PAGED_KW | {"n_pages": 36}))
+    # wave 1: two shared-prefix requests concurrently; wave 2 arrives
+    # AFTER wave 1 finished (temporal hits via the cached pages)
+    results = _run_schedule(engine, reqs, bursts=[2, 0, 0, 0, 0, 0, 0, 0,
+                                                  2, 0, 0, 0, 0, 0, 0, 0,
+                                                  2])
+    _assert_parity(results, reqs)
+    assert engine._paged.alloc.prefix_hits >= 2
+    assert engine._paged.prefix_hit_rate() > 0.1
+    assert len(engine.traces["step"]) == 1
+    engine._paged.audit(expect_empty=True)
+
+
+def test_engine_paged_parity_families(family_fix):
+    """All three families over the paged path, chunked prefill and
+    GQA included (the Mixtral regime note lives on the fixture)."""
+    model, reqs = family_fix
+    engine = Engine(model, n_slots=2, max_seq_len=32,
+                    registry=MetricsRegistry(), **PAGED_KW)
+    results = _run_schedule(engine, reqs, bursts=[2, 1])
+    _assert_parity(results, reqs)
+    engine._paged.audit(expect_empty=True)
+
+
+def test_engine_paged_no_retrace_across_alloc_free_cycles(gpt_fix):
+    """Many waves through a SMALL pool: pages allocate, free, re-enter
+    as cached, get evicted — the decode step must stay at ONE compile
+    throughout (tables are traced arguments, never shapes)."""
+    model, reqs = gpt_fix
+    engine = Engine(model, n_slots=2, max_seq_len=32,
+                    registry=MetricsRegistry(),
+                    **(PAGED_KW | {"n_pages": 10}))
+    for wave in range(3):
+        results = _run_schedule(engine, reqs[:4], bursts=[2, 2])
+        _assert_parity(results, reqs[:4])
+    assert len(engine.traces["step"]) == 1
+    assert len(engine.traces["prefill"]) <= len(engine._paged.chunk_ladder)
+    engine._paged.audit(expect_empty=True)
+
+
+def test_budget_aware_rejection_both_impls(gpt_fix):
+    """ISSUE 9 satellite: under paged the submit limit is
+    max_pages_per_seq*page_size; under slab it stays T_max — and the
+    rejection record names which limit fired."""
+    model, _ = gpt_fix
+    # slab: T_max binds
+    reg = MetricsRegistry()
+    slab = Engine(model, n_slots=1, max_seq_len=16, registry=reg)
+    rid = slab.submit(list(range(12)), max_new_tokens=8)
+    done = slab.drain()
+    assert done[0].req_id == rid and done[0].finish_reason == "rejected"
+    assert done[0].reject_limit == "max_seq_len"
+    assert reg.snapshot()["counters"]["serve_rejected"] == 1
+    # paged: the page budget binds BELOW T_max
+    reg = MetricsRegistry()
+    paged = Engine(model, n_slots=1, max_seq_len=32, registry=reg,
+                   kv_impl="paged", page_size=8, n_pages=8,
+                   max_pages_per_seq=2, prefill_chunk=8)
+    assert paged.max_total_tokens == 16
+    rid = paged.submit(list(range(12)), max_new_tokens=8)  # 20 > 16
+    done = paged.drain()
+    assert done[0].finish_reason == "rejected"
+    assert done[0].reject_limit == "page_budget"
+    # ... while the same shape FITS the budget and serves normally
+    ok = paged.submit(list(range(10)), max_new_tokens=6)
+    out = {f.req_id: f for f in paged.drain()}
+    assert out[ok].finish_reason in ("stop", "length")
+    assert len(paged.traces["prefill"]) >= 1
+
+
+def test_router_budget_aware_rejection_paged():
+    """The router's front door uses the ENGINE's effective limit (page
+    budget, not T_max) and stamps reject_limit on the refusal."""
+    model = GPT(GPT_TINY, rngs=nnx.Rngs(0))
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=1, n_slots=1, max_seq_len=32,
+                    registry=reg,
+                    engine_kwargs=dict(kv_impl="paged", page_size=8,
+                                       n_pages=8, max_pages_per_seq=2,
+                                       prefill_chunk=8))
+    assert router.max_total_tokens == 16
+    router.submit(list(range(12)), max_new_tokens=8)
+    done = router.drain()
+    assert done[0].finish_reason == "rejected"
+    assert done[0].reject_limit == "page_budget"
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_page_leak_audit_on_evict_and_deadline(gpt_fix):
+    """Every release path returns its pages: deadline eviction of a
+    LIVE slot, host-driven evict() of a MID-PREFILL request, and
+    drain() — each followed by a clean audit (drain/evict run it
+    internally; a poisoned allocator raises instead)."""
+    model, reqs = gpt_fix
+    clk = _Clock()
+    reg = MetricsRegistry()
+    engine = Engine(model, n_slots=2, max_seq_len=32, registry=reg,
+                    clock=clk, **PAGED_KW)
+    kw, ref = reqs[1]
+    sid = engine.submit(**kw)
+    tid = engine.submit([5, 6, 7], max_new_tokens=MAX_NEW,
+                        deadline_ms=50.0)
+    engine.step()
+    clk.t = 0.2
+    done = engine.step()   # deadline evicts tid's live slot
+    assert [f.req_id for f in done] == [tid]
+    assert done[0].finish_reason == "timeout"
+    # a long prompt mid-chunked-prefill, evicted by the host (the
+    # process-backend deadline path)
+    lid = engine.submit([int(t) for t in range(1, 25)],
+                        max_new_tokens=4)
+    engine.step()          # first chunk only (prefill_chunk=8 < 24)
+    assert engine._paged.prefill, "expected a mid-prefill slot"
+    out = engine.evict([lid])   # audits internally
+    assert [f.req_id for f in out] == [lid]
+    assert out[0].finish_reason == "timeout" and out[0].n_out == 0
+    rest = {f.req_id: f for f in engine.drain()}  # audits empty
+    assert rest[sid].tokens == ref
+
+
+def test_router_paged_failover_mid_chunked_prefill():
+    """ISSUE 9 acceptance: a replica dies while a request is mid-
+    chunked-prefill — the router re-prefills it from scratch elsewhere,
+    the completed output is bit-identical to one-shot, and NO parent-
+    side bookkeeping leaks (router maps empty; the dead replica's
+    allocator resets on revive and audits clean)."""
+    model = GPT(GPT_TINY, rngs=nnx.Rngs(0))
+    prompt = [int(t) for t in np.random.default_rng(7).integers(0, 64, 24)]
+    rng_key = jax.random.key(42)
+    ref = [int(t) for t in np.asarray(generate_cached(
+        model, rng_key, jnp.asarray(prompt, jnp.int32)[None], MAX_NEW,
+        temperature=1.0, top_k=8))[0]]
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=2, n_slots=2, max_seq_len=32,
+                    registry=reg,
+                    engine_kwargs=dict(**PAGED_KW))
+    rid = router.submit(prompt, max_new_tokens=MAX_NEW, temperature=1.0,
+                        top_k=8, rng=rng_key)
+    router.step()  # dispatched; first chunk ran (prefill_chunk=8 < 24)
+    victim = router._where[rid]
+    assert router.replicas[victim].engine._paged.prefill, \
+        "expected the request to be mid-chunked-prefill"
+    router.kill_replica(victim)
+    done = {f.req_id: f for f in router.drain()}
+    assert done[rid].tokens == ref
+    assert done[rid].failovers == 1
+    assert reg.snapshot()["counters"]["serve_failovers"] == 1
+    # parent-side leak audit (ISSUE 9 satellite)
+    assert router._by_replica[victim] == {} and router._where == {} \
+        and router._open == {}
+    router.revive_replica(victim)   # reset_host_state -> fresh allocator
+    router.replicas[victim].engine._paged.audit(expect_empty=True)
+    for rep in router.replicas:
+        rep.engine._paged.audit(expect_empty=True)
+
+
+def test_engine_paged_metrics(gpt_fix):
+    """The four ISSUE 9 metrics flow through the schema-checked
+    registry, and stats() carries the page budget for worker
+    heartbeats."""
+    model, reqs = gpt_fix
+    reg = MetricsRegistry()
+    engine = Engine(model, n_slots=2, max_seq_len=32, registry=reg,
+                    **PAGED_KW)
+    _run_schedule(engine, reqs[:4], bursts=[2, 2])
+    snap = reg.snapshot()
+    assert snap["counters"]["prefill_chunks"] >= 4
+    assert snap["gauges"]["kv_pages_free"] == engine.n_pages
+    assert snap["gauges"]["kv_page_util"] == 0.0   # drained
+    assert 0.0 <= snap["gauges"]["prefix_hit_rate"] <= 1.0
+    s = engine.stats()
+    assert s["kv"]["impl"] == "paged"
+    assert s["kv"]["n_pages"] == engine.n_pages
+    assert s["kv"]["pages_free"] == engine.n_pages
+    assert s["prefilling"] == 0
+
+
+@pytest.mark.slow
+def test_prefix_sharing_soak():
+    """E2E soak: 24 requests over a small pool, most sharing one system
+    prompt, arrivals forcing temporal reuse, eviction cycles and COW —
+    sampled bit-parity, clean audit, ONE decode compile."""
+    model = GPT(GPT_TINY, rngs=nnx.Rngs(0))
+    rng = np.random.default_rng(11)
+    prefix = [int(t) for t in rng.integers(0, 64, (17,))]
+    reqs = _mk_requests(model, rng, 24, max_prompt=10,
+                        shared_prefix=prefix, combos=((1.0, 8), (0.9, None)))
+    engine = Engine(model, n_slots=3, max_seq_len=48,
+                    registry=MetricsRegistry(),
+                    kv_impl="paged", page_size=8, n_pages=30,
+                    prefill_chunk=16)
+    results = _run_schedule(engine, reqs,
+                            bursts=[3, 0, 2, 0, 0, 1] * 8)
+    _assert_parity(results, reqs)
+    assert engine._paged.alloc.prefix_hits >= 6
+    assert len(engine.traces["step"]) == 1
+    engine._paged.audit(expect_empty=True)
+
+
+@pytest.mark.slow
+def test_chaos_kills_during_paged_serving():
+    """Chaos: seeded kills through the router while paged replicas hold
+    queued, mid-prefill and decoding work — zero lost, all served
+    outputs bit-identical, no bookkeeping leaks."""
+    model = GPT(GPT_TINY, rngs=nnx.Rngs(0))
+    rng = np.random.default_rng(5)
+    reqs = _mk_requests(model, rng, 10, max_prompt=20,
+                        combos=((1.0, 8),))
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=2, n_slots=2, max_seq_len=32,
+                    registry=reg, engine_kwargs=dict(**PAGED_KW))
+    ids = {}
+    pending = list(range(len(reqs)))
+    results = {}
+    kill_rng = np.random.default_rng(99)
+    steps = 0
+    while pending or router.open_requests or router._pending:
+        for _ in range(min(2, len(pending))):
+            i = pending.pop(0)
+            ids[router.submit(**reqs[i][0])] = i
+        for f in router.step():
+            results[ids[f.req_id]] = f
+        steps += 1
+        if steps in (3, 9):   # seeded kills mid-flight
+            alive = [r for r in router.replicas if r.state != "dead"]
+            if len(alive) == 2:
+                victim = alive[int(kill_rng.integers(0, 2))]
+                router.kill_replica(victim.replica_id)
+        if steps in (6, 12):  # revive so the fleet can finish
+            for r in router.replicas:
+                if r.state == "dead":
+                    router.revive_replica(r.replica_id)
+    assert len(results) == len(reqs)
+    for i, (kw, ref) in enumerate(reqs):
+        assert results[i].tokens == ref, f"request {i} diverged"
+    assert router._open == {} and router._where == {}
+    for r in router.replicas:
+        if r.state != "dead":
+            r.engine._paged.audit()
+
+
+@pytest.mark.slow
+def test_worker_process_paged_handshake_and_parity():
+    """Process backend with kv_impl=paged: the hello carries the page
+    knobs out and the page budget back, heartbeats mirror the paging
+    pressure parent-side, outputs stay bit-identical, and a dead
+    worker's parent-side request bookkeeping is cleared (leak audit)."""
+    model = GPT(GPT_TINY, rngs=nnx.Rngs(0))
+    reqs = _mk_requests(model, np.random.default_rng(4), 3,
+                        combos=((1.0, 8),))
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=1, n_slots=2, max_seq_len=32,
+                    registry=reg, backend="process",
+                    engine_kwargs=dict(**PAGED_KW))
+    try:
+        rep = router.replicas[0]
+        assert rep.engine.kv_impl == "paged"
+        assert rep.engine.max_total_tokens == 32
+        ids = {router.submit(**kw): i for i, (kw, _) in enumerate(reqs)}
+        done = {ids[f.req_id]: f for f in router.drain()}
+        for i, (kw, ref) in enumerate(reqs):
+            assert done[i].tokens == ref
+        assert rep.engine.kv is not None
+        assert rep.engine.kv["impl"] == "paged"
+        assert rep.engine.kv["pages_free"] == PAGED_KW["n_pages"]
+        rep.mark_dead()
+        assert rep._submit_t == {} and rep._deadline == {} \
+            and rep._t_first == {}
+    finally:
+        router.close()
+
+
+@pytest.mark.slow
+def test_serve_bench_sweep_smoke(tmp_path):
+    """tools/serve_bench.py --sweep end-to-end on tiny settings: both
+    impls swept, the BENCH JSON lands with the expected shape."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "bench_paged.json"
+    r = subprocess.run(
+        [sys.executable, "tools/serve_bench.py", "--sweep",
+         "--block_size=64", "--kv_budget_tokens=256", "--page_size=8",
+         "--shared_prefix=24", "--tail_min=4", "--tail_max=12",
+         "--max_new_tokens=4", "--sweep_requests=8",
+         "--max_concurrency=8", "--n_layer=1", "--n_embd=32",
+         f"--out={out}"],
+        cwd=repo, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode in (0, 1), r.stdout + r.stderr  # 1 = ratio < 2
+    bench = json.loads(out.read_text())
+    assert bench["kind"] == "paged_kv_sweep"
+    for impl in ("slab", "paged"):
+        assert "max_sustainable_concurrency" in bench[impl]
+        assert bench[impl]["trials"]
+    assert "concurrency_ratio" in bench
