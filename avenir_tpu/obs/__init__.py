@@ -7,6 +7,8 @@ Dependency-free (stdlib; jax only for trace annotations, optional):
 - sink.py:    JSONL run log (out_dir/metrics.jsonl), coordinator-owned
 - spans.py:   phase spans feeding both XProf and the registry
 - watchdog.py: stall watchdog for silently hung pod collectives
+- trace.py:   per-request trace events + ring-buffer flight recorder +
+              Perfetto (Chrome trace JSON) export (ISSUE 10)
 - report.py:  metrics.jsonl -> goodput/timing summary (tools/obs_report.py)
 """
 
@@ -18,9 +20,24 @@ from avenir_tpu.obs.metrics import (
 )
 from avenir_tpu.obs.sink import RECORD_KINDS, JsonlSink, NullSink
 from avenir_tpu.obs.spans import span
+from avenir_tpu.obs.trace import (
+    TRACE_EVENTS,
+    TraceBuffer,
+    Tracer,
+    chrome_trace,
+    get_tracer,
+    install_crash_hooks,
+    disarm_crash_hooks,
+    request_segments,
+    set_tracer,
+    ttft_attribution,
+)
 from avenir_tpu.obs.watchdog import StallWatchdog
 
 __all__ = [
     "METRIC_SCHEMA", "MetricsRegistry", "get_registry", "reset_registry",
     "RECORD_KINDS", "JsonlSink", "NullSink", "span", "StallWatchdog",
+    "TRACE_EVENTS", "TraceBuffer", "Tracer", "chrome_trace",
+    "get_tracer", "set_tracer", "request_segments", "ttft_attribution",
+    "install_crash_hooks", "disarm_crash_hooks",
 ]

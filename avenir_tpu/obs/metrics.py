@@ -111,6 +111,17 @@ METRIC_SCHEMA = {
     # -- watchdog --
     "watchdog_stalls": (
         "counter", "1", "stall-watchdog warnings fired"),
+    # -- request tracing / flight recorder (obs/trace.py, ISSUE 10) --
+    "trace_events_dropped": (
+        "counter", "1",
+        "trace events dropped by a bounded ring or buffer (oldest "
+        "first) — the flight recorder never grows unbounded, and never "
+        "drops silently either"),
+    "flight_dumps": (
+        "counter", "1",
+        "flight-recorder dumps written (out_dir/flight-*.jsonl): "
+        "watchdog fire, worker death, drain failure, or unhandled "
+        "crash via the obs/trace.py crash hooks"),
     # -- pipeline parallelism (parallel/pipeline.py) --
     "pp_bubble_frac": (
         "gauge", "1",

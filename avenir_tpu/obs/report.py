@@ -23,37 +23,54 @@ import math
 import statistics
 
 
-def load_records(path):
-    """Parse a metrics.jsonl. A killed run (SIGKILL, ENOSPC) can leave a
-    torn final line — skip unparseable lines with a warning instead of
-    crashing on exactly the logs a crashed run leaves behind."""
+def load_records_with_skips(path):
+    """Parse a metrics.jsonl; returns (records, skipped_line_numbers).
+
+    A killed run (SIGKILL, ENOSPC) can truncate the final line
+    MID-RECORD — including mid-multibyte-character, which used to raise
+    UnicodeDecodeError out of text-mode iteration and crash the report
+    on exactly the logs a crashed run leaves behind. Read bytes, decode
+    and parse per line, and SKIP what doesn't parse; the skip is
+    surfaced in the report output (and on stderr), never silent."""
     import sys
 
-    records = []
-    with open(path) as f:
-        for lineno, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
+    records, skipped = [], []
+    with open(path, "rb") as f:
+        # iterate BYTES lines (streaming — a multi-day log never sits in
+        # memory whole; binary iteration also never decodes, so the torn
+        # multibyte tail surfaces at json-parse time, per line)
+        for lineno, raw in enumerate(f, 1):
+            raw = raw.strip()
+            if not raw:
                 continue
             try:
-                records.append(json.loads(line))
-            except json.JSONDecodeError:
+                records.append(json.loads(raw.decode("utf-8")))
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                skipped.append(lineno)
                 print(f"[obs_report] skipping unparseable line {lineno} "
                       f"of {path} (torn write from a killed run?)",
                       file=sys.stderr)
-    return records
+    return records, skipped
+
+
+def load_records(path):
+    """Parse a metrics.jsonl, torn lines skipped (see
+    load_records_with_skips for the skip accounting)."""
+    return load_records_with_skips(path)[0]
 
 
 def _by_kind(records, kind):
     return [r for r in records if r.get("kind") == kind]
 
 
-def summarize(records):
+def summarize(records, *, skipped_lines=()):
     """Compute the goodput breakdown + run facts from parsed records.
     Returns a plain dict (format_report renders it). A resumed run's log
     holds one SEGMENT per launch (each starting with run_meta, appended
     by the sink); the summary covers the last segment — earlier segments
-    stay on disk and can be sliced out by their run_meta records."""
+    stay on disk and can be sliced out by their run_meta records.
+    `skipped_lines`: line numbers load_records_with_skips dropped (torn
+    writes) — noted in the report so a truncated log reads as one."""
     assert records, "empty metrics log"
     metas = [i for i, r in enumerate(records) if r.get("kind") == "run_meta"]
     n_segments = len(metas)
@@ -128,6 +145,7 @@ def summarize(records):
     return {
         "serve": serve,
         "meta": meta,
+        "skipped_lines": list(skipped_lines),
         "n_segments": n_segments,
         "total_ms": total_ms,
         "components": components,
@@ -177,6 +195,13 @@ def format_report(s):
     meta = s["meta"]
     lines = []
     lines.append("== avenir run report ==")
+    if s.get("skipped_lines"):
+        sk = s["skipped_lines"]
+        lines.append(f"(skipped {len(sk)} unparseable log line(s) "
+                     f"[{', '.join(str(n) for n in sk[:8])}"
+                     f"{', ...' if len(sk) > 8 else ''}] — torn write "
+                     "from a killed run; totals may undercount the "
+                     "final instants)")
     if s.get("n_segments", 1) > 1:
         lines.append(f"(resumed run: {s['n_segments']} segments in the log; "
                      "summarizing the last)")
@@ -280,5 +305,5 @@ def format_report(s):
 
 def main(argv):
     assert len(argv) == 1, "usage: python tools/obs_report.py <metrics.jsonl>"
-    records = load_records(argv[0])
-    print(format_report(summarize(records)))
+    records, skipped = load_records_with_skips(argv[0])
+    print(format_report(summarize(records, skipped_lines=skipped)))

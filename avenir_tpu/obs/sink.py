@@ -23,6 +23,7 @@ RECORD_KINDS = {
     "compile",    # per first-dispatch of a window length: compile wall
     "stall",      # watchdog warning: seconds since last progress
     "request",    # per finished serve-engine request: ttft/tpot/tokens
+    "trace",      # one per-request trace event (obs/trace.py, --trace)
     "retry",      # per transient-IO retry (utils/retry.py): site + delay
     "restore",    # per resume source decision: dir, kind, fallback count
     "run_end",    # one per run, at exit: final counter snapshot

@@ -14,6 +14,7 @@ import time
 from contextlib import contextmanager, nullcontext
 
 from avenir_tpu.obs.metrics import get_registry
+from avenir_tpu.obs.trace import get_tracer
 
 try:
     from jax.profiler import StepTraceAnnotation, TraceAnnotation
@@ -45,3 +46,11 @@ def span(name, *, counter=None, hist=None, step_num=None, registry=None):
         c.add(dt_ms)
         if h is not None:
             h.observe(dt_ms)
+        tr = get_tracer()
+        if tr is not None:
+            # phase spans ride the trace too (ISSUE 10): the SAME name
+            # in XProf, metrics.jsonl, and the Perfetto export. The
+            # start is left to the tracer's own clock (now - duration)
+            # so spans share the request events' time base even under
+            # an injected test clock
+            tr.span(name, dur_ms=dt_ms)

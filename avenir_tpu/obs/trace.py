@@ -1,0 +1,539 @@
+"""Per-request causal tracing + flight recorder (ISSUE 10 tentpole).
+
+The metrics registry answers "how much, in aggregate"; this module
+answers "where did request X's 640 ms TTFT go" — queue, admission,
+prefill chunks, a failover, a respawn backoff — across a fleet whose
+replicas live in other PROCESSES. Three pieces:
+
+- **TraceBuffer** — the per-engine event collector. Emission is one
+  attribute check away from free: every instrumented site holds
+  `tr = self._tr` and branches on `tr is not None`, so the hot decode
+  tick pays ONE predictable-not-taken branch when tracing is off (the
+  tier-1 micro-assert pins this). Buffers are bounded (oldest dropped,
+  drops counted) and drained every engine step — by the in-process
+  Replica directly, or by the worker into its step-reply frame.
+
+- **Tracer** — the fleet-level recorder. A bounded ring of the most
+  recent events (the FLIGHT RECORDER: dropped events are counted in
+  `trace_events_dropped`, never silently, and memory never grows with
+  run length), absorbed from replica buffers with engine-rid -> fleet-
+  rid translation and CLOCK RESTAMPING: worker events cross the pipe as
+  clock-free age deltas (`age_s` = worker-now - event-time at reply
+  build) and are restamped `parent_now - age_s` on arrival — the same
+  TTFT-restamp pattern serve/proc.py established, because a worker's
+  clock is unrelated to the fleet's. Restamped times are clamped
+  per-request monotone (pipe-latency jitter must never make a trace
+  tree run backwards; pinned by tests/test_trace.py).
+
+- **Exports** — `flight_dump()` writes the ring to
+  `out_dir/flight-<reason>-NNN.jsonl` on incidents (watchdog fire,
+  worker death, drain failure, unhandled crash via
+  `install_crash_hooks`); `chrome_trace()` renders events as Chrome
+  trace-event JSON that opens directly in Perfetto — request waterfalls
+  as per-rid tracks (queue / prefill / failover / decode slices derived
+  by `request_segments`) next to the training/serving phase spans
+  obs/spans.py already times.
+
+Event vocabulary (TRACE_EVENTS): one `finish` terminal event per
+request — exactly one, whatever the finish_reason path (pinned) — plus
+the lifecycle and incident events around it. Events are plain dicts
+{"rid", "ev", "t", ...attrs}; `t` is clock seconds (the fleet clock,
+injectable in tests), serialized as `ts` so JSONL records keep `t` for
+wall time like every other sink record.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+from avenir_tpu.obs.metrics import get_registry
+
+# the event vocabulary; docs/OBSERVABILITY.md "Tracing & flight
+# recorder" documents each. Emitting an unknown event fails loud (the
+# METRIC_SCHEMA policy applied to traces).
+TRACE_EVENTS = {
+    "submit",        # request entered the router front door
+    "admit",         # passed door admission (queued for dispatch)
+    "dispatch",      # handed to a replica engine
+    "engine_admit",  # engine granted a slot (prefill begins)
+    "prefill_chunk", # one prefill dispatch (slab: the whole prompt)
+    "prefix_hit",    # paged admission attached shared prefix pages
+    "cow",           # copy-on-write page copy for this request
+    "first_token",   # first sampled token landed
+    "decode_tick",   # sampled batched decode iteration (rid=None)
+    "evict",         # deadline eviction from a held slot
+    "failover",      # the replica holding this request died
+    "requeue",       # re-queued (front of class) for a fresh dispatch
+    "finish",        # THE terminal event: reason in attrs, one per rid
+    "span",          # a host phase span (obs/spans.py; rid=None)
+}
+
+TERMINAL = "finish"
+
+
+class TraceBuffer:
+    """Per-engine bounded event collector (host-side, single-threaded —
+    the engine's own thread is the only writer). Drained every step by
+    whoever owns the engine; `dropped` rides along so bounded buffering
+    is never silent loss."""
+
+    __slots__ = ("clock", "cap", "events", "dropped", "decode_sample")
+
+    def __init__(self, clock=None, cap=4096, decode_sample=8):
+        self.clock = clock if clock is not None else time.perf_counter
+        self.cap = int(cap)
+        self.events = []
+        self.dropped = 0
+        self.decode_sample = max(1, int(decode_sample))
+
+    def emit(self, rid, ev, t=None, **attrs):
+        assert ev in TRACE_EVENTS, (
+            f"unknown trace event {ev!r} — add it to trace.TRACE_EVENTS "
+            "and the docs/OBSERVABILITY.md event table")
+        if len(self.events) >= self.cap:
+            del self.events[0]
+            self.dropped += 1
+        e = {"rid": rid, "ev": ev,
+             "t": self.clock() if t is None else float(t)}
+        if attrs:
+            e.update(attrs)
+        self.events.append(e)
+
+    def drain(self):
+        """Return and clear the buffered events (+ the drop count since
+        the last drain, folded into the first event's owner)."""
+        out, self.events = self.events, []
+        return out
+
+    def drain_aged(self, now=None):
+        """Drain with each event's `t` replaced by `age_s` = now - t:
+        the clock-free form that crosses a process boundary (pipes do
+        not share clocks; serve/worker.py ships this in step replies)."""
+        now = self.clock() if now is None else now
+        out = []
+        for e in self.drain():
+            e["age_s"] = max(0.0, now - e.pop("t"))
+            out.append(e)
+        return out
+
+
+class Tracer:
+    """Fleet-level flight recorder: bounded ring, restamp+translate
+    absorption, incident dumps, Chrome trace export.
+
+    Thread-safe on the append/read surface — the stall watchdog dumps
+    the ring from its own thread while the fleet loop appends."""
+
+    def __init__(self, *, capacity=8192, registry=None, clock=None,
+                 out_dir=None, decode_sample=8, max_dumps=64):
+        """`capacity`: ring size (oldest dropped + counted beyond it).
+        `out_dir`: where flight dumps land (None = dumps disabled).
+        `decode_sample`: engines emit one `decode_tick` event per this
+        many batched decode iterations — the hot tick must not write an
+        event per token even when tracing is ON."""
+        self._ring = deque()
+        self.capacity = int(capacity)
+        self._reg = registry if registry is not None else get_registry()
+        self.clock = clock if clock is not None else time.perf_counter
+        self.out_dir = out_dir
+        self.decode_sample = max(1, int(decode_sample))
+        self.max_dumps = int(max_dumps)
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._last_t = {}   # rid -> last appended t (monotone clamp)
+        self._n_dumps = 0
+
+    # -- emission --
+
+    def emit(self, rid, ev, t=None, **attrs):
+        assert ev in TRACE_EVENTS, (
+            f"unknown trace event {ev!r} — add it to trace.TRACE_EVENTS "
+            "and the docs/OBSERVABILITY.md event table")
+        e = {"rid": rid, "ev": ev,
+             "t": self.clock() if t is None else float(t)}
+        if attrs:
+            e.update(attrs)
+        self._append(e)
+
+    def span(self, name, t0=None, dur_ms=0.0):
+        """One host phase span (obs/spans.py feeds this when a process
+        tracer is installed): rendered as a Perfetto slice. With
+        `t0=None` the start is derived from THIS tracer's clock
+        (now - duration), so span and request events share one time
+        base even under an injected test clock."""
+        if t0 is None:
+            t0 = self.clock() - float(dur_ms) / 1e3
+        self._append({"rid": None, "ev": "span", "t": float(t0),
+                      "name": name, "dur_ms": float(dur_ms)})
+
+    def absorb(self, events, *, rid_map=None, replica=None, now=None,
+               dropped=0):
+        """Fold a drained replica buffer into the ring. Events carrying
+        `age_s` (a worker's clock-free form) are restamped `now - age_s`
+        on THIS tracer's clock; `rid_map` translates engine-local rids
+        to fleet rids (an unmapped rid keeps its engine id under
+        `eng_rid` with rid=None — never silently lost, but never
+        miscredited to another fleet request either)."""
+        now = self.clock() if now is None else now
+        for e in events:
+            e = dict(e)
+            if "age_s" in e:
+                e["t"] = now - float(e.pop("age_s"))
+            if replica is not None:
+                e["replica"] = replica
+            if rid_map is not None and e.get("rid") is not None:
+                fleet = rid_map.get(e["rid"])
+                if fleet is None:
+                    e["eng_rid"], e["rid"] = e["rid"], None
+                else:
+                    e["rid"] = fleet
+            self._append(e)
+        if dropped:
+            with self._lock:
+                self.dropped += int(dropped)
+            self._reg.counter("trace_events_dropped").add(int(dropped))
+
+    def _append(self, e):
+        rid = e.get("rid")
+        with self._lock:
+            if rid is not None:
+                # per-request monotone clamp: restamped cross-process
+                # events carry pipe-latency jitter; a trace tree must
+                # never run backwards (tests pin this)
+                last = self._last_t.get(rid)
+                if last is not None and e["t"] < last:
+                    e["t"] = last
+                if e["ev"] == TERMINAL:
+                    self._last_t.pop(rid, None)  # bound the clamp map
+                else:
+                    self._last_t[rid] = e["t"]
+            if len(self._ring) >= self.capacity:
+                self._ring.popleft()
+                self.dropped += 1
+                self._reg.counter("trace_events_dropped").add(1)
+            self._ring.append(e)
+
+    # -- read surface --
+
+    def events(self):
+        with self._lock:
+            return list(self._ring)
+
+    def events_for(self, rid):
+        with self._lock:
+            return [e for e in self._ring if e.get("rid") == rid]
+
+    def __len__(self):
+        return len(self._ring)
+
+    # -- exports --
+
+    def flight_dump(self, reason, out_dir=None):
+        """Dump the ring (the last `capacity` events) to
+        `<dir>/flight-<reason>-NNN.jsonl` — the black box an operator
+        reads after an incident (docs/OPERATIONS.md). Returns the path,
+        or None when no dump directory is configured or the dump-count
+        cap is hit. Never raises: a diagnostics failure must not worsen
+        the incident it is recording (the watchdog's policy)."""
+        d = out_dir if out_dir is not None else self.out_dir
+        if d is None:
+            return None
+        try:
+            with self._lock:
+                # check-and-increment under the lock: a watchdog-thread
+                # dump racing a fleet-loop one must not reuse a filename
+                # (one incident overwriting another) or overshoot the cap
+                if self._n_dumps >= self.max_dumps:
+                    return None
+                self._n_dumps += 1
+                seq = self._n_dumps
+                events = list(self._ring)
+                dropped = self.dropped
+            safe = "".join(c if c.isalnum() or c in "-_" else "-"
+                           for c in str(reason))
+            path = os.path.join(d, f"flight-{safe}-{seq:03d}.jsonl")
+            os.makedirs(d, exist_ok=True)
+            with open(path, "w") as f:
+                f.write(json.dumps({
+                    "kind": "flight_meta", "t": time.time(),
+                    "reason": str(reason), "n_events": len(events),
+                    "dropped_before_ring": dropped,
+                }) + "\n")
+                for e in events:
+                    f.write(json.dumps(event_record(e)) + "\n")
+            self._reg.counter("flight_dumps").add(1)
+            return path
+        except Exception:  # noqa: BLE001 — diagnostics must not throw
+            return None
+
+    def write_events_jsonl(self, path):
+        """Every ring event as one `trace` record per line — the
+        tools/trace_report.py input (also what serve_bench forwards to
+        the metrics JSONL under --trace)."""
+        with open(path, "w") as f:
+            for e in self.events():
+                f.write(json.dumps(event_record(e)) + "\n")
+        return path
+
+    def chrome(self, **kw):
+        return chrome_trace(self.events(), **kw)
+
+
+def event_record(e):
+    """Serialize an internal event for a JSONL sink: the clock time
+    moves to `ts` (monotone/injectable clock seconds) so `t` stays wall
+    time like every other record kind."""
+    rec = {"kind": "trace", "ts": e["t"]}
+    rec.update({k: v for k, v in e.items() if k != "t"})
+    return rec
+
+
+def record_event(rec):
+    """Inverse of event_record (reading a trace JSONL back)."""
+    e = {k: v for k, v in rec.items() if k not in ("kind", "ts", "t")}
+    e["t"] = float(rec["ts"]) if "ts" in rec else float(rec.get("t", 0.0))
+    return e
+
+
+# ---------------------------------------------------------------------------
+# Waterfall segmentation (shared by the exporter and trace_report)
+# ---------------------------------------------------------------------------
+
+
+def request_segments(events):
+    """Partition one request's timeline into labeled segments:
+
+        queue     submitted/requeued, waiting for a dispatch
+        prefill   dispatched, working toward its first token
+        failover  time sunk into an attempt whose replica died (the
+                  work was discarded — re-prefill starts from scratch)
+        decode    first token -> finish
+
+    The segments PARTITION [submit, finish] by construction (each event
+    closes the previous segment at its own timestamp), which is what
+    lets trace_report attribute a TTFT exactly: queue + prefill +
+    failover sums to first_token - submit with no residue. A failover
+    retroactively relabels its whole attempt (dispatch onward — prefill
+    AND any decoded tokens) as failover loss: the work was discarded,
+    whatever it was called while it ran."""
+    evs = sorted((e for e in events if e.get("ev") != "span"),
+                 key=lambda e: e["t"])  # stable: ties keep append order
+    segs = []
+    state, t0 = None, None
+    attempt_at = 0  # first segment index of the current attempt
+
+    def close(kind, t1):
+        nonlocal t0
+        if t0 is not None and t1 > t0:  # zero-length segments (e.g. a
+            segs.append((kind, t0, t1))  # failover+requeue at the same
+        t0 = t1                          # instant) contribute nothing
+
+    for e in evs:
+        ev, t = e["ev"], e["t"]
+        if ev == "submit":
+            state, t0 = "queue", t
+        elif ev == "dispatch":
+            if state is not None:
+                close(state, t)
+            state = "prefill"
+            attempt_at = len(segs)
+        elif ev in ("failover", "requeue"):
+            if state is not None:
+                close(state, t)
+                # the dead attempt's time — prefill underway, tokens
+                # already decoded — died with the replica: relabel it
+                # failover loss. Queue wait is untouched (nothing was
+                # lost there; the wait just grew).
+                for i in range(attempt_at, len(segs)):
+                    k, a, b = segs[i]
+                    if k in ("prefill", "decode"):
+                        segs[i] = ("failover", a, b)
+            state = "queue"
+        elif ev == "first_token":
+            if state is not None:
+                close(state or "prefill", t)
+            state = "decode"
+        elif ev == TERMINAL:
+            if state is not None:
+                close(state, t)
+            state, t0 = None, None
+    return segs
+
+
+def ttft_attribution(events):
+    """{"ttft_s", "queue_s", "prefill_s", "failover_s"} for one
+    request's events, or None when it never produced a token. The three
+    components sum to ttft_s exactly (request_segments partitions)."""
+    firsts = [e["t"] for e in events if e.get("ev") == "first_token"]
+    submits = [e["t"] for e in events if e.get("ev") == "submit"]
+    if not firsts or not submits:
+        return None
+    t_first = max(firsts)  # the attempt that survived (failover
+    #                        discards earlier attempts' tokens)
+    out = {"ttft_s": t_first - submits[0],
+           "queue_s": 0.0, "prefill_s": 0.0, "failover_s": 0.0}
+    for kind, a, b in request_segments(events):
+        if b <= t_first and kind in ("queue", "prefill", "failover"):
+            out[kind + "_s"] += b - a
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event JSON (Perfetto / chrome://tracing)
+# ---------------------------------------------------------------------------
+
+_SEG_PID = 1      # request waterfalls
+_SPAN_PID = 2     # host phase spans (obs/spans.py)
+_ENGINE_PID = 3   # rid-less engine events (sampled decode ticks)
+
+
+def chrome_trace(events, *, origin=None):
+    """Render events as a Chrome trace-event JSON object (the
+    `{"traceEvents": [...]}` form Perfetto and chrome://tracing load
+    directly). Each request is one track (pid 1, tid = rid) carrying
+    its queue/prefill/failover/decode slices plus an instant marker per
+    raw event; host phase spans get per-name tracks on pid 2."""
+    events = [e for e in events]
+    if origin is None:
+        origin = min((e["t"] for e in events), default=0.0)
+
+    def us(t):
+        return round((t - origin) * 1e6, 3)
+
+    out = [
+        {"ph": "M", "name": "process_name", "pid": _SEG_PID,
+         "args": {"name": "serve requests"}},
+        {"ph": "M", "name": "process_name", "pid": _SPAN_PID,
+         "args": {"name": "host phases"}},
+        {"ph": "M", "name": "process_name", "pid": _ENGINE_PID,
+         "args": {"name": "engine"}},
+    ]
+    by_rid = {}
+    span_tids = {}
+    for e in events:
+        rid = e.get("rid")
+        if e["ev"] == "span":
+            tid = span_tids.setdefault(e.get("name", "span"),
+                                       len(span_tids))
+            out.append({"ph": "X", "name": e.get("name", "span"),
+                        "cat": "phase", "pid": _SPAN_PID, "tid": tid,
+                        "ts": us(e["t"]),
+                        "dur": round(e.get("dur_ms", 0.0) * 1e3, 3)})
+            continue
+        if rid is None:
+            out.append({"ph": "i", "s": "g", "name": e["ev"],
+                        "cat": "engine", "pid": _ENGINE_PID, "tid": 0,
+                        "ts": us(e["t"]),
+                        "args": {k: v for k, v in e.items()
+                                 if k not in ("rid", "ev", "t")}})
+            continue
+        by_rid.setdefault(rid, []).append(e)
+    for rid, evs in sorted(by_rid.items()):
+        sub = next((e for e in evs if e["ev"] == "submit"), None)
+        label = f"req {rid}"
+        if sub is not None and sub.get("priority"):
+            label += f" ({sub['priority']})"
+        fin = next((e for e in evs if e["ev"] == TERMINAL), None)
+        if fin is not None and fin.get("reason"):
+            label += f" [{fin['reason']}]"
+        out.append({"ph": "M", "name": "thread_name", "pid": _SEG_PID,
+                    "tid": rid, "args": {"name": label}})
+        for kind, a, b in request_segments(evs):
+            out.append({"ph": "X", "name": kind, "cat": "request",
+                        "pid": _SEG_PID, "tid": rid, "ts": us(a),
+                        "dur": max(round((b - a) * 1e6, 3), 0.001)})
+        for e in evs:
+            out.append({"ph": "i", "s": "t", "name": e["ev"],
+                        "cat": "request", "pid": _SEG_PID, "tid": rid,
+                        "ts": us(e["t"]),
+                        "args": {k: v for k, v in e.items()
+                                 if k not in ("rid", "ev", "t")}})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# Process-global tracer handle (the set_run_sink pattern: library layers
+# with no tracer plumbed through — obs/spans.py, the watchdog — consult
+# this; outside an armed run it stays None and every consult is one
+# `is None` check)
+# ---------------------------------------------------------------------------
+
+_tracer = [None]
+
+
+def get_tracer():
+    return _tracer[0]
+
+
+def set_tracer(tracer):
+    """Install `tracer` as the process tracer; returns the previous one
+    (restore it when the run ends)."""
+    prev, _tracer[0] = _tracer[0], tracer
+    return prev
+
+
+# ---------------------------------------------------------------------------
+# Crash hooks (ISSUE 10 satellite): a run that dies on an unhandled
+# exception — or exits without reaching its normal shutdown path — must
+# still leave a final run_end counter snapshot and a flight dump behind.
+# ---------------------------------------------------------------------------
+
+_hooks = {"armed": False, "sink": None, "registry": None, "tracer": None,
+          "installed": False, "prev_excepthook": None}
+
+
+def install_crash_hooks(*, sink, registry=None, tracer=None):
+    """Arm a sys.excepthook + atexit pair that writes one final
+    `run_end` record (crashed=True, full counter snapshot) and a flight
+    dump if a tracer is active, BEFORE the interpreter dies. Idempotent
+    and re-armable; `disarm_crash_hooks()` after the normal run_end is
+    written so a clean exit emits nothing extra. The hooks fire at most
+    once per arming (the excepthook path disarms, so atexit becomes a
+    no-op)."""
+    _hooks.update(sink=sink, registry=registry, tracer=tracer, armed=True)
+    if not _hooks["installed"]:
+        _hooks["installed"] = True
+        _hooks["prev_excepthook"] = sys.excepthook
+        sys.excepthook = _crash_excepthook
+        import atexit
+
+        atexit.register(_final_flush)
+
+
+def disarm_crash_hooks():
+    _hooks["armed"] = False
+
+
+def _crash_excepthook(tp, val, tb):
+    _final_flush(error=f"{tp.__name__}: {val}")
+    prev = _hooks["prev_excepthook"] or sys.__excepthook__
+    prev(tp, val, tb)
+
+
+def _final_flush(error=None):
+    """The one-shot crash emitter (excepthook, or atexit on an exit
+    path that never disarmed). Best-effort by policy: the process is
+    already dying — diagnostics must not mask the original failure."""
+    if not _hooks["armed"]:
+        return
+    _hooks["armed"] = False
+    tracer = _hooks["tracer"] if _hooks["tracer"] is not None \
+        else get_tracer()
+    if tracer is not None:
+        tracer.flight_dump("crash")
+    sink = _hooks["sink"]
+    if sink is None:
+        return
+    try:
+        reg = _hooks["registry"] if _hooks["registry"] is not None \
+            else get_registry()
+        rec = {"kind": "run_end", "t": time.time(), "crashed": True,
+               **reg.snapshot()}
+        if error is not None:
+            rec["error"] = str(error)
+        sink.write(rec)
+    except Exception:  # noqa: BLE001 — never mask the original crash
+        pass

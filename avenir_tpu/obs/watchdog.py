@@ -165,6 +165,16 @@ class StallWatchdog:
                 faulthandler.dump_traceback(file=sys.stderr)
             except Exception:
                 pass  # never let diagnostics kill the watchdog
+        # a stall is exactly what the flight recorder exists for: dump
+        # the last-N trace events when a tracer is armed (ISSUE 10;
+        # flight_dump never raises and no-ops without an out_dir)
+        from avenir_tpu.obs.trace import get_tracer
+
+        tr = get_tracer()
+        if tr is not None:
+            path = tr.flight_dump("watchdog")
+            if path:
+                self._echo(f"[watchdog] flight recorder dumped: {path}")
         if fatal:
             # escalation (ISSUE 5 satellite): the loop is not coming
             # back — exit non-zero so a pod supervisor restarts the job

@@ -109,7 +109,7 @@ class Engine:
                  sink=None, seed=0, clock=None, kv_impl="slab",
                  page_size=16, n_pages=None, max_pages_per_seq=None,
                  prefill_chunk=None, prefix_sharing=True,
-                 paged_attn_impl="auto"):
+                 paged_attn_impl="auto", tracer=None):
         """`kv_impl` (ISSUE 9, the attn_impl/loss_impl pattern):
         'slab' keeps the fixed per-slot KV columns (serve/slots.py);
         'paged' stores KV in a pool of `n_pages` blocks of `page_size`
@@ -120,7 +120,14 @@ class Engine:
         `n_pages` defaults to the slab's KV footprint (n_slots * T_max
         tokens); `max_pages_per_seq` (default ceil(T_max/page_size))
         fixes the page-table width so allocation never retraces.
-        `paged_attn_impl` = reference | pallas | auto (pallas on TPU)."""
+        `paged_attn_impl` = reference | pallas | auto (pallas on TPU).
+
+        `tracer` (ISSUE 10): an obs/trace.py TraceBuffer (or Tracer)
+        receiving per-request lifecycle events — engine_admit, prefill
+        chunks, prefix hits, COW, first token, sampled decode ticks,
+        evict, finish. None (the default) disables tracing: every
+        emission site is a single `is not None` branch, so the hot
+        decode tick pays nothing measurable (tests/test_trace.py)."""
         # one clock for submit timestamps, TTFT/TPOT, and deadline
         # expiry — injectable so the deadline tests drive time instead
         # of sleeping through it
@@ -141,6 +148,8 @@ class Engine:
         self._live = {}  # slot -> _Live
         self._pending = []  # rejected-at-submit records, flushed by step()
         self._tick_s = []   # recent decode-tick durations (clock secs)
+        self._tr = tracer   # None = tracing off (the near-zero path)
+        self._tick_n = 0    # decode ticks ever, for trace sampling
         self._next_id = 0
         self._base_rng = jax.random.key(seed)
         self.traces = {"prefill": [], "step": [], "cow": []}
@@ -452,6 +461,9 @@ class Engine:
                 "reject_limit": self.limit_name,
                 "limit_tokens": self.max_total_tokens,
             })
+            if self._tr is not None:
+                self._tr.emit(rid, "finish", reason="rejected",
+                              n_out=0, reject_limit=self.limit_name)
             self._pending.append(rec)
             return rid
         if rng is None:
@@ -487,6 +499,12 @@ class Engine:
         for req, slot in self.sched.take_admissions():
             t0 = len(req.prompt)
             t_pad = self.sched.bucket(t0)
+            if self._tr is not None:
+                self._tr.emit(req.req_id, "engine_admit", slot=slot,
+                              bucket=t_pad)
+                # the slab prefills in one shot: one chunk, the prompt
+                self._tr.emit(req.req_id, "prefill_chunk", start=0,
+                              n=t0, slot=slot)
             idx = np.zeros((1, t_pad), np.int32)
             idx[0, :t0] = req.prompt
             k_eff = V if req.top_k is None else max(1, min(int(req.top_k), V))
@@ -542,6 +560,14 @@ class Engine:
         # allocator can cover its worst case (prompt + max_new, minus
         # attached prefix pages)
         for req, slot in self.sched.take_admissions(can_admit=pg.try_admit):
+            if self._tr is not None:
+                plan = pg._plans[req.req_id]
+                self._tr.emit(req.req_id, "engine_admit", slot=slot,
+                              new_pages=plan.new_pages)
+                if plan.shared_len:
+                    self._tr.emit(req.req_id, "prefix_hit",
+                                  shared_tokens=plan.shared_len,
+                                  pages=len(plan.shared_pages))
             pg.start_prefill(slot, req)
         # chunked prefill: at most `prefill_chunk` prompt tokens
         # computed per tick across all prefilling slots (oldest
@@ -557,8 +583,14 @@ class Engine:
             n_real = min(budget, st.n_prompt - start)
             cow = pg.prepare_chunk(req.req_id, start, n_real)
             if cow is not None:
+                if self._tr is not None:
+                    self._tr.emit(req.req_id, "cow", src=cow[0],
+                                  dst=cow[1])
                 self.pool = self._cow_fn(self.pool, jnp.int32(cow[0]),
                                          jnp.int32(cow[1]))
+            if self._tr is not None:
+                self._tr.emit(req.req_id, "prefill_chunk", start=start,
+                              n=n_real, slot=slot)
             t_pad = pg.chunk_bucket(n_real)
             idx = np.zeros((1, t_pad), np.int32)
             idx[0, :n_real] = req.prompt[start:start + n_real]
@@ -588,6 +620,9 @@ class Engine:
                     live.req.req_id,
                     len(live.req.prompt) + len(live.emitted))
                 if cow is not None:
+                    if self._tr is not None:
+                        self._tr.emit(live.req.req_id, "cow",
+                                      src=cow[0], dst=cow[1])
                     self.pool = self._cow_fn(self.pool, jnp.int32(cow[0]),
                                              jnp.int32(cow[1]))
             active = np.zeros((self.n_slots,), bool)
@@ -626,6 +661,14 @@ class Engine:
         self._tick_s.append(now - t_tick)
         if len(self._tick_s) > 64:
             del self._tick_s[:32]
+        tr = self._tr
+        if tr is not None:
+            # SAMPLED: one event per decode_sample batched iterations —
+            # tracing on must not write an event per token either
+            self._tick_n += 1
+            if self._tick_n % tr.decode_sample == 0:
+                tr.emit(None, "decode_tick", t=now,
+                        n_live=len(self._live), tick=self._tick_n)
         self._reg.counter("tokens_out").add(len(self._live))
         for slot in sorted(self._live):
             live = self._live[slot]
@@ -635,6 +678,9 @@ class Engine:
                 live.t_first = now
                 self._reg.hist("ttft_ms").observe(
                     (now - live.req.submit_t) * 1e3)
+                if tr is not None:
+                    tr.emit(live.req.req_id, "first_token", t=now,
+                            slot=slot)
             live.t_last = now
             if self.detokenize is not None:
                 live.text += self.detokenize([tok])
@@ -774,6 +820,11 @@ class Engine:
         if n_out > 1:  # omitted (not 0.0) so report percentiles stay honest
             record["tpot_ms"] = tpot_ms
         self.sink.write(record)
+        if self._tr is not None:
+            if reason == "timeout":
+                self._tr.emit(req.req_id, "evict", slot=slot)
+            self._tr.emit(req.req_id, "finish", reason=reason,
+                          n_out=n_out)
         return rec
 
     def _finish_prefilling_timeout(self, slot):
@@ -784,11 +835,19 @@ class Engine:
         st = self._paged.prefill[slot]
         self._paged.release(slot)   # pops the prefill state + pages
         self.sched.release(slot)
-        return self._finish_queued_timeout(st.req)
+        if self._tr is not None:
+            # it HELD a slot and burned prefill compute — trace it as an
+            # eviction, not a queued death (the record shape stays the
+            # queued-timeout one: no token was ever produced)
+            self._tr.emit(st.req.req_id, "evict", slot=slot,
+                          prefilling=True)
+        return self._finish_queued_timeout(st.req, queued=False)
 
-    def _finish_queued_timeout(self, req):
+    def _finish_queued_timeout(self, req, queued=True):
         """A request whose deadline passed while it was still QUEUED: it
-        never held a slot and emitted nothing — no pool state to touch."""
+        never held a slot and emitted nothing — no pool state to touch.
+        (`queued=False` from the mid-prefill eviction path, which shares
+        the record shape but DID hold a slot — its trace says so.)"""
         self._reg.counter("serve_requests").add(1)
         self._reg.counter("serve_timeouts").add(1)
         rec = FinishedRequest(
@@ -802,4 +861,7 @@ class Engine:
             "n_prompt": rec.n_prompt, "n_out": 0,
             "finish_reason": "timeout",
         })
+        if self._tr is not None:
+            self._tr.emit(req.req_id, "finish", reason="timeout",
+                          n_out=0, queued=queued)
         return rec

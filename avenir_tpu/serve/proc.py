@@ -191,7 +191,7 @@ class ProcReplica(ReplicaHealth):
                  sink=None, seed=0, clock=None, stall_floor_secs=10.0,
                  stall_factor=10.0, rpc_slack_secs=5.0,
                  compile_grace_secs=300.0, env=None,
-                 defer_handshake=False, engine_kwargs=None):
+                 defer_handshake=False, engine_kwargs=None, trace=0):
         super().__init__(
             replica_id,
             clock=clock if clock is not None else time.perf_counter,
@@ -201,6 +201,15 @@ class ProcReplica(ReplicaHealth):
                      "detokenize": detokenize, "seed": int(seed),
                      # paged-KV knobs ride the hello (ISSUE 9)
                      **(engine_kwargs or {})}
+        if trace:
+            # tracing rides the hello as the decode-tick sampling
+            # interval (ISSUE 10): the worker builds its own TraceBuffer
+            # and ships drained events back in every reply as clock-free
+            # AGE deltas — restamped onto the parent clock in _rpc, the
+            # TTFT-restamp pattern
+            self._ekw["trace"] = int(trace)
+        self._trace_pending = []   # restamped, engine-rid keyed
+        self._trace_dropped = 0
         self._reg = registry if registry is not None else get_registry()
         self.sink = sink if sink is not None else NullSink()
         self.rpc_slack_secs = float(rpc_slack_secs)
@@ -386,7 +395,27 @@ class ProcReplica(ReplicaHealth):
             self.engine.update(reply["hb"])
         if "counters" in reply:
             self._apply_counter_deltas(reply["counters"])
+        if reply.get("trace"):
+            # restamp NOW, at arrival: age_s was measured against the
+            # worker clock when the reply was built; parent_now - age is
+            # the same event on the fleet clock (pipe latency shifts
+            # every event of a reply equally — relative order holds,
+            # and the fleet tracer's per-rid clamp absorbs the jitter)
+            now = self._clock()
+            for e in reply["trace"]:
+                e = dict(e)
+                e["t"] = now - float(e.pop("age_s", 0.0))
+                self._trace_pending.append(e)
+        if reply.get("trace_dropped"):
+            self._trace_dropped += int(reply["trace_dropped"])
         return reply
+
+    def take_trace(self):
+        """Drain restamped worker trace events (engine-rid keyed,
+        PARENT clock). Returns (events, dropped count)."""
+        out, self._trace_pending = self._trace_pending, []
+        dropped, self._trace_dropped = self._trace_dropped, 0
+        return out, dropped
 
     def _read_reply(self, *, timeout_s):
         """Read until the reply matching the current seq (bounded):

@@ -140,15 +140,42 @@ class Replica(ReplicaHealth):
     def __init__(self, model, replica_id, *, n_slots=4, max_seq_len=None,
                  detokenize=None, registry=None, sink=None, seed=0,
                  clock=None, stall_floor_secs=10.0, stall_factor=10.0,
-                 engine_kwargs=None):
+                 engine_kwargs=None, trace=0):
+        # per-replica trace buffer (ISSUE 10): engine events keyed by
+        # ENGINE-local rids collect here and the router drains+translates
+        # them each step (take_trace) — the same drain-per-step shape the
+        # process backend uses over its reply frames, so one fleet trace
+        # tree covers both backends. `trace` is the decode-tick sampling
+        # interval (0/False = tracing off; the Router passes its
+        # Tracer's decode_sample so the knob reaches every engine)
+        self._trace_buf = None
+        if trace:
+            from avenir_tpu.obs.trace import TraceBuffer
+
+            self._trace_buf = TraceBuffer(clock=clock,
+                                          decode_sample=int(trace))
         self.engine = Engine(
             model, n_slots=n_slots, max_seq_len=max_seq_len,
             detokenize=detokenize, registry=registry, sink=sink,
-            seed=seed, clock=clock, **(engine_kwargs or {}),
+            seed=seed, clock=clock, tracer=self._trace_buf,
+            **(engine_kwargs or {}),
         )
+        if self._trace_buf is not None:
+            # share the engine's resolved clock (clock=None means the
+            # engine picked perf_counter; events must ride that too)
+            self._trace_buf.clock = self.engine._clock
         super().__init__(replica_id, clock=self.engine._clock,
                          stall_floor_secs=stall_floor_secs,
                          stall_factor=stall_factor)
+
+    def take_trace(self):
+        """Drain this replica's trace events (engine-rid keyed, fleet
+        clock — no restamp needed in-process). Returns (events,
+        dropped-since-last-drain)."""
+        if self._trace_buf is None:
+            return [], 0
+        dropped, self._trace_buf.dropped = self._trace_buf.dropped, 0
+        return self._trace_buf.drain(), dropped
 
     # -- capacity surface the router routes on --
 

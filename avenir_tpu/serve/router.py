@@ -105,7 +105,7 @@ class Router:
                  stall_floor_secs=10.0, stall_factor=10.0,
                  backend="inproc", model_spec=None, supervise=False,
                  respawn_policy=None, max_respawns=5, proc_kwargs=None,
-                 engine_kwargs=None):
+                 engine_kwargs=None, tracer=None):
         """`weights`: dispatch shares per priority class (default
         interactive 4 : batch 1). `queue_limits`: max queued per class
         before shedding (default 16/64 x fleet slots). `clock` is shared
@@ -129,12 +129,22 @@ class Router:
         replica — the paged-KV ones (`kv_impl`, `page_size`, `n_pages`,
         `max_pages_per_seq`, `prefill_chunk`, `prefix_sharing`,
         `paged_attn_impl`) ride the process backend's hello handshake
-        unchanged, so a fleet of paged workers is one flag away."""
+        unchanged, so a fleet of paged workers is one flag away.
+
+        `tracer` (ISSUE 10): an obs/trace.py Tracer — the fleet flight
+        recorder. The router emits the fleet-level lifecycle events
+        (submit/admit/dispatch/failover/requeue/terminal refusals) and
+        absorbs each replica's engine events every step, translating
+        engine-local rids to fleet rids (process-backend events arrive
+        as age deltas and are restamped on the fleet clock). None (the
+        default) disables tracing end to end — replicas then build no
+        buffers and workers ship no trace frames."""
         assert n_replicas >= 1
         assert backend in BACKENDS, f"unknown backend {backend!r}"
         self._clock = clock if clock is not None else time.perf_counter
         self._reg = registry if registry is not None else get_registry()
         self.sink = sink if sink is not None else NullSink()
+        self.tracer = tracer
         self.backend = backend
         self._supervisor = None
         if backend == "process":
@@ -155,6 +165,8 @@ class Router:
                             stall_factor=stall_factor,
                             defer_handshake=True,
                             engine_kwargs=engine_kwargs,
+                            trace=(tracer.decode_sample
+                                   if tracer is not None else 0),
                             **(proc_kwargs or {}))
                 for i in range(n_replicas)
             ]
@@ -177,7 +189,9 @@ class Router:
                         sink=self.sink, seed=seed, clock=self._clock,
                         stall_floor_secs=stall_floor_secs,
                         stall_factor=stall_factor,
-                        engine_kwargs=engine_kwargs)
+                        engine_kwargs=engine_kwargs,
+                        trace=(tracer.decode_sample
+                               if tracer is not None else 0))
                 for i in range(n_replicas)
             ]
         eng0 = self.replicas[0].engine
@@ -228,6 +242,10 @@ class Router:
         if rng is None:
             rng = jax.random.fold_in(self._base_rng, rid)
         now = self._clock()
+        if self.tracer is not None:
+            self.tracer.emit(rid, "submit", t=now, n_prompt=len(prompt),
+                             max_new=int(max_new_tokens),
+                             priority=priority, deadline_ms=deadline_ms)
         if len(prompt) + int(max_new_tokens) > self.max_total_tokens:
             self._reg.counter("serve_rejected").add(1)
             self._refuse(rid, prompt, priority, "rejected",
@@ -253,6 +271,9 @@ class Router:
         )
         q.append(req)
         self._open[rid] = req
+        if self.tracer is not None:
+            self.tracer.emit(rid, "admit", t=now,
+                             queue_depth=len(q))
         self._reg.gauge("router_queue_depth").set(self.queue_depth)
         return rid
 
@@ -290,7 +311,17 @@ class Router:
             # step otherwise becomes its own median, zeroing the slack
             # exactly when the credit matters most
             med_before = rep.median_step_secs()
-            for f in rep.step():
+            fins = rep.step()
+            if self.tracer is not None:
+                # absorb BEFORE harvesting: _harvest pops the engine-rid
+                # -> fleet-rid map, and finished requests' engine events
+                # (their finish, this step's first tokens) still need it
+                evs, dropped = rep.take_trace()
+                if evs or dropped:
+                    self.tracer.absorb(
+                        evs, rid_map=self._by_replica[rep.replica_id],
+                        replica=rep.replica_id, dropped=dropped)
+            for f in fins:
                 finished.append(self._harvest(rep, f))
             dt = self._clock() - t_before
             # credit every OTHER live replica the ANOMALOUS part of the
@@ -393,6 +424,8 @@ class Router:
                 causes = "; ".join(
                     f"replica {r.replica_id}: {r.last_error!r}"
                     for r in self.replicas if r.last_error is not None)
+                if self.tracer is not None:
+                    self.tracer.flight_dump("drain-all-dead")
                 raise RuntimeError(
                     "all replicas dead with open requests — revive one"
                     + (" (supervisor exhausted its respawn budget)"
@@ -401,6 +434,8 @@ class Router:
             out.extend(self.step())
             steps += 1
             if steps > bound:
+                if self.tracer is not None:
+                    self.tracer.flight_dump("drain-stuck")
                 raise RuntimeError(
                     f"router failed to drain within {bound} iterations")
         return out
@@ -493,6 +528,10 @@ class Router:
         if reject_limit is not None:
             record["reject_limit"] = reject_limit
         self.sink.write(record)
+        if self.tracer is not None:
+            kw = {} if reject_limit is None \
+                else {"reject_limit": reject_limit}
+            self.tracer.emit(rid, "finish", reason=reason, n_out=0, **kw)
 
     def _expire_queued(self, now, out):
         """Router-queue deadline sweep with one fleet tick of lookahead:
@@ -581,6 +620,11 @@ class Router:
             req.dispatch_t = self._clock()
             self._where[req.rid] = rep.replica_id
             self._by_replica[rep.replica_id][eng_rid] = req.rid
+            if self.tracer is not None:
+                self.tracer.emit(req.rid, "dispatch", t=req.dispatch_t,
+                                 replica=rep.replica_id,
+                                 eng_rid=eng_rid,
+                                 failovers=req.failovers)
 
     def _harvest(self, rep, f):
         """Map an engine FinishedRequest back to its router identity."""
@@ -604,6 +648,20 @@ class Router:
         tokens are discarded so the eventual output is the one-shot
         stream. A request already past its deadline finishes 'timeout'
         here instead of being requeued."""
+        if self.tracer is not None:
+            # absorb whatever the corpse had buffered FIRST — the map
+            # below is about to be cleared and the dying tick's events
+            # (its last prefill chunks, first tokens) would lose their
+            # fleet attribution
+            evs, dropped = rep.take_trace()
+            if evs or dropped:
+                self.tracer.absorb(
+                    evs, rid_map=self._by_replica[rep.replica_id],
+                    replica=rep.replica_id, dropped=dropped)
+            # a replica death is exactly the incident the flight
+            # recorder exists for: dump the ring (no-op without an
+            # out_dir), whether or not the corpse held work
+            self.tracer.flight_dump(f"replica{rep.replica_id}-death")
         assigned = self._by_replica[rep.replica_id]
         if not assigned:
             return
@@ -615,6 +673,11 @@ class Router:
             self._where.pop(req.rid, None)
             req.dispatch_t = None
             req.failovers += 1
+            if self.tracer is not None:
+                self.tracer.emit(
+                    req.rid, "failover", t=now, replica=rep.replica_id,
+                    error=repr(rep.last_error) if rep.last_error
+                    else None)
             if req.expired(now):
                 # not a failover (nothing is re-prefilled): the death
                 # just surfaced a deadline that had already passed
@@ -622,6 +685,9 @@ class Router:
             else:
                 self._reg.counter("serve_failovers").add(1)
                 self._queues[req.priority].appendleft(req)
+                if self.tracer is not None:
+                    self.tracer.emit(req.rid, "requeue", t=now,
+                                     failovers=req.failovers)
 
     def _finish_router_timeout(self, req):
         """Deadline death in the ROUTER's hands (queued, or orphaned by
@@ -635,6 +701,9 @@ class Router:
             "n_prompt": len(req.prompt), "n_out": 0,
             "finish_reason": "timeout", "priority": req.priority,
         })
+        if self.tracer is not None:
+            self.tracer.emit(req.rid, "finish", reason="timeout",
+                             n_out=0, router_queued=True)
         return RouterFinished(
             req_id=req.rid, tokens=list(req.prompt),
             n_prompt=len(req.prompt), n_out=0, finish_reason="timeout",

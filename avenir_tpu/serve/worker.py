@@ -126,6 +126,17 @@ def _serve(stream):
              ("kv_impl", "page_size", "n_pages", "max_pages_per_seq",
               "prefill_chunk", "prefix_sharing", "paged_attn_impl")
              if ekw.get(k) is not None}
+    # request tracing (ISSUE 10): the parent's hello flips this flag;
+    # the engine collects lifecycle events in a bounded buffer and every
+    # reply ships the drained events as clock-free AGE deltas (pipes do
+    # not share clocks — the parent restamps on ITS clock, the same
+    # pattern submit_t already rides as age_ms)
+    tbuf = None
+    if ekw.get("trace"):
+        from avenir_tpu.obs.trace import TraceBuffer
+
+        # the hello's trace value IS the decode-tick sampling interval
+        tbuf = TraceBuffer(decode_sample=int(ekw["trace"]))
     engine = Engine(
         _build_model(hello["model"]),
         n_slots=int(ekw.get("n_slots", 4)),
@@ -133,8 +144,20 @@ def _serve(stream):
         detokenize=ekw.get("detokenize"),
         seed=int(ekw.get("seed", 0)),
         registry=reg,
+        tracer=tbuf,
         **kv_kw,
     )
+    if tbuf is not None:
+        tbuf.clock = engine._clock  # ages measured on the event clock
+
+    def drain_trace():
+        if tbuf is None:
+            return {}
+        dropped, tbuf.dropped = tbuf.dropped, 0
+        out = {"trace": tbuf.drain_aged()}
+        if dropped:
+            out["trace_dropped"] = dropped
+        return out
     stream.write({"ok": True, "seq": hseq, "proto": PROTO_VERSION,
                   "t_max": engine.T_max, "n_slots": engine.n_slots,
                   "limit_tokens": engine.max_total_tokens,
@@ -188,6 +211,7 @@ def _serve(stream):
                     "first": first,
                     "hb": hb(),
                     "counters": reg.counters(),
+                    **drain_trace(),
                 })
             elif op == "submit":
                 rng = None
@@ -208,7 +232,7 @@ def _serve(stream):
                     submit_t=submit_t,
                 )
                 send({"ok": True, "rid": int(rid), "hb": hb(),
-                      "counters": reg.counters()})
+                      "counters": reg.counters(), **drain_trace()})
             elif op == "ping":
                 send({"ok": True, "hb": hb(), "pid": os.getpid()})
             elif op == "arm_fault":

@@ -552,8 +552,16 @@ def run_training(cfg):
     # the process run-log handle: library layers without a plumbed sink
     # (the retry wrapper, writer threads) log retries through this
     from avenir_tpu.obs.sink import set_run_sink
+    from avenir_tpu.obs.trace import disarm_crash_hooks, \
+        install_crash_hooks
 
     _prev_sink = set_run_sink(sink)
+    # crash hooks (ISSUE 10 satellite): the finally below writes the
+    # normal run_end, but a crash that never reaches it — an exception
+    # in a path outside this try, an exit from a non-main thread — must
+    # still leave a final counter snapshot (and a flight dump when a
+    # tracer is armed) in the log; disarmed before the normal run_end
+    install_crash_hooks(sink=sink, registry=reg)
     if resume_src is not None:
         sink.write({
             "kind": "restore", "t": time.time(), "iter": iter_num,
@@ -899,6 +907,7 @@ def run_training(cfg):
             # any async-writer time the join just accounted)
             if wd is not None:
                 wd.stop()
+            disarm_crash_hooks()  # the normal run_end below supersedes
             snap = reg.snapshot()
             sink.write({
                 "kind": "run_end", "t": time.time(), "iter": iter_num,

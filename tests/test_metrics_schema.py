@@ -95,6 +95,28 @@ def test_source_emits_only_documented_keys():
             )
 
 
+def test_trace_schema_keys_pinned():
+    """ISSUE 10: the tracing/flight-recorder keys and the `trace`
+    record kind are part of the pinned contract (the set-equality tests
+    above enforce the doc mirror; this names them explicitly so a
+    future schema prune cannot drop them silently)."""
+    assert METRIC_SCHEMA["trace_events_dropped"][0] == "counter"
+    assert METRIC_SCHEMA["flight_dumps"][0] == "counter"
+    assert "trace" in RECORD_KINDS
+    from avenir_tpu.obs.trace import TERMINAL, TRACE_EVENTS
+
+    assert TERMINAL in TRACE_EVENTS
+    # the doc's event table mirrors TRACE_EVENTS (same policy as the
+    # metric table)
+    text = open(DOC).read()
+    doc_events = _doc_table_keys(text, "event")
+    assert set(doc_events) == TRACE_EVENTS, (
+        f"docs/OBSERVABILITY.md event table drifted from TRACE_EVENTS:\n"
+        f"  undocumented: {sorted(TRACE_EVENTS - set(doc_events))}\n"
+        f"  stale doc rows: {sorted(set(doc_events) - TRACE_EVENTS)}"
+    )
+
+
 def test_span_counter_keys_resolve():
     """span() derives `{name}_ms` from the annotation name unless given
     an explicit counter; both paths must land on schema keys."""

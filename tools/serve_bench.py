@@ -39,6 +39,14 @@ number of steps later.
 
 --metrics_log writes an obs JSONL (run_meta / request / run_end) that
 `python tools/obs_report.py <log>` summarizes.
+
+`--trace[=path.json]` (ISSUE 10) arms per-request causal tracing: the
+run writes a Perfetto-loadable Chrome trace JSON (request waterfalls —
+queue/prefill/failover/decode — next to the serve phase spans), a
+sibling `.events.jsonl`, `trace` records into --metrics_log, and
+flight-recorder dumps (`flight-*.jsonl`) on every replica death.
+`python tools/trace_report.py <events/log>` attributes TTFT across
+queue vs prefill vs failover per request.
 """
 
 import os
@@ -320,9 +328,33 @@ def main():
         os.makedirs(os.path.dirname(os.path.abspath(metrics_log)),
                     exist_ok=True)
         sink = JsonlSink(metrics_log)
+    # --trace (ISSUE 10): per-request causal tracing + flight recorder.
+    # The value is the Perfetto JSON output path (bare --trace uses
+    # serve_trace.json); a sibling .events.jsonl feeds
+    # tools/trace_report.py and flight-*.jsonl dumps land next to it.
+    tracer = None
+    trace_out = None
+    trace_flag = args.get("trace")
+    if trace_flag in ("0", "false"):  # the --prefix_sharing=0 convention
+        trace_flag = None
+    if trace_flag:
+        from avenir_tpu.obs.trace import Tracer, set_tracer
+
+        trace_out = (trace_flag if trace_flag not in ("1", "true")
+                     else "serve_trace.json")
+        flight_dir = os.path.dirname(os.path.abspath(trace_out))
+        os.makedirs(flight_dir, exist_ok=True)
+        tracer = Tracer(registry=reg, out_dir=flight_dir)
+        set_tracer(tracer)  # phase spans + watchdog dumps see it too
+    from avenir_tpu.obs.trace import install_crash_hooks, \
+        disarm_crash_hooks
+
+    # a crashed bench still leaves a final run_end snapshot (and a
+    # flight dump when tracing) in the log — ISSUE 10 satellite
+    install_crash_hooks(sink=sink, registry=reg, tracer=tracer)
     router = Router(model, n_replicas=n_replicas, n_slots=n_slots,
                     registry=reg, sink=sink, seed=seed, backend=backend,
-                    engine_kwargs=_kv_engine_kwargs(args),
+                    engine_kwargs=_kv_engine_kwargs(args), tracer=tracer,
                     # the supervisor is the process backend's recovery
                     # story; inproc kills are revived below
                     supervise=(backend == "process" and kills > 0),
@@ -394,6 +426,24 @@ def main():
         elif submitted < n_requests:
             time.sleep(min(0.005, arrivals[submitted] - now))
     wall = time.perf_counter() - t0
+    if tracer is not None:
+        import json as _json
+
+        from avenir_tpu.obs.trace import event_record, set_tracer
+
+        # every trace event rides the metrics log as a `trace` record
+        # (tools/trace_report.py reads either file)
+        for ev in tracer.events():
+            sink.write(event_record(ev))
+        with open(trace_out, "w") as f:
+            _json.dump(tracer.chrome(), f)
+        events_out = trace_out.rsplit(".json", 1)[0] + ".events.jsonl"
+        tracer.write_events_jsonl(events_out)
+        set_tracer(None)
+        print(f"trace: {trace_out} (load in Perfetto / chrome://tracing)"
+              f"\ntrace events: {events_out} "
+              f"(attribute: python tools/trace_report.py {events_out})")
+    disarm_crash_hooks()  # the normal run_end below supersedes
     snap = reg.snapshot()
     sink.write({"kind": "run_end", "t": time.time(),
                 "counters": snap["counters"],

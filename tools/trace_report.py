@@ -1,0 +1,168 @@
+"""TTFT attribution report from per-request trace events (ISSUE 10).
+
+Answers the question the aggregate metrics can't: for the requests this
+run served, where did time-to-first-token actually go — queue wait,
+prefill work, or attempts lost to replica deaths (failover)? The three
+components PARTITION each request's TTFT by construction
+(obs/trace.request_segments), so the attribution sums to the measured
+latency with no residue.
+
+Input: any JSONL carrying `trace` records — a `--metrics_log` from
+`tools/serve_bench.py --trace`, the `<trace>.events.jsonl` it writes
+next to the Perfetto JSON, or an `out_dir/flight-*.jsonl` flight-
+recorder dump. Other record kinds are ignored, so the same metrics.jsonl
+feeds both this and tools/obs_report.py.
+
+Usage:
+    python tools/trace_report.py out/metrics.jsonl
+    python tools/trace_report.py out/flight-replica0-death-001.jsonl
+    python tools/trace_report.py serve_trace.events.jsonl --json
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from avenir_tpu.obs.report import percentile  # noqa: E402
+from avenir_tpu.obs.trace import (  # noqa: E402
+    record_event,
+    request_segments,
+    ttft_attribution,
+)
+
+
+def load_trace_events(path):
+    """Trace events from a JSONL — the shared torn-line-tolerant reader
+    (obs/report.py), filtered to `trace` records; skips are warned on
+    stderr there, never silent."""
+    from avenir_tpu.obs.report import load_records_with_skips
+
+    records, _skipped = load_records_with_skips(path)
+    return [record_event(r) for r in records
+            if r.get("kind") == "trace" and "ev" in r]
+
+
+def summarize_traces(events):
+    """Per-request attribution + run-level percentiles. Returns a plain
+    dict; format_trace_report renders it."""
+    by_rid = {}
+    for e in events:
+        if e.get("rid") is not None:
+            by_rid.setdefault(e["rid"], []).append(e)
+    reqs = []
+    for rid, evs in sorted(by_rid.items()):
+        fin = next((e for e in evs if e["ev"] == "finish"), None)
+        sub = next((e for e in evs if e["ev"] == "submit"), None)
+        att = ttft_attribution(evs)
+        reqs.append({
+            "rid": rid,
+            "priority": (sub or {}).get("priority"),
+            "reason": (fin or {}).get("reason"),
+            "failovers": sum(1 for e in evs if e["ev"] == "failover"),
+            "chunks": sum(1 for e in evs if e["ev"] == "prefill_chunk"),
+            "prefix_hit": any(e["ev"] == "prefix_hit" for e in evs),
+            "cows": sum(1 for e in evs if e["ev"] == "cow"),
+            "attribution": att,
+            "segments": request_segments(evs),
+        })
+    with_ttft = [r for r in reqs if r["attribution"] is not None]
+    ttfts = [r["attribution"]["ttft_s"] * 1e3 for r in with_ttft]
+
+    def comp_ms(key):
+        return [r["attribution"][key] * 1e3 for r in with_ttft]
+
+    comps = {k: comp_ms(k + "_s")
+             for k in ("queue", "prefill", "failover")}
+    total_ttft = sum(ttfts)
+    return {
+        "n_requests": len(reqs),
+        "n_with_token": len(with_ttft),
+        "n_failover": sum(1 for r in reqs if r["failovers"]),
+        "reasons": _count(r["reason"] for r in reqs),
+        "ttft_p50_ms": percentile(ttfts, 0.50),
+        "ttft_p99_ms": percentile(ttfts, 0.99),
+        "ttft_total_ms": total_ttft,
+        "components_ms": {k: sum(v) for k, v in comps.items()},
+        "components_p99_ms": {k: percentile(v, 0.99)
+                              for k, v in comps.items()},
+        "requests": reqs,
+    }
+
+
+def _count(xs):
+    out = {}
+    for x in xs:
+        out[x] = out.get(x, 0) + 1
+    return out
+
+
+def format_trace_report(s, *, detail_failovers=8):
+    lines = ["== avenir trace report (TTFT attribution) =="]
+    lines.append(
+        f"requests traced: {s['n_requests']}  "
+        f"(with >=1 token: {s['n_with_token']}, "
+        f"survived a failover: {s['n_failover']})")
+    if s["reasons"]:
+        lines.append("finish reasons: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(s["reasons"].items(),
+                                          key=lambda kv: str(kv[0]))))
+    if s["ttft_p50_ms"] is not None:
+        lines.append(f"ttft: p50 {s['ttft_p50_ms']:.1f} ms  "
+                     f"p99 {s['ttft_p99_ms']:.1f} ms")
+        lines.append("")
+        lines.append("-- where TTFT went (sums over every first token; "
+                     "the components partition each request's TTFT) --")
+        total = s["ttft_total_ms"] or 1.0
+        for k in ("queue", "prefill", "failover"):
+            ms = s["components_ms"][k]
+            p99 = s["components_p99_ms"][k]
+            lines.append(
+                f"  {k:<9}{ms / 1e3:9.3f}s  {100.0 * ms / total:5.1f}%"
+                + (f"   p99 {p99:8.1f} ms" if p99 is not None else ""))
+        lines.append(f"  {'total':<9}{s['ttft_total_ms'] / 1e3:9.3f}s  "
+                     "100.0%")
+    fo = [r for r in s["requests"] if r["failovers"]
+          and r["attribution"] is not None]
+    if fo:
+        fo.sort(key=lambda r: -r["attribution"]["ttft_s"])
+        lines.append("")
+        lines.append("-- failover survivors (worst TTFT first) --")
+        for r in fo[:detail_failovers]:
+            a = r["attribution"]
+            lines.append(
+                f"  rid {r['rid']:>4}  ttft {a['ttft_s'] * 1e3:8.1f} ms"
+                f" = queue {a['queue_s'] * 1e3:7.1f}"
+                f" + prefill {a['prefill_s'] * 1e3:7.1f}"
+                f" + failover {a['failover_s'] * 1e3:7.1f} ms"
+                f"  ({r['failovers']} failover(s), {r['chunks']} "
+                f"chunk(s), finish={r['reason']})")
+        if len(fo) > detail_failovers:
+            lines.append(f"  ... and {len(fo) - detail_failovers} more")
+    return "\n".join(lines)
+
+
+def main(argv):
+    as_json = "--json" in argv
+    paths = [a for a in argv if not a.startswith("--")]
+    assert len(paths) == 1, (
+        "usage: python tools/trace_report.py <trace-events .jsonl> "
+        "[--json]\n(a serve_bench --metrics_log, a *.events.jsonl, or "
+        "a flight-*.jsonl dump)")
+    events = load_trace_events(paths[0])
+    if not events:
+        print(f"no trace records in {paths[0]} — was the run traced? "
+              "(tools/serve_bench.py --trace)", file=sys.stderr)
+        return 1
+    s = summarize_traces(events)
+    if as_json:
+        slim = {k: v for k, v in s.items() if k != "requests"}
+        print(json.dumps(slim, indent=1))
+    else:
+        print(format_trace_report(s))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
