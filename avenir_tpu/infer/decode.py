@@ -215,7 +215,12 @@ def _llama_layer_step(lyr, x, kc, vc, pos, q_pos, cos, sin, kv_ops=None):
 
 def _run_layers(model, x, cache, pos, q_pos, layer_step):
     """Apply layer_step across the model's layers, handling both the
-    python-loop and the scan-stacked layouts. Returns (x, new_cache)."""
+    python-loop and the scan-stacked layouts. Returns (x, new_cache).
+
+    The per-layer cache halves (cache.k / cache.v) are treated as
+    PYTREES, not bare arrays: the int8 KV pools (ops/kv_quant.py) carry
+    (data, scale) pairs per half, and tree-mapped indexing/stacking lets
+    one loop serve both the dense and the quantized layouts."""
     # explicit `is None` checks: nnx.Module truthiness is not a reliable
     # presence test (a falsy module would silently fall into the loop path)
     scanned = getattr(model, "h_scan", None)
@@ -234,10 +239,13 @@ def _run_layers(model, x, cache, pos, q_pos, layer_step):
         layers = model.layers
     ks, vs = [], []
     for l, layer in enumerate(layers):
-        x, kc, vc = layer_step(layer, x, cache.k[l], cache.v[l], pos, q_pos)
+        kc = jax.tree.map(lambda a: a[l], cache.k)
+        vc = jax.tree.map(lambda a: a[l], cache.v)
+        x, kc, vc = layer_step(layer, x, kc, vc, pos, q_pos)
         ks.append(kc)
         vs.append(vc)
-    return x, KVCache(jnp.stack(ks), jnp.stack(vs))
+    stack = lambda cs: jax.tree.map(lambda *xs: jnp.stack(xs), *cs)
+    return x, KVCache(stack(ks), stack(vs))
 
 
 def _take_last(x, last_index):
@@ -249,7 +257,8 @@ def _take_last(x, last_index):
     return jax.lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)
 
 
-def _forward_cached(model, idx, cache, pos, last_index=None, kv_ops=None):
+def _forward_cached(model, idx, cache, pos, last_index=None, kv_ops=None,
+                    return_all=False):
     """Forward `idx` (B, T) at absolute start position `pos` — a scalar
     shared by the batch, or a (B,) vector of per-row positions (serve
     slot pool) — reading and writing the cache. Returns (fp32 logits at
@@ -258,7 +267,15 @@ def _forward_cached(model, idx, cache, pos, last_index=None, kv_ops=None):
     `kv_ops`: optional (write, attend) pair replacing the dense
     `_write_cache`/`_attend_cached` — the paged-KV serve pool
     (serve/pages.py) routes cache reads/writes through a page table
-    this way, so one forward serves both cache layouts."""
+    this way, so one forward serves both cache layouts.
+
+    `return_all` (ISSUE 11): return fp32 logits at EVERY position,
+    (B, T, V) — the speculative-decoding k-token verify forward, where
+    position i's logits are the target distribution conditioned on the
+    draft prefix idx[:, :i+1]. The cache write is unchanged: draft
+    tokens' KV lands at pos..pos+T-1 and stays masked (unattendable)
+    past the accepted point until real tokens overwrite it — the slot-
+    hygiene invariant covers rejected tokens exactly like recycling."""
     B, T = idx.shape
     if getattr(pos, "ndim", 0) == 1:
         q_pos = pos[:, None] + jnp.arange(T)[None]  # (B, T)
@@ -272,7 +289,9 @@ def _forward_cached(model, idx, cache, pos, last_index=None, kv_ops=None):
             lambda blk, h, kc, vc, p, qp: _gpt_block_step(
                 blk, h, kc, vc, p, qp, kv_ops=kv_ops),
         )
-        x = model.ln_f(_take_last(x, last_index)).astype(x.dtype)
+        if not return_all:
+            x = _take_last(x, last_index)
+        x = model.ln_f(x).astype(x.dtype)
         logits = model.wte.attend(x)
     else:  # Llama / Mixtral
         from avenir_tpu.ops import rope_frequencies
@@ -287,8 +306,12 @@ def _forward_cached(model, idx, cache, pos, last_index=None, kv_ops=None):
             lambda lyr, h, kc, vc, p, qp: _llama_layer_step(
                 lyr, h, kc, vc, p, qp, cos, sin, kv_ops=kv_ops),
         )
-        x = model.norm(_take_last(x, last_index)).astype(x.dtype)
+        if not return_all:
+            x = _take_last(x, last_index)
+        x = model.norm(x).astype(x.dtype)
         logits = model.lm_head(x)
+    if return_all:
+        return logits.astype(jnp.float32), cache
     return logits[:, -1].astype(jnp.float32), cache
 
 

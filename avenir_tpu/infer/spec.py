@@ -1,0 +1,155 @@
+"""Speculative decoding: the accept/reject math for batched one-step
+verification (ISSUE 11 tentpole, part 1).
+
+A small DRAFT model proposes k tokens autoregressively; the TARGET
+verifies all k in ONE batched forward over the cached path
+(`infer.decode._forward_cached(..., return_all=True)` — the k-token
+verify forward). This module owns the sampling theory between those two
+forwards: modified rejection sampling (Leviathan et al. / Chen et al.)
+that keeps every emitted token EXACTLY target-distributed:
+
+    for i = 1..k:   accept d_i  iff  u_i < p_{i-1}(d_i) / q_i(d_i)
+    on the first rejection at j: emit one token from the residual
+        normalize(max(p_{j-1} - q_j, 0))
+    if all k accepted: emit a BONUS token from p_k
+
+so every tick emits between 1 and k+1 tokens. Two properties the serve
+suite pins:
+
+  - **distribution exactness**: each emitted token is distributed
+    exactly as target-only sampling at that position (the classic
+    rejection-sampling identity; tests/test_spec_decode.py checks
+    seeded frequencies against the analytic target distribution).
+  - **greedy bit-parity**: with top_k=1 the target distribution is
+    one-hot at argmax, so accept/reject outcomes are DETERMINISTIC
+    (p(d)/q(d) is 1/q >= 1 or exactly 0) and both the residual and the
+    bonus distributions collapse to that one-hot — the emitted stream
+    is the argmax chain bit-identical to sequential `generate_cached`
+    decoding, for ANY draft model and ANY rng. A bad draft can only
+    cost speed, never correctness.
+
+`p`/`q` are computed from raw logits with the SAME per-row
+temperature/top-k masking `_sample_rows` applies (sort-threshold mask
+then softmax), so "the target distribution" here is literally the
+distribution the sequential sampler draws from.
+
+Everything is fixed-shape: drafts ride as (B, k), emissions as a
+(B, k+1) token block plus a (B,) accepted-count vector — the variable
+1..k+1 harvest is host bookkeeping over traced outputs, never a
+retrace (the page-table traced-arg discipline).
+"""
+
+import jax
+import jax.numpy as jnp
+
+# the draft model's proposal rng is derived from the request key with
+# this fold constant — a fixed, documented split so the draft stream
+# can never collide with the target stream (which sequential decoding
+# owns) while staying a pure function of the request's rng
+DRAFT_RNG_FOLD = 0x5bec
+
+
+def draft_key(rng):
+    """The draft-proposal key for a request key. Deterministic: a
+    failed-over request re-drafts identically, so spec-decode output is
+    a pure function of (prompt, rng) — the router's bit-identical
+    failover contract survives spec decoding."""
+    return jax.random.fold_in(rng, DRAFT_RNG_FOLD)
+
+
+def masked_probs(logits, temperature, top_k):
+    """(B, T, V) logits -> (B, T, V) probabilities under the per-row
+    temperature/top-k the sequential sampler uses: divide by temp, mask
+    strictly below the row's k-th largest logit to -inf, softmax.
+    `top_k` is (B,) int32 with V meaning "no top-k" (the slot-pool
+    convention); like `_sample_rows`, an all->=V batch skips the
+    full-vocab sort at RUNTIME through one lax.cond inside the same
+    compiled step."""
+    V = logits.shape[-1]
+    l = logits / temperature[:, None, None]
+
+    def with_mask(lx):
+        srt = jnp.sort(lx, axis=-1)  # ascending
+        k = jnp.clip(top_k, 1, V)
+        kth = jnp.take_along_axis(
+            srt, jnp.broadcast_to((V - k)[:, None, None],
+                                  (lx.shape[0], lx.shape[1], 1)), axis=-1)
+        return jnp.where(lx < kth, -jnp.inf, lx)
+
+    l = jax.lax.cond(jnp.all(top_k >= V), lambda lx: lx, with_mask, l)
+    return jax.nn.softmax(l, axis=-1)
+
+
+def spec_accept(keys, p_logits, q_logits, drafts, temperature, top_k):
+    """One verification round. All shapes fixed; k = drafts.shape[1].
+
+    keys:      (B,) typed target keys (each row consumes only its own —
+               the per-row-stream discipline of `_sample_rows`)
+    p_logits:  (B, k+1, V) target logits from the verify forward over
+               [tail, d_1..d_k]; index i is p(.|prefix, d_1..d_i)
+               (index 0 conditions on the tail alone)
+    q_logits:  (B, k, V) draft logits d_i was sampled from
+    drafts:    (B, k) int32 proposed tokens
+    temperature/top_k: (B,) per-row sampling params (top_k = V none)
+
+    Returns (new_keys, toks, counts): `toks` (B, k+1) int32 holds the
+    emitted tokens left-aligned — positions 0..counts-2 are accepted
+    drafts, position counts-1 is the residual correction (on a
+    rejection) or the bonus token (all accepted); entries past counts
+    are dead. `counts` (B,) in 1..k+1.
+    """
+    B, K1, V = p_logits.shape
+    K = K1 - 1
+    assert drafts.shape == (B, K) and q_logits.shape == (B, K, V)
+    p = masked_probs(p_logits, temperature, top_k)        # (B, K+1, V)
+    q = masked_probs(q_logits, temperature, top_k)        # (B, K, V)
+
+    # fixed rng budget per tick: 1 carry + 1 final draw + K accept
+    # uniforms per row, consumed whatever the accept pattern — counts
+    # can never skew the stream (no data-dependent key use)
+    ks = jax.vmap(lambda kk: jax.random.split(kk, K + 2))(keys)
+    new_keys = ks[:, 0]
+    u = jax.vmap(lambda row: jax.vmap(
+        lambda kk: jax.random.uniform(kk))(row))(ks[:, 2:])   # (B, K)
+
+    p_d = jnp.take_along_axis(p[:, :K], drafts[..., None], -1)[..., 0]
+    q_d = jnp.take_along_axis(q, drafts[..., None], -1)[..., 0]
+    # u < p/q, written divide-free (q_d > 0: d was sampled from q)
+    accept = u * q_d < p_d                                 # (B, K)
+    acc = jnp.cumprod(accept.astype(jnp.int32), axis=1)
+    n_acc = acc.sum(axis=1)                                # (B,) 0..K
+
+    # the final token's distribution: residual at the first rejection,
+    # the bonus p_k when everything was accepted
+    p_sel = jnp.take_along_axis(p, n_acc[:, None, None], axis=1)[:, 0]
+    q_sel = jnp.take_along_axis(
+        q, jnp.minimum(n_acc, K - 1)[:, None, None], axis=1)[:, 0]
+    resid = jnp.maximum(p_sel - q_sel, 0.0)
+    rs = resid.sum(-1, keepdims=True)
+    # rs == 0 cannot follow a genuine rejection (a rejection implies
+    # q > p somewhere, hence p > q somewhere else); the where() guards
+    # float underflow only — fall back to the target distribution,
+    # which is still exactly correct sampling, just not residual-shaped
+    resid = jnp.where(rs > 0, resid / jnp.maximum(rs, 1e-38), p_sel)
+    final_dist = jnp.where((n_acc < K)[:, None], resid, p_sel)
+    final_tok = jax.vmap(
+        lambda kk, pr: jax.random.categorical(kk, jnp.log(pr)))(
+            ks[:, 1], final_dist).astype(jnp.int32)
+
+    counts = n_acc + 1                                     # 1..K+1
+    toks = jnp.concatenate(
+        [drafts.astype(jnp.int32),
+         jnp.zeros((B, 1), jnp.int32)], axis=1)            # (B, K+1)
+    toks = toks.at[jnp.arange(B), n_acc].set(final_tok)
+    return new_keys, toks, counts
+
+
+def expected_tokens_per_tick(accept_rate, k):
+    """E[emitted/tick] under an i.i.d. per-draft accept rate `a`:
+    1 + a + a^2 + ... + a^k = (1 - a^(k+1)) / (1 - a). The accept-rate
+    math docs/PERFORMANCE.md quotes; benches report the measured
+    counterpart (tokens_out / verify ticks)."""
+    a = float(accept_rate)
+    if a >= 1.0:
+        return float(k + 1)
+    return (1.0 - a ** (k + 1)) / (1.0 - a)

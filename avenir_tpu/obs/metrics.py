@@ -236,6 +236,26 @@ METRIC_SCHEMA = {
         "chunked-prefill dispatches by the paged engine (each computes "
         "at most prefill_chunk prompt tokens, so long prompts never "
         "stall a decode tick)"),
+    # -- decode raw speed (ISSUE 11: spec decoding + int8 KV) --
+    "spec_proposed": (
+        "counter", "tok",
+        "draft tokens proposed for verification (spec_k per live slot "
+        "per speculative tick; serve/engine.py spec_decode='draft')"),
+    "spec_accepted": (
+        "counter", "tok",
+        "draft tokens the target's rejection-sampling verify accepted "
+        "(the correction/bonus token is target-sampled and not counted "
+        "here)"),
+    "spec_accept_rate": (
+        "gauge", "1",
+        "cumulative spec_accepted / spec_proposed — drives the "
+        "effective tokens-per-model-pass: (1 - a^(k+1)) / (1 - a) "
+        "(docs/PERFORMANCE.md accept-rate math)"),
+    "kv_dtype": (
+        "gauge", "bits",
+        "KV-cache element width of the serving engine: 16 (bf16, the "
+        "compute dtype) or 8 (int8 with per-head scales, "
+        "ops/kv_quant.py) — set once at engine construction"),
     "ttft_ms": (
         "hist", "ms", "submit -> first token, per finished request"),
     "tpot_ms": (

@@ -141,6 +141,11 @@ def summarize(records, *, skipped_lines=()):
             "kv_pages_free": (end.get("gauges") or {}).get("kv_pages_free"),
             "prefix_hit_rate": (end.get("gauges")
                                 or {}).get("prefix_hit_rate"),
+            # speculative decoding (ISSUE 11): counters carry totals;
+            # the gauge snapshot names the KV width the run served at
+            "spec_proposed": counters.get("spec_proposed", 0.0),
+            "spec_accepted": counters.get("spec_accepted", 0.0),
+            "kv_dtype_bits": (end.get("gauges") or {}).get("kv_dtype"),
         }
     return {
         "serve": serve,
@@ -300,6 +305,14 @@ def format_report(s):
             if sv.get("prefix_hit_rate") is not None:
                 bits.append(f"prefix hit {sv['prefix_hit_rate']:.0%}")
             lines.append("  paging: " + "   ".join(bits))
+        if sv.get("spec_proposed"):
+            rate = sv["spec_accepted"] / sv["spec_proposed"]
+            bits = [f"{rate:.0%} of {sv['spec_proposed']:.0f} proposed "
+                    "draft tokens"]
+            if sv.get("kv_dtype_bits") is not None:
+                bits.append("kv " + ("int8" if sv["kv_dtype_bits"] == 8
+                                     else "bf16"))
+            lines.append("  accept: " + "   ".join(bits))
     return "\n".join(lines)
 
 

@@ -64,6 +64,8 @@ TRACE_EVENTS = {
     "cow",           # copy-on-write page copy for this request
     "first_token",   # first sampled token landed
     "decode_tick",   # sampled batched decode iteration (rid=None)
+    "spec_verify",   # sampled speculative verify tick: proposed/
+                     # accepted draft counts ride as attrs (rid=None)
     "evict",         # deadline eviction from a held slot
     "failover",      # the replica holding this request died
     "requeue",       # re-queued (front of class) for a fresh dispatch

@@ -1,0 +1,121 @@
+"""int8 KV cache: per-head symmetric quantization behind the kv_ops
+injection point (ISSUE 11 tentpole, part 2).
+
+Decode is bandwidth-bound — every decode step re-reads the whole KV
+window, so KV bytes ARE the token latency. Storing K/V as int8 with a
+per-(position, head) fp32 scale halves the bytes the attend streams
+(int8 data + a D-times-smaller scale sidecar, ~6% overhead at D=64)
+and, under paged KV, doubles how many tokens a fixed HBM budget holds —
+compounding the paging capacity win (BENCH_paged_kv.json's mechanism).
+
+Scheme: symmetric absmax. On every cache write the new K/V vectors are
+quantized per head: scale = max|x| / 127 over the head dim, data =
+round(x / scale) int8 — quantize-on-write means the cache NEVER holds a
+bf16 copy, and re-quantization error never compounds (each position is
+quantized exactly once, from the compute-dtype value the dense cache
+would have stored). The attend dequantizes data * scale back to the
+compute dtype; the reference path then reuses the dense
+`_attend_cached` verbatim (CPU-testable — the attn_impl
+parity-tolerance pattern: numerically close, not bitwise), and the TPU
+kernels (`ops/pallas/flash_attention.decode_attention_int8`,
+`ops/pallas/paged_attention.paged_attention_int8`) fuse the dequant
+into the page/block DMA so HBM only ever moves int8.
+
+Error budget (docs/PERFORMANCE.md): absmax-int8 rounding error per
+element is <= scale/2 = amax/254, i.e. ~0.4% of the head's dynamic
+range; softmax scores see the error pre-softmax where it perturbs
+logits by O(||q|| * amax/254). The serve tests pin logits closeness
+across GPT/Llama/Mixtral in both KV layouts rather than bit parity —
+the same contract split as `attn_impl='pallas'`.
+
+The cache pytree: a quantized cache half is a `QuantKV(data, scale)`
+NamedTuple wherever the dense pools hold a bare array. Everything that
+moves caches (`infer.decode._run_layers`, the engine's slot splices,
+the paged COW copy) is tree-mapped, so ONE code path serves both
+layouts — and donation/scan semantics are unchanged (NamedTuples are
+pytrees).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.infer.decode import _attend_cached
+
+# the symmetric int8 range; scale maps amax onto it exactly
+Q_MAX = 127.0
+# floor keeps an all-zero head (a fresh pool row) from a 0-divide;
+# dequantizing its zeros still yields exact zeros
+SCALE_FLOOR = 1e-8
+
+
+class QuantKV(NamedTuple):
+    """One quantized cache half. `data` int8, `scale` fp32 with the
+    head dim reduced away — slab: data (L, B, T, H_kv, D) / scale
+    (L, B, T, H_kv); paged: data (L, n_pages, ps, H_kv, D) / scale
+    (L, n_pages, ps, H_kv)."""
+
+    data: jax.Array
+    scale: jax.Array
+
+
+def quantize(x):
+    """Per-head absmax int8: x (..., D) -> (int8 data, fp32 scale) with
+    scale = max|x| / 127 over the last axis. Round-trip error per
+    element is bounded by scale/2 (tests pin it)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), SCALE_FLOOR) / Q_MAX
+    data = jnp.round(xf / scale[..., None]).astype(jnp.int8)
+    return data, scale
+
+
+def dequantize(qkv, dtype):
+    """QuantKV -> dense (..., D) in `dtype`."""
+    return (qkv.data.astype(jnp.float32)
+            * qkv.scale[..., None].astype(jnp.float32)).astype(dtype)
+
+
+def init_quant_kv(shape):
+    """Zeroed QuantKV for a dense-cache shape (..., H_kv, D)."""
+    return QuantKV(jnp.zeros(shape, jnp.int8),
+                   jnp.zeros(shape[:-1], jnp.float32))
+
+
+def quant_slab_kv_ops(compute_dtype, attend_fn=None):
+    """(write, attend) pair for `infer.decode._forward_cached` over a
+    QUANTIZED slab layer cache — the int8 twin of the default
+    `_write_cache`/`_attend_cached` pair, same position semantics
+    (scalar prefill pos, (B,) per-row decode/verify pos, any T width).
+
+    `attend_fn(q, kc, vc, q_pos)`, when given, replaces the
+    dequant-gather for SINGLE-token queries (the Pallas int8 decode
+    kernel); multi-token queries (prefill chunks, spec verify) always
+    take the dequant + dense-attend reference path."""
+
+    def write(kc, vc, k, v, pos):
+        kd, ks = quantize(k)
+        vd, vs = quantize(v)
+        if getattr(pos, "ndim", 0) == 1:
+            def row(kc_r, vc_r, kd_r, ks_r, vd_r, vs_r, p):
+                upd = jax.lax.dynamic_update_slice
+                return (QuantKV(upd(kc_r.data, kd_r, (p, 0, 0)),
+                                upd(kc_r.scale, ks_r, (p, 0))),
+                        QuantKV(upd(vc_r.data, vd_r, (p, 0, 0)),
+                                upd(vc_r.scale, vs_r, (p, 0))))
+
+            return jax.vmap(row)(kc, vc, kd, ks, vd, vs, pos)
+        upd = jax.lax.dynamic_update_slice
+        kc = QuantKV(upd(kc.data, kd, (0, pos, 0, 0)),
+                     upd(kc.scale, ks, (0, pos, 0)))
+        vc = QuantKV(upd(vc.data, vd, (0, pos, 0, 0)),
+                     upd(vc.scale, vs, (0, pos, 0)))
+        return kc, vc
+
+    def attend(q, kc, vc, q_pos):
+        if attend_fn is not None and q.shape[1] == 1:
+            return attend_fn(q, kc, vc, q_pos)
+        return _attend_cached(q, dequantize(kc, compute_dtype),
+                              dequantize(vc, compute_dtype), q_pos)
+
+    return write, attend

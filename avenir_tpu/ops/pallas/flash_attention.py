@@ -775,3 +775,132 @@ def flash_attention(q, k, v, *, causal=True, sm_scale=None, block_q=None,
         o = f(qt, kt, vt)
     o = o[:, :, :T, :]
     return o if layout == "bhtd" else o.transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# int8 single-token decode attention (ISSUE 11): the slab serve path
+# ---------------------------------------------------------------------------
+
+
+def _decode_int8_kernel(lengths_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                        o_ref, acc_ref, m_ref, l_ref, *, block_t):
+    """One decode query (G grouped heads) against a row's int8 slab
+    cache, streamed block_t tokens per grid step with the online-softmax
+    carry. The dequant (data * per-(position, head) scale) happens in
+    VMEM after the DMA, so the HBM read — the thing decode latency IS —
+    moves int8: half the bytes of the bf16 slab per token."""
+    b = pl.program_id(0)
+    t = pl.program_id(2)
+    n_t = pl.num_programs(2)
+
+    @pl.when(t == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = lengths_ref[b]
+
+    @pl.when(t * block_t < length)
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32)            # (G, D)
+        k = (k_ref[0, :, 0, :].astype(jnp.float32)
+             * ks_ref[0, :, 0][:, None])               # (bt, D)
+        v = (v_ref[0, :, 0, :].astype(jnp.float32)
+             * vs_ref[0, :, 0][:, None])
+        d = q.shape[-1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (d ** -0.5)                                # (G, bt)
+        k_pos = t * block_t + jax.lax.broadcasted_iota(jnp.int32,
+                                                       s.shape, 1)
+        s = jnp.where(k_pos < length, s, NEG_INF)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(pexp, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            pexp, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:, :1] = m_new
+        l_ref[:, :1] = l_new
+
+    @pl.when(t == n_t - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def decode_attention_int8(q, k_data, k_scale, v_data, v_scale, lengths,
+                          *, block_t=128, interpret=False):
+    """Single-token decode attention over an int8 SLAB cache — the
+    kv_dtype='int8' twin of the serve engine's `_attend_cached` decode
+    read (the paged twin is paged_attention.paged_attention_int8).
+
+    q: (B, H, D) one decode token per row; k_data/v_data: (B, T_max,
+    H_kv, D) int8; k_scale/v_scale: (B, T_max, H_kv) fp32 (the
+    ops/kv_quant absmax layout); lengths: (B,) attendable positions per
+    row (pos + 1 — the just-written token included). Blocks past a
+    row's length skip all compute; the partial block masks with
+    NEG_INF. Numerics: fp32 online softmax, close to the dequant
+    reference, not bitwise — the attn_impl contract split."""
+    B, H, D = q.shape
+    _, T, h_kv, _ = k_data.shape
+    assert H % h_kv == 0, (H, h_kv)
+    G = H // h_kv
+    bt = min(block_t, 1 << max(T - 1, 1).bit_length())
+    Tp = -(-T // bt) * bt
+    if Tp != T:
+        pad = [(0, 0), (0, Tp - T), (0, 0), (0, 0)]
+        k_data = jnp.pad(k_data, pad)
+        v_data = jnp.pad(v_data, pad)
+        k_scale = jnp.pad(k_scale, pad[:-1])
+        v_scale = jnp.pad(v_scale, pad[:-1])
+    qg = q.reshape(B, h_kv, G, D)
+    grid = (B, h_kv, Tp // bt)
+
+    def q_index(b, h, t, lengths_ref):
+        return (b, h, 0, 0)
+
+    def kv_index(b, h, t, lengths_ref):
+        return (b, t, h, 0)
+
+    def scale_index(b, h, t, lengths_ref):
+        return (b, t, h)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, G, D), q_index),
+            pl.BlockSpec((1, bt, 1, D), kv_index),
+            pl.BlockSpec((1, bt, 1), scale_index),
+            pl.BlockSpec((1, bt, 1, D), kv_index),
+            pl.BlockSpec((1, bt, 1), scale_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, D), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((G, D), jnp.float32),      # acc
+            pltpu.VMEM((G, _LANES), jnp.float32),  # m (col 0)
+            pltpu.VMEM((G, _LANES), jnp.float32),  # l
+        ],
+    )
+    try:
+        params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    except (AttributeError, TypeError):
+        params = pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    out = pl.pallas_call(
+        functools.partial(_decode_int8_kernel, block_t=bt),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, h_kv, G, D), q.dtype),
+        compiler_params=params,
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), qg, k_data,
+      k_scale.astype(jnp.float32), v_data, v_scale.astype(jnp.float32))
+    return out.reshape(B, H, D)

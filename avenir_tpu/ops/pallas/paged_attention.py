@@ -85,6 +85,120 @@ def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
         o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
+def _paged_decode_kernel_int8(tables_ref, lengths_ref, q_ref, k_ref,
+                              ks_ref, v_ref, vs_ref, o_ref, acc_ref,
+                              m_ref, l_ref, *, page_size):
+    """int8 twin of `_paged_decode_kernel` (ISSUE 11): K/V pages arrive
+    as int8 with a per-(position, head) fp32 scale page riding beside
+    them. The dequant (data * scale) happens HERE, in VMEM, after the
+    DMA — so HBM only ever moves int8 pages, which is the entire point:
+    decode is bandwidth-bound and the page stream just halved."""
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    n_p = pl.num_programs(2)
+    ps = page_size
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = lengths_ref[b]
+
+    @pl.when(p * ps < length)
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32)        # (G, D)
+        k = (k_ref[0, :, 0, :].astype(jnp.float32)
+             * ks_ref[0, :, 0][:, None])           # (ps, D) dequant
+        v = (v_ref[0, :, 0, :].astype(jnp.float32)
+             * vs_ref[0, :, 0][:, None])
+        d = q.shape[-1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (d ** -0.5)                            # (G, ps)
+        k_pos = p * ps + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos < length, s, NEG_INF)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(pexp, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            pexp, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:, :1] = m_new
+        l_ref[:, :1] = l_new
+
+    @pl.when(p == n_p - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def paged_attention_int8(q, k_data, k_scale, v_data, v_scale, tables,
+                         lengths, *, interpret=False):
+    """`paged_attention` over an int8 page pool: k_data/v_data
+    (n_pages, page_size, H_kv, D) int8, k_scale/v_scale (n_pages,
+    page_size, H_kv) fp32 (ops/kv_quant absmax layout). Same grid,
+    masking and online-softmax as the bf16 kernel; same numerics
+    contract (close to the dequant reference, not bitwise)."""
+    B, H, D = q.shape
+    n_pages, ps, h_kv, _ = k_data.shape
+    P = tables.shape[1]
+    assert tables.shape == (B, P) and lengths.shape == (B,)
+    assert H % h_kv == 0, (H, h_kv)
+    G = H // h_kv
+    qg = q.reshape(B, h_kv, G, D)
+    grid = (B, h_kv, P)
+
+    def q_index(b, h, p, tables_ref, lengths_ref):
+        return (b, h, 0, 0)
+
+    def kv_index(b, h, p, tables_ref, lengths_ref):
+        return (tables_ref[b, p], 0, h, 0)
+
+    def scale_index(b, h, p, tables_ref, lengths_ref):
+        return (tables_ref[b, p], 0, h)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, G, D), q_index),
+            pl.BlockSpec((1, ps, 1, D), kv_index),
+            pl.BlockSpec((1, ps, 1), scale_index),
+            pl.BlockSpec((1, ps, 1, D), kv_index),
+            pl.BlockSpec((1, ps, 1), scale_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, D), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((G, D), jnp.float32),    # acc
+            pltpu.VMEM((G, 128), jnp.float32),  # m (col 0; lane-tiled)
+            pltpu.VMEM((G, 128), jnp.float32),  # l
+        ],
+    )
+    try:
+        params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    except (AttributeError, TypeError):
+        params = pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel_int8, page_size=ps),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, h_kv, G, D), q.dtype),
+        compiler_params=params,
+        interpret=interpret,
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      qg, k_data, k_scale.astype(jnp.float32),
+      v_data, v_scale.astype(jnp.float32))
+    return out.reshape(B, H, D)
+
+
 def paged_attention(q, k_pages, v_pages, tables, lengths, *,
                     interpret=False):
     """q: (B, H, D) single decode token per row; k_pages/v_pages:

@@ -63,11 +63,54 @@ from avenir_tpu.infer.decode import (
     _normalize_stop,
     init_cache,
 )
+from avenir_tpu.infer.spec import draft_key, spec_accept
 from avenir_tpu.obs import NullSink, get_registry, span
+from avenir_tpu.ops.kv_quant import init_quant_kv, quant_slab_kv_ops
 from avenir_tpu.serve.pages import PagedHost, PagedPool, \
     init_paged_pool, paged_kv_ops
 from avenir_tpu.serve.scheduler import FCFSScheduler, Request
-from avenir_tpu.serve.slots import SlotPool, init_slot_pool
+from avenir_tpu.serve.slots import SlotPool, init_draft_pool, \
+    init_slot_pool
+
+
+def _splice_slot(dst, src, slot):
+    """Tree-mapped per-slot splice: update `dst`'s slot column (axis 1
+    after the layer axis) with `src`'s single-sequence column. Serves
+    dense arrays and QuantKV (data, scale) pairs with one code path —
+    each leaf's start-index tuple is rank-matched."""
+    return jax.tree.map(
+        lambda d, s: jax.lax.dynamic_update_slice(
+            d, s.astype(d.dtype), (0, slot) + (0,) * (d.ndim - 2)),
+        dst, src)
+
+
+def _seed_spec_slot(pool, dpool, dtmp, slot, logits_row, key_data,
+                    dkey_data, temp, top_k, dpos):
+    """The spec-admission tail, shared by the slab admit and the paged
+    chunk fn (one behavior, one site): sample the request's FIRST token
+    from its prefill logits with the slot's own key — the same split
+    the first sequential tick would consume, which is what makes greedy
+    spec output bit-identical from token one — then splice the draft's
+    prefilled cache, keys, and catch-up seed (prev=[tail], prev_n=1)
+    into the slot. Idempotent given the ORIGINAL request key, so the
+    uniform paged chunk fn can run it every chunk and only the final
+    chunk's values survive. Returns (pool, dpool, tail scalar)."""
+    keys1 = jax.random.wrap_key_data(key_data[None])
+    keys1, tail = _sample_rows(keys1, logits_row, temp[None], top_k[None])
+    upd = jax.lax.dynamic_update_slice
+    prev_row = jnp.zeros((1, dpool.prev.shape[1]), jnp.int32).at[
+        0, 0].set(tail[0].astype(jnp.int32))
+    pool = pool._replace(
+        rng=upd(pool.rng, jax.random.key_data(keys1), (slot, 0)))
+    dpool = dpool._replace(
+        k=_splice_slot(dpool.k, dtmp.k, slot),
+        v=_splice_slot(dpool.v, dtmp.v, slot),
+        rng=upd(dpool.rng, dkey_data[None], (slot, 0)),
+        pos=upd(dpool.pos, dpos[None].astype(jnp.int32), (slot,)),
+        prev=upd(dpool.prev, prev_row, (slot, 0)),
+        prev_n=upd(dpool.prev_n, jnp.ones((1,), jnp.int32), (slot,)),
+    )
+    return pool, dpool, tail[0]
 
 
 @dataclasses.dataclass
@@ -94,6 +137,11 @@ class _Live:
         self.text = "" if req is not None else None
         self.t_first = None
         self.t_last = None
+        # spec decoding (ISSUE 11): the request's first token is
+        # sampled at admission (inside the prefill dispatch, consuming
+        # the slot rng exactly like the first sequential tick) and
+        # harvested — prepended — with the slot's first verify tick
+        self.pending = []
 
 
 class Engine:
@@ -109,7 +157,8 @@ class Engine:
                  sink=None, seed=0, clock=None, kv_impl="slab",
                  page_size=16, n_pages=None, max_pages_per_seq=None,
                  prefill_chunk=None, prefix_sharing=True,
-                 paged_attn_impl="auto", tracer=None):
+                 paged_attn_impl="auto", tracer=None, kv_dtype="bf16",
+                 spec_decode="off", spec_k=4, draft_model=None):
         """`kv_impl` (ISSUE 9, the attn_impl/loss_impl pattern):
         'slab' keeps the fixed per-slot KV columns (serve/slots.py);
         'paged' stores KV in a pool of `n_pages` blocks of `page_size`
@@ -121,6 +170,25 @@ class Engine:
         tokens); `max_pages_per_seq` (default ceil(T_max/page_size))
         fixes the page-table width so allocation never retraces.
         `paged_attn_impl` = reference | pallas | auto (pallas on TPU).
+
+        `kv_dtype` (ISSUE 11, beside kv_impl/attn_impl): 'bf16' stores
+        KV in the model compute dtype; 'int8' quantizes on write with
+        per-(position, head) absmax scales (ops/kv_quant.py) — half the
+        decode-attend bandwidth and, per byte of HBM, twice the paged
+        token capacity. Numerics contract: logits-close to bf16, not
+        bitwise (the attn_impl tolerance pattern; tests pin all three
+        families in both layouts).
+
+        `spec_decode` (ISSUE 11): 'off' = sequential (one token per
+        tick); 'draft' = speculative — `draft_model` (same vocab;
+        fail-loud here, which IS the worker's hello) proposes `spec_k`
+        tokens per tick and the target verifies all of them in ONE
+        batched jitted step, harvesting 1..spec_k+1 tokens per slot
+        per tick. Rejection sampling (infer/spec.py) keeps emissions
+        exactly target-distributed, and top_k=1 (greedy) outputs are
+        BIT-identical to sequential `generate_cached` for any draft.
+        The draft's own KV rides a dense slab (`serve/slots.DraftPool`)
+        whatever this engine's kv_impl/kv_dtype.
 
         `tracer` (ISSUE 10): an obs/trace.py TraceBuffer (or Tracer)
         receiving per-request lifecycle events — engine_admit, prefill
@@ -141,6 +209,37 @@ class Engine:
         )
         assert kv_impl in ("slab", "paged"), f"unknown kv_impl {kv_impl!r}"
         self.kv_impl = kv_impl
+        assert kv_dtype in ("bf16", "int8"), f"unknown kv_dtype {kv_dtype!r}"
+        self.kv_dtype = kv_dtype
+        assert spec_decode in ("off", "draft"), (
+            f"unknown spec_decode {spec_decode!r}")
+        self.spec_decode = spec_decode
+        self.spec_k = int(spec_k)
+        assert self.spec_k >= 1
+        self.draft_model = draft_model
+        spec_on = spec_decode == "draft"
+        if spec_on:
+            # fail LOUD at construction — in a process worker this is
+            # the hello, so a draft/target mismatch refuses the
+            # handshake instead of emitting garbage under load
+            # (docs/OPERATIONS.md failure matrix)
+            if draft_model is None:
+                raise ValueError(
+                    "spec_decode='draft' needs a draft_model")
+            dcfg = draft_model.config
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft/target vocab mismatch: draft "
+                    f"{dcfg.vocab_size} != target {cfg.vocab_size} — "
+                    "speculative verification compares token "
+                    "distributions, the vocabularies must be the same "
+                    "model version (fail-loud at hello)")
+            if dcfg.block_size < self.T_max:
+                raise ValueError(
+                    f"draft block_size {dcfg.block_size} < engine "
+                    f"max_seq_len {self.T_max} — the draft must cover "
+                    "every position the target serves (fail-loud at "
+                    "hello)")
         self.detokenize = detokenize
         self._reg = registry if registry is not None else get_registry()
         self.sink = sink if sink is not None else NullSink()
@@ -158,8 +257,33 @@ class Engine:
         head_dim = cfg.n_embd // cfg.n_head
         from avenir_tpu.models.common import resolve_dtype
 
-        kv_dtype = resolve_dtype(cfg.compute_dtype)
+        pool_dtype = resolve_dtype(cfg.compute_dtype)
+        self._pool_dtype = pool_dtype
+        # spec verify writes [tail, d_1..d_k] at pos..pos+k, so both KV
+        # layouts carry a spec_k-position scratch tail past T_max —
+        # masked until overwritten, never attended past the accepted
+        # point (the slot-hygiene invariant covers rejected drafts)
+        self._spec_pad = self.spec_k if spec_on else 0
+        self._reg.gauge("kv_dtype").set(8 if kv_dtype == "int8" else 16)
         if kv_impl == "paged":
+            if spec_on and prefix_sharing:
+                # a prefix HIT skips computing the shared prompt region
+                # entirely — exact for the target (the attached pages
+                # ARE its KV) but the DRAFT has no shared pages: its
+                # slab would keep stale garbage under the prefix, so
+                # proposals q would condition on a previous tenant's
+                # state — collapsing accept rate on exactly the
+                # shared-prefix workload, and (worse) making sampled
+                # output depend on slot history instead of being a pure
+                # function of (prompt, rng), which the bit-identical
+                # failover-replay contract needs. Until the draft gets
+                # its own prefix store, spec decoding computes full
+                # prompts: sharing off, loudly.
+                warnings.warn(
+                    "spec_decode='draft' disables paged prefix sharing: "
+                    "the draft model must forward the full prompt "
+                    "(docs/SERVING.md)", stacklevel=2)
+                prefix_sharing = False
             self.page_size = int(page_size)
             assert self.page_size >= 1
             # equal-HBM default: the paged pool spends exactly the KV
@@ -169,26 +293,59 @@ class Engine:
                                         // self.page_size))
             self.max_pages_per_seq = int(
                 max_pages_per_seq if max_pages_per_seq is not None
-                else -(-self.T_max // self.page_size))
+                else -(-(self.T_max + self._spec_pad) // self.page_size))
             self.prefill_chunk = int(prefill_chunk or 4 * self.page_size)
             self._paged = PagedHost(
                 n_pages=self.n_pages, page_size=self.page_size,
                 n_slots=self.n_slots,
                 max_pages_per_seq=self.max_pages_per_seq,
                 prefill_chunk=self.prefill_chunk,
-                prefix_sharing=prefix_sharing)
+                prefix_sharing=prefix_sharing,
+                spec_pad=self._spec_pad)
             self.pool = init_paged_pool(
                 n_layer=cfg.n_layer, n_slots=self.n_slots,
                 n_pages=self.n_pages, page_size=self.page_size,
                 n_kv_head=n_kv, head_dim=head_dim,
-                vocab_size=cfg.vocab_size, dtype=kv_dtype,
+                vocab_size=cfg.vocab_size, dtype=pool_dtype,
+                kv_dtype=kv_dtype,
             )
         else:
             self._paged = None
             self.pool = init_slot_pool(
                 n_layer=cfg.n_layer, n_slots=self.n_slots,
-                max_t=self.T_max, n_kv_head=n_kv, head_dim=head_dim,
-                vocab_size=cfg.vocab_size, dtype=kv_dtype,
+                max_t=self.T_max + self._spec_pad, n_kv_head=n_kv,
+                head_dim=head_dim,
+                vocab_size=cfg.vocab_size, dtype=pool_dtype,
+                kv_dtype=kv_dtype,
+            )
+        # slab int8: KV reads/writes route through the quantized kv_ops
+        # pair; the single-token decode attend takes the fused Pallas
+        # int8 kernel on TPU (HBM moves int8 — the bandwidth win) and
+        # the dequant + dense reference elsewhere (CPU-testable)
+        self._slab_kv_ops = None
+        if kv_impl == "slab" and kv_dtype == "int8":
+            attend_fn = None
+            if jax.default_backend() == "tpu":
+                from avenir_tpu.ops.pallas.flash_attention import \
+                    decode_attention_int8
+
+                def attend_fn(q, kc, vc, q_pos):
+                    lengths = (q_pos[:, -1] + 1).astype(jnp.int32)
+                    return decode_attention_int8(
+                        q[:, 0], kc.data, kc.scale, vc.data, vc.scale,
+                        lengths)[:, None]
+
+            self._slab_kv_ops = quant_slab_kv_ops(pool_dtype, attend_fn)
+        self._dpool = None
+        if spec_on:
+            dcfg = draft_model.config
+            self._dpool = init_draft_pool(
+                n_layer=dcfg.n_layer, n_slots=self.n_slots,
+                max_t=self.T_max + self.spec_k,
+                n_kv_head=getattr(dcfg, "n_kv_head", dcfg.n_head),
+                head_dim=dcfg.n_embd // dcfg.n_head,
+                spec_k=self.spec_k,
+                dtype=resolve_dtype(dcfg.compute_dtype),
             )
         if getattr(cfg, "n_experts", 0):
             cap = max(1, int(-(-cfg.n_experts_per_tok * self.n_slots
@@ -207,25 +364,117 @@ class Engine:
         # a full parameter-pytree traversal on the per-token hot path.
         # Call refresh_state() after mutating weights in place.
         graphdef, self._state = nnx.split(model)
+        self._dgraphdef = self._dstate = None
+        if spec_on:
+            self._dgraphdef, self._dstate = nnx.split(draft_model)
         traces = self.traces
         if kv_impl == "paged":
             self._build_paged_fns(graphdef, traces, paged_attn_impl)
-            return
+        else:
+            self._build_slab_fns(graphdef, traces)
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def _admit(state, pool, idx_pad, slot, last_index, key_data, temp,
-                   top_k):
+    def _spec_core(self, m, dm, pool, dpool, active, kv_ops):
+        """The speculative tick, shared by both KV layouts — runs
+        INSIDE the jitted step (one dispatch): draft catch-up on last
+        tick's emissions, k autoregressive draft proposals, the ONE
+        batched target verify over [tail, d_1..d_k], then rejection-
+        sampling acceptance (infer/spec.py). Returns (toks (B, k+1),
+        counts (B,), new_pool, new_dpool) — fixed shapes; the variable
+        1..k+1 harvest is host bookkeeping over `counts`."""
+        K1 = dpool.prev.shape[1]
+        K = K1 - 1
+        # 1. draft catch-up: the draft saw only its own proposals last
+        # tick — feed it what was actually EMITTED (count-masked width
+        # k+1; padding rows land past every query position this tick
+        # and are overwritten by the proposals before ever attended)
+        dkeys = jax.random.wrap_key_data(dpool.rng)
+        q_all, dcache = _forward_cached(
+            dm, dpool.prev, KVCache(dpool.k, dpool.v), dpool.pos,
+            return_all=True)
+        q0 = jnp.take_along_axis(
+            q_all, (dpool.prev_n - 1)[:, None, None], axis=1)[:, 0]
+        dpos = dpool.pos + dpool.prev_n
+
+        # 2. k draft proposals, each sampled with the slot's OWN
+        # sampling params from the slot's draft key stream
+        def body(carry, mm):
+            dkeys, qlog, kc, vc, p = carry
+            dkeys, d = _sample_rows(dkeys, qlog, pool.temperature,
+                                    pool.top_k)
+            logits2, cache2 = _forward_cached(mm, d[:, None],
+                                              KVCache(kc, vc), p)
+            return (dkeys, logits2, cache2.k, cache2.v, p + 1), (d, qlog)
+
+        (dkeys, _, dk_new, dv_new, _), (drafts, q_logits) = nnx.scan(
+            body, in_axes=(nnx.Carry, None), out_axes=(nnx.Carry, 0),
+            length=K,
+        )((dkeys, q0, dcache.k, dcache.v, dpos), dm)
+        drafts = drafts.T                          # (B, K)
+        q_logits = jnp.moveaxis(q_logits, 0, 1)    # (B, K, V)
+
+        # 3. ONE batched target verify over [tail, d_1..d_k]: index i
+        # of the returned logits is p(.|prefix, d_1..d_i)
+        tail = jnp.take_along_axis(dpool.prev, (dpool.prev_n - 1)[:, None],
+                                   axis=1)
+        vin = jnp.concatenate([tail, drafts], axis=1)   # (B, K+1)
+        p_logits, cache = _forward_cached(
+            m, vin, KVCache(pool.k, pool.v), pool.pos, kv_ops=kv_ops,
+            return_all=True)
+
+        # 4. accept/reject: bit-greedy, distribution-exact otherwise
+        tkeys = jax.random.wrap_key_data(pool.rng)
+        tkeys, toks, counts = spec_accept(tkeys, p_logits, q_logits,
+                                          drafts, pool.temperature,
+                                          pool.top_k)
+        new_pool = pool._replace(
+            k=cache.k, v=cache.v,
+            rng=jax.random.key_data(tkeys),
+            pos=jnp.where(active, pool.pos + counts, pool.pos),
+        )
+        new_dpool = dpool._replace(
+            k=dk_new, v=dv_new,
+            rng=jax.random.key_data(dkeys),
+            pos=jnp.where(active, dpos, dpool.pos),
+            prev=jnp.where(active[:, None], toks, dpool.prev),
+            prev_n=jnp.where(active, counts, dpool.prev_n),
+        )
+        return toks, counts, new_pool, new_dpool
+
+    def _init_tmp_cache(self, width):
+        """Single-sequence temp cache for an admission prefill, in this
+        engine's kv_dtype (quantize-on-write starts at prefill — the
+        pool never holds a bf16 copy of anything)."""
+        cfg = self.model.config
+        n_kv = getattr(cfg, "n_kv_head", cfg.n_head)
+        head_dim = cfg.n_embd // cfg.n_head
+        shape = (cfg.n_layer, 1, width, n_kv, head_dim)
+        if self.kv_dtype == "int8":
+            return KVCache(init_quant_kv(shape), init_quant_kv(shape))
+        return KVCache(jnp.zeros(shape, self._pool_dtype),
+                       jnp.zeros(shape, self._pool_dtype))
+
+    def _build_slab_fns(self, graphdef, traces):
+        """The slab pool's jitted entry points: admission prefill and
+        the batched step (sequential or speculative). Compile budget
+        unchanged: one prefill trace per bucket + ONE step trace."""
+        dgraphdef = self._dgraphdef
+        spec_on = self.spec_decode == "draft"
+        slab_kv = self._slab_kv_ops
+        init_tmp = self._init_tmp_cache
+        dcfg = self.draft_model.config if spec_on else None
+
+        def _admit_body(state, pool, idx_pad, slot, last_index, key_data,
+                        temp, top_k):
             traces["prefill"].append(idx_pad.shape)
             m = nnx.merge(graphdef, state)
-            L, _, _, Hkv, D = pool.k.shape
-            tmp = init_cache(n_layer=L, batch=1, max_t=idx_pad.shape[1],
-                             n_kv_head=Hkv, head_dim=D, dtype=pool.k.dtype)
+            tmp = init_tmp(idx_pad.shape[1])
             logits, tmp = _forward_cached(m, idx_pad, tmp, 0,
-                                          last_index=last_index)
+                                          last_index=last_index,
+                                          kv_ops=slab_kv)
             upd = jax.lax.dynamic_update_slice
-            return SlotPool(
-                k=upd(pool.k, tmp.k, (0, slot, 0, 0, 0)),
-                v=upd(pool.v, tmp.v, (0, slot, 0, 0, 0)),
+            pool = pool._replace(
+                k=_splice_slot(pool.k, tmp.k, slot),
+                v=_splice_slot(pool.v, tmp.v, slot),
                 logits=upd(pool.logits, logits, (slot, 0)),
                 rng=upd(pool.rng, key_data[None], (slot, 0)),
                 pos=upd(pool.pos, (last_index + 1)[None].astype(jnp.int32),
@@ -233,6 +482,49 @@ class Engine:
                 temperature=upd(pool.temperature, temp[None], (slot,)),
                 top_k=upd(pool.top_k, top_k[None], (slot,)),
             )
+            return pool
+
+        if spec_on:
+            # spec admission = the sequential one PLUS: the draft
+            # prefills the same prompt into its slab column, and the
+            # request's FIRST token (the "tail") is sampled here from
+            # the prefill logits — consuming the slot's rng exactly as
+            # the first sequential decode tick would, which is what
+            # keeps greedy spec output bit-identical from token one
+            @functools.partial(jax.jit, donate_argnums=(1, 2))
+            def _admit_spec(state, pool, dpool, dstate, idx_pad, slot,
+                            last_index, key_data, dkey_data, temp, top_k):
+                pool = _admit_body(state, pool, idx_pad, slot, last_index,
+                                   key_data, temp, top_k)
+                dm = nnx.merge(dgraphdef, dstate)
+                n_kv_d = getattr(dcfg, "n_kv_head", dcfg.n_head)
+                dshape = (dcfg.n_layer, 1, idx_pad.shape[1], n_kv_d,
+                          dcfg.n_embd // dcfg.n_head)
+                dtmp = KVCache(jnp.zeros(dshape, dpool.k.dtype),
+                               jnp.zeros(dshape, dpool.v.dtype))
+                _, dtmp = _forward_cached(dm, idx_pad, dtmp, 0,
+                                          last_index=last_index)
+                logits_row = jax.lax.dynamic_slice_in_dim(
+                    pool.logits, slot, 1, axis=0)
+                return _seed_spec_slot(pool, dpool, dtmp, slot,
+                                       logits_row, key_data, dkey_data,
+                                       temp, top_k, last_index + 1)
+
+            self._admit = _admit_spec
+
+            @functools.partial(jax.jit, donate_argnums=(2, 3))
+            def _spec_step(state, dstate, pool, dpool, active):
+                traces["step"].append(True)
+                m = nnx.merge(graphdef, state)
+                dm = nnx.merge(dgraphdef, dstate)
+                return self._spec_core(m, dm, pool, dpool, active,
+                                       slab_kv)
+
+            self._step_fn = _spec_step
+            return
+
+        self._admit = functools.partial(jax.jit, donate_argnums=(1,))(
+            _admit_body)
 
         # ONE step variant on purpose: the engine's compile budget
         # (buckets + 1 decode step, asserted) is the contract we keep.
@@ -251,15 +543,14 @@ class Engine:
                                       pool.top_k)
             logits, cache = _forward_cached(m, toks[:, None],
                                             KVCache(pool.k, pool.v),
-                                            pool.pos)
+                                            pool.pos, kv_ops=slab_kv)
             pos = jnp.where(active, pool.pos + 1, pool.pos)
-            return toks, SlotPool(
+            return toks, pool._replace(
                 k=cache.k, v=cache.v, logits=logits,
                 rng=jax.random.key_data(keys), pos=pos,
-                temperature=pool.temperature, top_k=pool.top_k,
             )
 
-        self._admit, self._step_fn = _admit, _step
+        self._step_fn = _step
 
     def _build_paged_fns(self, graphdef, traces, paged_attn_impl):
         """The paged pool's three jitted entry points (ISSUE 9):
@@ -275,8 +566,20 @@ class Engine:
                         else "reference")
         assert resolved in ("reference", "pallas"), paged_attn_impl
         self.paged_attn_impl = resolved
+        kv_dtype = self.kv_dtype
+        compute_dtype = self._pool_dtype
         attend_fn = None
-        if resolved == "pallas":
+        if resolved == "pallas" and kv_dtype == "int8":
+            from avenir_tpu.ops.pallas.paged_attention import \
+                paged_attention_int8
+
+            def attend_fn(q, kc, vc, q_pos, tables):
+                lengths = (q_pos[:, -1] + 1).astype(jnp.int32)
+                return paged_attention_int8(
+                    q[:, 0], kc.data, kc.scale, vc.data, vc.scale,
+                    tables, lengths)[:, None]
+
+        elif resolved == "pallas":
             from avenir_tpu.ops.pallas.paged_attention import \
                 paged_attention
 
@@ -288,14 +591,20 @@ class Engine:
                                        lengths)[:, None]
 
         n_pg, ps, P = self.n_pages, self.page_size, self.max_pages_per_seq
+        dgraphdef = self._dgraphdef
+        spec_on = self.spec_decode == "draft"
+        dcfg = self.draft_model.config if spec_on else None
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def _chunk(state, pool, idx, table_row, slot, start, n_real,
-                   key_data, temp, top_k):
+        def _kv(tables, **kw):
+            return paged_kv_ops(tables, n_pages=n_pg, page_size=ps,
+                                kv_dtype=kv_dtype,
+                                compute_dtype=compute_dtype, **kw)
+
+        def _chunk_body(state, pool, idx, table_row, slot, start, n_real,
+                        key_data, temp, top_k):
             traces["prefill"].append(idx.shape)
             m = nnx.merge(graphdef, state)
-            kv = paged_kv_ops(table_row[None], n_pages=n_pg, page_size=ps,
-                              n_real=n_real)
+            kv = _kv(table_row[None], n_real=n_real)
             logits, cache = _forward_cached(
                 m, idx, KVCache(pool.k, pool.v), start,
                 last_index=n_real - 1, kv_ops=kv)
@@ -304,7 +613,7 @@ class Engine:
             # final chunk, whose splice is the one decode samples from),
             # so a prompt of any length costs ladder-bounded compiles
             upd = jax.lax.dynamic_update_slice
-            return PagedPool(
+            return pool._replace(
                 k=cache.k, v=cache.v,
                 logits=upd(pool.logits, logits, (slot, 0)),
                 rng=upd(pool.rng, key_data[None], (slot, 0)),
@@ -312,35 +621,90 @@ class Engine:
                         (start + n_real)[None].astype(jnp.int32), (slot,)),
                 temperature=upd(pool.temperature, temp[None], (slot,)),
                 top_k=upd(pool.top_k, top_k[None], (slot,)),
-            )
+            ), logits
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def _step(state, pool, active, tables):
-            traces["step"].append(True)
-            m = nnx.merge(graphdef, state)
-            keys = jax.random.wrap_key_data(pool.rng)
-            keys, toks = _sample_rows(keys, pool.logits, pool.temperature,
-                                      pool.top_k)
-            kv = paged_kv_ops(tables, n_pages=n_pg, page_size=ps,
-                              write_mask=active, attend_fn=attend_fn)
-            logits, cache = _forward_cached(m, toks[:, None],
-                                            KVCache(pool.k, pool.v),
-                                            pool.pos, kv_ops=kv)
-            pos = jnp.where(active, pool.pos + 1, pool.pos)
-            return toks, PagedPool(
-                k=cache.k, v=cache.v, logits=logits,
-                rng=jax.random.key_data(keys), pos=pos,
-                temperature=pool.temperature, top_k=pool.top_k,
-            )
+        if spec_on:
+            # the chunk fn stays UNIFORM across chunks: the draft
+            # forwards the same chunk into its slab column, and the
+            # tail/prev/rng splices recompute idempotently from the
+            # ORIGINAL request key every chunk — only the final chunk's
+            # values survive, so chunk count never forks the compile
+            @functools.partial(jax.jit, donate_argnums=(1, 2))
+            def _chunk_spec(state, pool, dpool, dstate, idx, table_row,
+                            slot, start, n_real, key_data, dkey_data,
+                            temp, top_k):
+                pool, logits = _chunk_body(state, pool, idx, table_row,
+                                           slot, start, n_real, key_data,
+                                           temp, top_k)
+                dm = nnx.merge(dgraphdef, dstate)
+                # draft chunk: read-modify-write the slot's draft slab
+                # column at a traced index (dynamic_slice, not [slot])
+                dk = jax.lax.dynamic_slice_in_dim(dpool.k, slot, 1,
+                                                  axis=1)
+                dv = jax.lax.dynamic_slice_in_dim(dpool.v, slot, 1,
+                                                  axis=1)
+                _, dtmp = _forward_cached(dm, idx, KVCache(dk, dv), start,
+                                          last_index=n_real - 1)
+                return _seed_spec_slot(pool, dpool, dtmp, slot, logits,
+                                       key_data, dkey_data, temp, top_k,
+                                       start + n_real)
+
+            self._chunk_fn = _chunk_spec
+
+            @functools.partial(jax.jit, donate_argnums=(2, 3))
+            def _spec_step(state, dstate, pool, dpool, active, tables,
+                           write_limit):
+                traces["step"].append(True)
+                m = nnx.merge(graphdef, state)
+                dm = nnx.merge(dgraphdef, dstate)
+                # verify is a MULTI-token write: the per-row write_limit
+                # drops scratch positions past the slot's allocated page
+                # coverage (a clipped page_slot would corrupt a page the
+                # 0-padded table names); attend_fn only serves width-1
+                # queries, so verify reads take the gather reference
+                kv = _kv(tables, write_mask=active,
+                         write_limit=write_limit, attend_fn=attend_fn)
+                return self._spec_core(m, dm, pool, dpool, active, kv)
+
+            self._step_fn = _spec_step
+        else:
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def _chunk(state, pool, idx, table_row, slot, start, n_real,
+                       key_data, temp, top_k):
+                pool, _ = _chunk_body(state, pool, idx, table_row, slot,
+                                      start, n_real, key_data, temp,
+                                      top_k)
+                return pool
+
+            self._chunk_fn = _chunk
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def _step(state, pool, active, tables):
+                traces["step"].append(True)
+                m = nnx.merge(graphdef, state)
+                keys = jax.random.wrap_key_data(pool.rng)
+                keys, toks = _sample_rows(keys, pool.logits,
+                                          pool.temperature, pool.top_k)
+                kv = _kv(tables, write_mask=active, attend_fn=attend_fn)
+                logits, cache = _forward_cached(m, toks[:, None],
+                                                KVCache(pool.k, pool.v),
+                                                pool.pos, kv_ops=kv)
+                pos = jnp.where(active, pool.pos + 1, pool.pos)
+                return toks, pool._replace(
+                    k=cache.k, v=cache.v, logits=logits,
+                    rng=jax.random.key_data(keys), pos=pos,
+                )
+
+            self._step_fn = _step
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def _cow(pool, src, dst):
             traces["cow"].append(True)
-            return pool._replace(
-                k=pool.k.at[:, dst].set(pool.k[:, src]),
-                v=pool.v.at[:, dst].set(pool.v[:, src]))
+            cp = lambda a: a.at[:, dst].set(a[:, src])
+            return pool._replace(k=jax.tree.map(cp, pool.k),
+                                 v=jax.tree.map(cp, pool.v))
 
-        self._chunk_fn, self._step_fn, self._cow_fn = _chunk, _step, _cow
+        self._cow_fn = _cow
 
     # ---- API ----
 
@@ -350,12 +714,15 @@ class Engine:
         fit this. Slab: T_max. Paged: also the per-sequence page budget
         (max_pages_per_seq * page_size) AND the whole pool (a request
         whose worst case exceeds n_pages could block the FCFS head
-        forever waiting on pages that cannot exist) — whichever binds."""
+        forever waiting on pages that cannot exist) — whichever binds.
+        Spec decoding shaves its scratch tail (spec_k positions) off
+        the paged budget: the reservation must cover verify writes past
+        the last real token."""
         if self._paged is None:
             return self.T_max
         return min(self.T_max,
                    min(self.max_pages_per_seq, self.n_pages)
-                   * self.page_size)
+                   * self.page_size - self._spec_pad)
 
     @property
     def limit_name(self):
@@ -363,7 +730,7 @@ class Engine:
         records so a caller knows WHAT to raise (ISSUE 9 satellite)."""
         if (self._paged is not None
                 and min(self.max_pages_per_seq, self.n_pages)
-                * self.page_size <= self.T_max):
+                * self.page_size - self._spec_pad <= self.T_max):
             return "page_budget"
         return "max_seq_len"
 
@@ -496,6 +863,7 @@ class Engine:
         for req in self.sched.expire_queued(self._clock(),
                                             lookahead_s=self.tick_estimate_s()):
             finished.append(self._finish_queued_timeout(req))
+        spec_on = self.spec_decode == "draft"
         for req, slot in self.sched.take_admissions():
             t0 = len(req.prompt)
             t_pad = self.sched.bucket(t0)
@@ -508,23 +876,42 @@ class Engine:
             idx = np.zeros((1, t_pad), np.int32)
             idx[0, :t0] = req.prompt
             k_eff = V if req.top_k is None else max(1, min(int(req.top_k), V))
+            live = _Live(req)
             with span("serve_prefill", registry=self._reg):
-                self.pool = self._admit(
-                    state, self.pool, jnp.asarray(idx), jnp.int32(slot),
-                    jnp.int32(t0 - 1), jax.random.key_data(req.rng),
-                    jnp.float32(req.temperature), jnp.int32(k_eff),
-                )
-            self._live[slot] = _Live(req)
+                if spec_on:
+                    self.pool, self._dpool, tail = self._admit(
+                        state, self.pool, self._dpool, self._dstate,
+                        jnp.asarray(idx), jnp.int32(slot),
+                        jnp.int32(t0 - 1), jax.random.key_data(req.rng),
+                        jax.random.key_data(draft_key(req.rng)),
+                        jnp.float32(req.temperature), jnp.int32(k_eff),
+                    )
+                    live.pending = [int(tail)]
+                else:
+                    self.pool = self._admit(
+                        state, self.pool, jnp.asarray(idx), jnp.int32(slot),
+                        jnp.int32(t0 - 1), jax.random.key_data(req.rng),
+                        jnp.float32(req.temperature), jnp.int32(k_eff),
+                    )
+            self._live[slot] = live
 
         if self._live:
             active = np.zeros((self.n_slots,), bool)
             active[list(self._live)] = True
             t_tick = self._clock()
+            counts = None
             with span("serve_decode", registry=self._reg):
-                toks, self.pool = self._step_fn(state, self.pool,
-                                                jnp.asarray(active))
-                toks = np.asarray(toks)  # the per-iteration D2H fence
-            self._harvest_tokens(toks, t_tick, finished)
+                if spec_on:
+                    toks, counts, self.pool, self._dpool = self._step_fn(
+                        state, self._dstate, self.pool, self._dpool,
+                        jnp.asarray(active))
+                    toks = np.asarray(toks)   # the per-iteration D2H fence
+                    counts = np.asarray(counts)
+                else:
+                    toks, self.pool = self._step_fn(state, self.pool,
+                                                    jnp.asarray(active))
+                    toks = np.asarray(toks)  # the per-iteration D2H fence
+            self._harvest_tokens(toks, t_tick, finished, counts=counts)
         self._set_gauges()
         assert len(self.traces["prefill"]) <= len(self.sched.ladder), (
             "prefill compiles escaped the bucket ladder"
@@ -596,14 +983,29 @@ class Engine:
             idx[0, :n_real] = req.prompt[start:start + n_real]
             k_eff = V if req.top_k is None else max(1, min(int(req.top_k),
                                                            V))
+            spec_on = self.spec_decode == "draft"
+            tail = None
             with span("serve_prefill", registry=self._reg):
-                self.pool = self._chunk_fn(
-                    state, self.pool, jnp.asarray(idx),
-                    jnp.asarray(pg.table_row(req.req_id)),
-                    jnp.int32(slot), jnp.int32(start), jnp.int32(n_real),
-                    jax.random.key_data(req.rng),
-                    jnp.float32(req.temperature), jnp.int32(k_eff),
-                )
+                if spec_on:
+                    self.pool, self._dpool, tail = self._chunk_fn(
+                        state, self.pool, self._dpool, self._dstate,
+                        jnp.asarray(idx),
+                        jnp.asarray(pg.table_row(req.req_id)),
+                        jnp.int32(slot), jnp.int32(start),
+                        jnp.int32(n_real),
+                        jax.random.key_data(req.rng),
+                        jax.random.key_data(draft_key(req.rng)),
+                        jnp.float32(req.temperature), jnp.int32(k_eff),
+                    )
+                else:
+                    self.pool = self._chunk_fn(
+                        state, self.pool, jnp.asarray(idx),
+                        jnp.asarray(pg.table_row(req.req_id)),
+                        jnp.int32(slot), jnp.int32(start),
+                        jnp.int32(n_real),
+                        jax.random.key_data(req.rng),
+                        jnp.float32(req.temperature), jnp.int32(k_eff),
+                    )
             self._reg.counter("prefill_chunks").add(1)
             st.next = start + n_real
             budget -= n_real
@@ -612,13 +1014,25 @@ class Engine:
                 # prefill done — the slot joins THIS tick's decode (the
                 # slab engine's admission->decode-same-tick semantics)
                 pg.finish_prefill(slot)
-                self._live[slot] = _Live(req)
+                live = _Live(req)
+                if spec_on:
+                    # only the FINAL chunk's tail is real (earlier
+                    # chunks' samples were idempotent overwrites) — one
+                    # small D2H per finished prefill, never per token
+                    live.pending = [int(tail)]
+                self._live[slot] = live
         if self._live:
+            spec_on = self.spec_decode == "draft"
             for slot in sorted(self._live):
                 live = self._live[slot]
-                cow = pg.ensure_decode_page(
-                    live.req.req_id,
-                    len(live.req.prompt) + len(live.emitted))
+                # spec verify writes tail..tail+spec_k — pages must
+                # cover the whole scratch window (the admission
+                # reservation's spec_pad guarantees they can)
+                next_pos = (len(live.req.prompt) + len(live.emitted)
+                            + len(live.pending) - 1 + self._spec_pad
+                            if spec_on else
+                            len(live.req.prompt) + len(live.emitted))
+                cow = pg.ensure_decode_page(live.req.req_id, next_pos)
                 if cow is not None:
                     if self._tr is not None:
                         self._tr.emit(live.req.req_id, "cow",
@@ -628,12 +1042,28 @@ class Engine:
             active = np.zeros((self.n_slots,), bool)
             active[list(self._live)] = True
             t_tick = self._clock()
+            counts = None
             with span("serve_decode", registry=self._reg):
-                toks, self.pool = self._step_fn(
-                    state, self.pool, jnp.asarray(active),
-                    jnp.asarray(pg.tables_array()))
-                toks = np.asarray(toks)  # the per-iteration D2H fence
-            self._harvest_tokens(toks, t_tick, finished)
+                if spec_on:
+                    # per-slot allocated token coverage: the write mask
+                    # for scratch positions past the last owned page
+                    limit = np.zeros((self.n_slots,), np.int32)
+                    for slot, rid in pg.rid_of.items():
+                        limit[slot] = (len(pg.alloc.table(rid))
+                                       * self.page_size)
+                    toks, counts, self.pool, self._dpool = self._step_fn(
+                        state, self._dstate, self.pool, self._dpool,
+                        jnp.asarray(active),
+                        jnp.asarray(pg.tables_array()),
+                        jnp.asarray(limit))
+                    toks = np.asarray(toks)
+                    counts = np.asarray(counts)
+                else:
+                    toks, self.pool = self._step_fn(
+                        state, self.pool, jnp.asarray(active),
+                        jnp.asarray(pg.tables_array()))
+                    toks = np.asarray(toks)  # the per-iteration D2H fence
+            self._harvest_tokens(toks, t_tick, finished, counts=counts)
         self._set_gauges()
         a = pg.alloc.stats()
         self._reg.gauge("kv_pages_free").set(a["free"] + a["cached"])
@@ -649,45 +1079,83 @@ class Engine:
         assert len(self.traces["cow"]) <= 1, "the COW copy retraced"
         return finished
 
-    def _harvest_tokens(self, toks, t_tick, finished):
+    def _harvest_tokens(self, toks, t_tick, finished, counts=None):
         """Post-decode harvest shared by both KV impls: per-slot token
         append/detokenize, stop/budget checks, then deadline eviction
         AFTER harvest — this iteration's token is kept (the request
         pays for it either way), then the slot is recycled; surviving
         co-tenants are untouched, so their streams stay bit-identical
         to a one-shot run (the same argument as stop-token recycling;
-        parity-tested)."""
+        parity-tested).
+
+        `counts` (spec decoding, ISSUE 11): toks is (B, spec_k+1) and
+        each live slot harvests its first counts[slot] entries — plus
+        any admission-sampled pending first token — IN ORDER, with the
+        stop/budget check after every token, so a mid-block stop or a
+        budget edge truncates exactly where sequential decoding would
+        have stopped (the device may have verified further; those
+        tokens are discarded with the slot, like any over-advanced
+        speculative state)."""
         now = self._clock()
         self._tick_s.append(now - t_tick)
         if len(self._tick_s) > 64:
             del self._tick_s[:32]
         tr = self._tr
+        n_live = len(self._live)
+        spec_accepted = 0
+        if counts is not None:
+            # accepted DRAFT tokens this tick (the bonus/correction
+            # token is target-sampled, not a draft acceptance)
+            spec_accepted = int(sum(int(counts[s]) - 1
+                                    for s in self._live))
+            self._reg.counter("spec_proposed").add(self.spec_k * n_live)
+            self._reg.counter("spec_accepted").add(spec_accepted)
+            prop = self._reg.counter("spec_proposed").total
+            acc = self._reg.counter("spec_accepted").total
+            self._reg.gauge("spec_accept_rate").set(
+                acc / prop if prop else 0.0)
+        # decode ticks ever == batched model passes (the denominator of
+        # the effective tokens-per-model-pass headline, tools/
+        # bench_decode.py) — counted with or without tracing
+        self._tick_n += 1
         if tr is not None:
             # SAMPLED: one event per decode_sample batched iterations —
             # tracing on must not write an event per token either
-            self._tick_n += 1
             if self._tick_n % tr.decode_sample == 0:
                 tr.emit(None, "decode_tick", t=now,
-                        n_live=len(self._live), tick=self._tick_n)
-        self._reg.counter("tokens_out").add(len(self._live))
+                        n_live=n_live, tick=self._tick_n)
+                if counts is not None:
+                    tr.emit(None, "spec_verify", t=now,
+                            proposed=self.spec_k * n_live,
+                            accepted=spec_accepted, tick=self._tick_n)
+        emitted_total = 0
         for slot in sorted(self._live):
             live = self._live[slot]
-            tok = int(toks[slot])
-            live.emitted.append(tok)
-            if live.t_first is None:
-                live.t_first = now
-                self._reg.hist("ttft_ms").observe(
-                    (now - live.req.submit_t) * 1e3)
-                if tr is not None:
-                    tr.emit(live.req.req_id, "first_token", t=now,
-                            slot=slot)
-            live.t_last = now
-            if self.detokenize is not None:
-                live.text += self.detokenize([tok])
-            hit_stop = tok in live.req.stop_tokens
-            if hit_stop or len(live.emitted) >= live.req.max_new_tokens:
-                finished.append(self._finish(
-                    slot, live, "stop" if hit_stop else "length"))
+            if counts is None:
+                seq = [int(toks[slot])]
+            else:
+                seq = list(live.pending)
+                live.pending = []
+                seq += [int(t) for t in toks[slot][:int(counts[slot])]]
+            for tok in seq:
+                live.emitted.append(tok)
+                emitted_total += 1
+                if live.t_first is None:
+                    live.t_first = now
+                    self._reg.hist("ttft_ms").observe(
+                        (now - live.req.submit_t) * 1e3)
+                    if tr is not None:
+                        tr.emit(live.req.req_id, "first_token", t=now,
+                                slot=slot)
+                live.t_last = now
+                if self.detokenize is not None:
+                    live.text += self.detokenize([tok])
+                hit_stop = tok in live.req.stop_tokens
+                if hit_stop or len(live.emitted) >= live.req.max_new_tokens:
+                    finished.append(self._finish(
+                        slot, live, "stop" if hit_stop else "length"))
+                    break
+        self._reg.counter("tokens_out").add(emitted_total)
         now = self._clock()
         for slot in sorted(self._live):
             live = self._live[slot]

@@ -417,8 +417,20 @@ class PagedPool(NamedTuple):
 
 
 def init_paged_pool(*, n_layer, n_slots, n_pages, page_size, n_kv_head,
-                    head_dim, vocab_size, dtype):
+                    head_dim, vocab_size, dtype, kv_dtype="bf16"):
     kv_shape = (n_layer, n_pages, page_size, n_kv_head, head_dim)
+    if kv_dtype == "int8":
+        from avenir_tpu.ops.kv_quant import init_quant_kv
+
+        return PagedPool(
+            k=init_quant_kv(kv_shape),
+            v=init_quant_kv(kv_shape),
+            logits=jnp.zeros((n_slots, vocab_size), jnp.float32),
+            rng=jnp.zeros((n_slots, key_data_width()), jnp.uint32),
+            pos=jnp.zeros((n_slots,), jnp.int32),
+            temperature=jnp.ones((n_slots,), jnp.float32),
+            top_k=jnp.full((n_slots,), vocab_size, jnp.int32),
+        )
     return PagedPool(
         k=jnp.zeros(kv_shape, dtype),
         v=jnp.zeros(kv_shape, dtype),
@@ -431,7 +443,8 @@ def init_paged_pool(*, n_layer, n_slots, n_pages, page_size, n_kv_head,
 
 
 def paged_kv_ops(tables, *, n_pages, page_size, n_real=None,
-                 write_mask=None, attend_fn=None):
+                 write_mask=None, attend_fn=None, kv_dtype="bf16",
+                 compute_dtype=None, write_limit=None):
     """(write, attend) pair for `infer.decode._forward_cached` over a
     paged layer cache of shape (n_pages, page_size, H_kv, D).
 
@@ -444,12 +457,42 @@ def paged_kv_ops(tables, *, n_pages, page_size, n_real=None,
     Reads gather the table's pages into a (B, P*page_size, ...) view
     and reuse the dense `_attend_cached` — bit-identical to the slab
     path (tests pin it); `attend_fn`, when given, replaces the gather
-    for single-token queries (the Pallas decode kernel)."""
+    for single-token queries (the Pallas decode kernel).
+
+    ISSUE 11 additions:
+      - a THIRD write form, (B, T>1) at per-row positions — the spec-
+        decode verify forward writes [tail, d_1..d_k] per slot in one
+        dispatch; `write_limit` (B,) drops any position >= the row's
+        allocated token coverage (a clipped page_slot on an unallocated
+        position would silently corrupt whatever page the table's 0-pad
+        names), and `write_mask` drops inactive rows whole.
+      - `kv_dtype='int8'`: kc/vc are ops/kv_quant.QuantKV pairs;
+        writes quantize per (position, head) before the scatter and the
+        gather path dequantizes into `compute_dtype` before the dense
+        attend (the parity-tolerance reference; `attend_fn` gets the
+        QuantKV halves for the fused Pallas int8 kernel)."""
     B, P = tables.shape
     ps = page_size
+    quant = kv_dtype == "int8"
+    if quant:
+        from avenir_tpu.ops.kv_quant import QuantKV, dequantize, quantize
+
+    def _scatter(c, data, scale, phys, off):
+        if quant:
+            return QuantKV(
+                c.data.at[phys, off].set(data, mode="drop"),
+                c.scale.at[phys, off].set(scale, mode="drop"))
+        return c.at[phys, off].set(data.astype(c.dtype), mode="drop")
+
+    def _prep(c, x):
+        """Quantize (or cast) the new K/V block for scattering."""
+        if quant:
+            d, s = quantize(x)
+            return d, s
+        return x, None
 
     def write(kc, vc, k, v, pos):
-        if getattr(pos, "ndim", 0) == 1:
+        if getattr(pos, "ndim", 0) == 1 and k.shape[1] == 1:
             # decode: (B, 1, H_kv, D) at per-row positions
             page_slot = jnp.clip(pos // ps, 0, P - 1)
             phys = jnp.take_along_axis(tables, page_slot[:, None],
@@ -457,11 +500,25 @@ def paged_kv_ops(tables, *, n_pages, page_size, n_real=None,
             if write_mask is not None:
                 phys = jnp.where(write_mask, phys, n_pages)  # dropped
             off = pos % ps
-            kc = kc.at[phys, off].set(k[:, 0].astype(kc.dtype),
-                                      mode="drop")
-            vc = vc.at[phys, off].set(v[:, 0].astype(vc.dtype),
-                                      mode="drop")
-            return kc, vc
+            kd, ks = _prep(kc, k[:, 0])
+            vd, vs = _prep(vc, v[:, 0])
+            return (_scatter(kc, kd, ks, phys, off),
+                    _scatter(vc, vd, vs, phys, off))
+        if getattr(pos, "ndim", 0) == 1:
+            # spec verify: (B, T) tokens at per-row start positions
+            T = k.shape[1]
+            offs = pos[:, None] + jnp.arange(T)[None]        # (B, T)
+            page_slot = jnp.clip(offs // ps, 0, P - 1)
+            phys = jnp.take_along_axis(tables, page_slot, axis=1)
+            if write_mask is not None:
+                phys = jnp.where(write_mask[:, None], phys, n_pages)
+            if write_limit is not None:
+                phys = jnp.where(offs < write_limit[:, None], phys,
+                                 n_pages)
+            kd, ks = _prep(kc, k)
+            vd, vs = _prep(vc, v)
+            return (_scatter(kc, kd, ks, phys, offs % ps),
+                    _scatter(vc, vd, vs, phys, offs % ps))
         # chunk prefill: B == 1, scalar start position
         T = k.shape[1]
         offs = pos + jnp.arange(T)
@@ -469,18 +526,27 @@ def paged_kv_ops(tables, *, n_pages, page_size, n_real=None,
         phys = tables[0][page_slot]
         if n_real is not None:
             phys = jnp.where(jnp.arange(T) < n_real, phys, n_pages)
-        kc = kc.at[phys, offs % ps].set(k[0].astype(kc.dtype),
-                                       mode="drop")
-        vc = vc.at[phys, offs % ps].set(v[0].astype(vc.dtype),
-                                       mode="drop")
-        return kc, vc
+        kd, ks = _prep(kc, k[0])
+        vd, vs = _prep(vc, v[0])
+        return (_scatter(kc, kd, ks, phys, offs % ps),
+                _scatter(vc, vd, vs, phys, offs % ps))
+
+    def _gather(c):
+        if quant:
+            # gather FIRST, dequantize the (B, P*ps, ...) view — never
+            # materialize a dense copy of the whole pool (the reference
+            # path serves every multi-token spec verify, so its traffic
+            # must stay proportional to the attended window)
+            g = QuantKV(
+                c.data[tables].reshape(B, P * ps, *c.data.shape[-2:]),
+                c.scale[tables].reshape(B, P * ps, c.scale.shape[-1]))
+            return dequantize(g, compute_dtype or jnp.float32)
+        return c[tables].reshape(B, P * ps, *c.shape[-2:])
 
     def attend(q, kc, vc, q_pos):
         if attend_fn is not None and q.shape[1] == 1:
             return attend_fn(q, kc, vc, q_pos, tables)
-        kg = kc[tables].reshape(B, P * ps, *kc.shape[-2:])
-        vg = vc[tables].reshape(B, P * ps, *vc.shape[-2:])
-        return _attend_cached(q, kg, vg, q_pos)
+        return _attend_cached(q, _gather(kc), _gather(vc), q_pos)
 
     return write, attend
 
@@ -511,13 +577,20 @@ class PagedHost:
     """
 
     def __init__(self, *, n_pages, page_size, n_slots, max_pages_per_seq,
-                 prefill_chunk, prefix_sharing=True):
+                 prefill_chunk, prefix_sharing=True, spec_pad=0):
         self.alloc = PageAllocator(n_pages, page_size,
                                    prefix_sharing=prefix_sharing)
         self.page_size = int(page_size)
         self.n_slots = int(n_slots)
         self.max_pages_per_seq = int(max_pages_per_seq)
         self.prefill_chunk = int(prefill_chunk)
+        # speculative-decode scratch (ISSUE 11): the verify forward
+        # writes up to spec_k positions PAST the request's last real
+        # token, so admission reserves ceil((prompt + max_new +
+        # spec_pad) / page_size) pages — the out-of-pages-wall guarantee
+        # must cover the scratch tail too (a per-request capacity cost
+        # of at most ceil(spec_k/page_size)+1 pages, docs/SERVING.md)
+        self.spec_pad = int(spec_pad)
         self.chunk_ladder = bucket_ladder(self.prefill_chunk)
         self.prefill = {}     # slot -> _PrefillState (admission order)
         self.rid_of = {}      # slot -> rid (prefilling or live)
@@ -532,7 +605,7 @@ class PagedHost:
         return blocks the queue head). True COMMITS allocator state —
         the scheduler hands the request a slot in the same call."""
         plan = self.alloc.admit(req.req_id, req.prompt,
-                                req.max_new_tokens)
+                                req.max_new_tokens + self.spec_pad)
         if plan is None:
             return False
         self._plans[req.req_id] = plan

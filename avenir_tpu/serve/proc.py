@@ -191,12 +191,17 @@ class ProcReplica(ReplicaHealth):
                  sink=None, seed=0, clock=None, stall_floor_secs=10.0,
                  stall_factor=10.0, rpc_slack_secs=5.0,
                  compile_grace_secs=300.0, env=None,
-                 defer_handshake=False, engine_kwargs=None, trace=0):
+                 defer_handshake=False, engine_kwargs=None, trace=0,
+                 draft_spec=None):
         super().__init__(
             replica_id,
             clock=clock if clock is not None else time.perf_counter,
             stall_floor_secs=stall_floor_secs, stall_factor=stall_factor)
         self._spec = model_spec
+        # spec-decode draft weights ride the hello exactly like target
+        # weights (ISSUE 11) — same spec shapes, incl. {"kind":
+        # "checkpoint"} to keep a big draft off the pipe
+        self._draft_spec = draft_spec
         self._ekw = {"n_slots": int(n_slots), "max_seq_len": max_seq_len,
                      "detokenize": detokenize, "seed": int(seed),
                      # paged-KV knobs ride the hello (ISSUE 9)
@@ -272,10 +277,11 @@ class ProcReplica(ReplicaHealth):
         """Send hello, block for the worker's reply; fail loud on a
         protocol mismatch (never guess at an incompatible peer)."""
         self._seq += 1
-        self._stream.write(
-            {"op": "hello", "seq": self._seq, "proto": PROTO_VERSION,
-             "model": self._spec, "engine": self._ekw},
-            ptype=PT_PICKLE)
+        hello = {"op": "hello", "seq": self._seq, "proto": PROTO_VERSION,
+                 "model": self._spec, "engine": self._ekw}
+        if self._draft_spec is not None:
+            hello["draft"] = self._draft_spec
+        self._stream.write(hello, ptype=PT_PICKLE)
         reply = self._read_reply(timeout_s=OP_TIMEOUT_S["hello"])
         if not reply.get("ok"):
             raise RuntimeError(

@@ -140,7 +140,7 @@ class Replica(ReplicaHealth):
     def __init__(self, model, replica_id, *, n_slots=4, max_seq_len=None,
                  detokenize=None, registry=None, sink=None, seed=0,
                  clock=None, stall_floor_secs=10.0, stall_factor=10.0,
-                 engine_kwargs=None, trace=0):
+                 engine_kwargs=None, trace=0, draft_model=None):
         # per-replica trace buffer (ISSUE 10): engine events keyed by
         # ENGINE-local rids collect here and the router drains+translates
         # them each step (take_trace) — the same drain-per-step shape the
@@ -158,6 +158,7 @@ class Replica(ReplicaHealth):
             model, n_slots=n_slots, max_seq_len=max_seq_len,
             detokenize=detokenize, registry=registry, sink=sink,
             seed=seed, clock=clock, tracer=self._trace_buf,
+            draft_model=draft_model,
             **(engine_kwargs or {}),
         )
         if self._trace_buf is not None:

@@ -105,7 +105,7 @@ class Router:
                  stall_floor_secs=10.0, stall_factor=10.0,
                  backend="inproc", model_spec=None, supervise=False,
                  respawn_policy=None, max_respawns=5, proc_kwargs=None,
-                 engine_kwargs=None, tracer=None):
+                 engine_kwargs=None, tracer=None, draft_model=None):
         """`weights`: dispatch shares per priority class (default
         interactive 4 : batch 1). `queue_limits`: max queued per class
         before shedding (default 16/64 x fleet slots). `clock` is shared
@@ -128,8 +128,15 @@ class Router:
         `engine_kwargs` (ISSUE 9) forwards per-engine knobs to every
         replica — the paged-KV ones (`kv_impl`, `page_size`, `n_pages`,
         `max_pages_per_seq`, `prefill_chunk`, `prefix_sharing`,
-        `paged_attn_impl`) ride the process backend's hello handshake
-        unchanged, so a fleet of paged workers is one flag away.
+        `paged_attn_impl`) and the decode-speed ones (`kv_dtype`,
+        `spec_decode`, `spec_k`, ISSUE 11) ride the process backend's
+        hello handshake unchanged, so a fleet of paged / int8 /
+        speculative workers is one flag away. `draft_model` is the
+        spec-decode draft: shipped to process workers exactly like the
+        target weights (bit-identical numpy-state spec in the hello; a
+        {"kind": "checkpoint"} draft_spec can ride `proc_kwargs`
+        instead) — the router itself needs ZERO semantic changes for
+        spec decoding, engines just finish more tokens per step.
 
         `tracer` (ISSUE 10): an obs/trace.py Tracer — the fleet flight
         recorder. The router emits the fleet-level lifecycle events
@@ -156,6 +163,9 @@ class Router:
 
             spec = model_spec if model_spec is not None \
                 else model_spec_from_model(model)
+            pk = dict(proc_kwargs or {})
+            if draft_model is not None and "draft_spec" not in pk:
+                pk["draft_spec"] = model_spec_from_model(draft_model)
             self.replicas = [
                 ProcReplica(spec, i, n_slots=n_slots,
                             max_seq_len=max_seq_len,
@@ -167,7 +177,7 @@ class Router:
                             engine_kwargs=engine_kwargs,
                             trace=(tracer.decode_sample
                                    if tracer is not None else 0),
-                            **(proc_kwargs or {}))
+                            **pk)
                 for i in range(n_replicas)
             ]
             for r in self.replicas:  # workers warmed up concurrently
@@ -191,7 +201,8 @@ class Router:
                         stall_factor=stall_factor,
                         engine_kwargs=engine_kwargs,
                         trace=(tracer.decode_sample
-                               if tracer is not None else 0))
+                               if tracer is not None else 0),
+                        draft_model=draft_model)
                 for i in range(n_replicas)
             ]
         eng0 = self.replicas[0].engine

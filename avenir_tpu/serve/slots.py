@@ -36,6 +36,9 @@ import jax.numpy as jnp
 
 
 class SlotPool(NamedTuple):
+    # k/v are bare arrays under kv_dtype='bf16' and ops/kv_quant.QuantKV
+    # (int8 data + per-head fp32 scale) pytrees under kv_dtype='int8' —
+    # every consumer tree-maps, so the pool shape never forks the code
     k: jax.Array            # (L, B_slots, T_max, H_kv, D)
     v: jax.Array            # (L, B_slots, T_max, H_kv, D)
     logits: jax.Array       # (B_slots, V) fp32 — last-position logits
@@ -45,6 +48,24 @@ class SlotPool(NamedTuple):
     top_k: jax.Array        # (B_slots,) int32; V means "no top-k"
 
 
+class DraftPool(NamedTuple):
+    """Per-slot draft-model state for speculative decoding (ISSUE 11).
+    The draft keeps a DENSE slab cache whatever the target's kv_impl /
+    kv_dtype — it is small by design (that is the whole economics), so
+    paging or quantizing it would buy noise. `prev`/`prev_n` carry the
+    tokens the slot emitted LAST tick: each spec tick starts by
+    catching the draft cache up on them (fixed (k+1)-wide forward,
+    count-masked), because the draft only ever saw its own proposals —
+    the correction/bonus token and any rejection live in `prev` alone."""
+
+    k: jax.Array            # (L_d, B_slots, W_d, H_d, D_d)
+    v: jax.Array
+    rng: jax.Array          # (B_slots, key_words) uint32 — DRAFT keys
+    pos: jax.Array          # (B_slots,) int32 — draft tokens committed
+    prev: jax.Array         # (B_slots, k+1) int32 — last tick's emissions
+    prev_n: jax.Array       # (B_slots,) int32 >= 1
+
+
 def key_data_width():
     """Words per raw key under the process default PRNG impl (2 for
     threefry2x32)."""
@@ -52,14 +73,45 @@ def key_data_width():
 
 
 def init_slot_pool(*, n_layer, n_slots, max_t, n_kv_head, head_dim,
-                   vocab_size, dtype):
+                   vocab_size, dtype, kv_dtype="bf16"):
+    """`kv_dtype` (ISSUE 11): 'bf16' stores K/V in the model compute
+    dtype; 'int8' swaps the k/v leaves for ops/kv_quant.QuantKV pairs
+    (per-head absmax scales ride beside the data) — same pytree
+    positions, so donation and the jitted step signatures are
+    untouched."""
     kv_shape = (n_layer, n_slots, max_t, n_kv_head, head_dim)
+    if kv_dtype == "int8":
+        from avenir_tpu.ops.kv_quant import init_quant_kv
+
+        k = init_quant_kv(kv_shape)
+        v = init_quant_kv(kv_shape)
+    else:
+        k = jnp.zeros(kv_shape, dtype)
+        v = jnp.zeros(kv_shape, dtype)
     return SlotPool(
-        k=jnp.zeros(kv_shape, dtype),
-        v=jnp.zeros(kv_shape, dtype),
+        k=k,
+        v=v,
         logits=jnp.zeros((n_slots, vocab_size), jnp.float32),
         rng=jnp.zeros((n_slots, key_data_width()), jnp.uint32),
         pos=jnp.zeros((n_slots,), jnp.int32),
         temperature=jnp.ones((n_slots,), jnp.float32),
         top_k=jnp.full((n_slots,), vocab_size, jnp.int32),
+    )
+
+
+def init_draft_pool(*, n_layer, n_slots, max_t, n_kv_head, head_dim,
+                    spec_k, dtype):
+    """Draft-side state for spec decoding. `max_t` must already include
+    the speculative scratch tail (engine passes T_max + spec_k): the
+    catch-up writes a (k+1)-wide block at positions up to T_max-1 and
+    proposals extend to T_max+k-1 — all masked-until-overwritten, the
+    slab hygiene invariant."""
+    kv_shape = (n_layer, n_slots, max_t, n_kv_head, head_dim)
+    return DraftPool(
+        k=jnp.zeros(kv_shape, dtype),
+        v=jnp.zeros(kv_shape, dtype),
+        rng=jnp.zeros((n_slots, key_data_width()), jnp.uint32),
+        pos=jnp.zeros((n_slots,), jnp.int32),
+        prev=jnp.zeros((n_slots, spec_k + 1), jnp.int32),
+        prev_n=jnp.ones((n_slots,), jnp.int32),
     )

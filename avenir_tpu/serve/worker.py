@@ -119,12 +119,13 @@ def _serve(stream):
 
     ekw = dict(hello.get("engine") or {})
     reg = get_registry()
-    # paged-KV knobs ride the handshake (ISSUE 9 satellite): the parent
-    # decides the kv_impl and page geometry, the worker only obeys —
-    # None values fall back to the Engine's own defaults
+    # paged-KV + decode-speed knobs ride the handshake (ISSUEs 9 + 11):
+    # the parent decides kv_impl/kv_dtype/spec geometry, the worker only
+    # obeys — None values fall back to the Engine's own defaults
     kv_kw = {k: ekw[k] for k in
              ("kv_impl", "page_size", "n_pages", "max_pages_per_seq",
-              "prefill_chunk", "prefix_sharing", "paged_attn_impl")
+              "prefill_chunk", "prefix_sharing", "paged_attn_impl",
+              "kv_dtype", "spec_decode", "spec_k")
              if ekw.get(k) is not None}
     # request tracing (ISSUE 10): the parent's hello flips this flag;
     # the engine collects lifecycle events in a bounded buffer and every
@@ -137,16 +138,30 @@ def _serve(stream):
 
         # the hello's trace value IS the decode-tick sampling interval
         tbuf = TraceBuffer(decode_sample=int(ekw["trace"]))
-    engine = Engine(
-        _build_model(hello["model"]),
-        n_slots=int(ekw.get("n_slots", 4)),
-        max_seq_len=ekw.get("max_seq_len"),
-        detokenize=ekw.get("detokenize"),
-        seed=int(ekw.get("seed", 0)),
-        registry=reg,
-        tracer=tbuf,
-        **kv_kw,
-    )
+    # the DRAFT model ships in the hello exactly like the target (ISSUE
+    # 11): same (family, config, numpy state) spec, rebuilt bit-identical
+    # — so fleet spec decoding needs zero router/proc semantic changes.
+    # An Engine that refuses the pair (vocab/width mismatch) becomes an
+    # error REPLY: the parent's handshake fails loud with the reason
+    # instead of a pipe EOF (docs/OPERATIONS.md failure matrix)
+    try:
+        draft = (_build_model(hello["draft"])
+                 if hello.get("draft") is not None else None)
+        engine = Engine(
+            _build_model(hello["model"]),
+            n_slots=int(ekw.get("n_slots", 4)),
+            max_seq_len=ekw.get("max_seq_len"),
+            detokenize=ekw.get("detokenize"),
+            seed=int(ekw.get("seed", 0)),
+            registry=reg,
+            tracer=tbuf,
+            draft_model=draft,
+            **kv_kw,
+        )
+    except (ValueError, AssertionError) as e:
+        stream.write({"ok": False, "seq": hseq,
+                      "error": f"{type(e).__name__}: {e}"})
+        return 2
     if tbuf is not None:
         tbuf.clock = engine._clock  # ages measured on the event clock
 
@@ -163,6 +178,8 @@ def _serve(stream):
                   "limit_tokens": engine.max_total_tokens,
                   "limit_name": engine.limit_name,
                   "kv_impl": engine.kv_impl,
+                  "kv_dtype": engine.kv_dtype,
+                  "spec_decode": engine.spec_decode,
                   "pid": os.getpid()})
 
     def hb():
