@@ -211,6 +211,49 @@ METRIC_SCHEMA = {
         "oldest heartbeat age across non-dead replicas after the last "
         "router step — a rising value is a stall forming, visible "
         "before the threshold declares it"),
+    # -- elastic control plane (serve/autoscale.py, ISSUE 12) --
+    "scale_up": (
+        "counter", "1",
+        "autoscaler decisions that grew the fleet (incl. burst wakes "
+        "and dead-replica replacement); every bump has a matching "
+        "`scale` trace event carrying the evidence, and a row in "
+        "tools/fleet_report.py"),
+    "scale_down": (
+        "counter", "1",
+        "autoscaler decisions that retired a replica (surplus or "
+        "scale-to-zero idle); the retiree drains before removal — "
+        "in-flight work is never dropped by a scale decision"),
+    "prewarm_ticks": (
+        "counter", "1",
+        "synthetic prefill+decode ticks run by Engine.prewarm at "
+        "replica spawn (one per bucket) so a fresh worker never serves "
+        "its first compile to a user; the synthetic requests touch no "
+        "other metric"),
+    "slo_attainment_interactive": (
+        "gauge", "1",
+        "windowed fraction of interactive-class requests meeting the "
+        "TTFT/TPOT SLO (serve/autoscale.py SLOEngine; shed and "
+        "timeouts count as misses, door rejections are excluded)"),
+    "slo_attainment_batch": (
+        "gauge", "1",
+        "windowed fraction of batch-class requests meeting the SLO "
+        "(see slo_attainment_interactive)"),
+    "slo_burn_rate": (
+        "gauge", "1",
+        "worst-class error-budget burn: (1 - attainment) / "
+        "(1 - target_attainment) over the SLO window — 1.0 spends the "
+        "budget exactly at its sustainable rate; the autoscaler's "
+        "primary scale-up signal"),
+    "fleet_size": (
+        "gauge", "1",
+        "serving replicas (non-dead, not retiring) after the last "
+        "autoscaler poll"),
+    "fleet_replica_seconds": (
+        "counter", "s",
+        "integrated replica-seconds: each autoscaler poll adds "
+        "dt x non-dead replicas (draining retirees still bill — they "
+        "hold their chip until reaped). THE cost denominator of the "
+        "autoscale bench: SLO attainment per replica-second"),
     "slot_occupancy": (
         "gauge", "1",
         "fraction of KV slots live (decoding or mid-chunked-prefill) "

@@ -126,6 +126,12 @@ def summarize(records, *, skipped_lines=()):
             "respawns": counters.get("replica_respawns", 0.0),
             "rpc_timeouts": counters.get("rpc_timeouts", 0.0),
             "frame_crc_errors": counters.get("frame_crc_errors", 0.0),
+            # elastic control plane (ISSUE 12): decision counts + the
+            # integrated replica-second bill the autoscaler optimizes
+            "scale_up": counters.get("scale_up", 0.0),
+            "scale_down": counters.get("scale_down", 0.0),
+            "replica_seconds": counters.get("fleet_replica_seconds", 0.0),
+            "prewarm_ticks": counters.get("prewarm_ticks", 0.0),
             "tokens_out": tokens_out,
             "goodput_tok_per_sec": (tokens_out / (total_ms / 1e3)
                                     if total_ms else None),
@@ -286,6 +292,12 @@ def format_report(s):
              if sv.get("frame_crc_errors") else ""),
             f"SHED: {sv['n_shed']}" if sv.get("n_shed") else "",
             f"rejected {sv['n_rejected']}" if sv.get("n_rejected") else "",
+            (f"scale +{sv['scale_up']:.0f}/-{sv['scale_down']:.0f}"
+             if sv.get("scale_up") or sv.get("scale_down") else ""),
+            (f"replica-seconds {sv['replica_seconds']:.1f}"
+             if sv.get("replica_seconds") else ""),
+            (f"prewarm ticks {sv['prewarm_ticks']:.0f}"
+             if sv.get("prewarm_ticks") else ""),
         ]
         fleet_bits = [b for b in fleet_bits if b]
         if fleet_bits:

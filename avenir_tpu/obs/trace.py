@@ -71,6 +71,11 @@ TRACE_EVENTS = {
     "requeue",       # re-queued (front of class) for a fresh dispatch
     "finish",        # THE terminal event: reason in attrs, one per rid
     "span",          # a host phase span (obs/spans.py; rid=None)
+    "scale",         # one autoscale decision (rid=None): action/reason,
+                     # before/after fleet size, and the evidence window
+                     # that triggered it (burn rate, attainment, queue
+                     # wait) — the auditable control-plane trail
+                     # (serve/autoscale.py, ISSUE 12)
 }
 
 TERMINAL = "finish"
@@ -390,6 +395,7 @@ def ttft_attribution(events):
 _SEG_PID = 1      # request waterfalls
 _SPAN_PID = 2     # host phase spans (obs/spans.py)
 _ENGINE_PID = 3   # rid-less engine events (sampled decode ticks)
+_SCALE_PID = 4    # autoscale decisions + fleet-size counter (ISSUE 12)
 
 
 def chrome_trace(events, *, origin=None):
@@ -412,6 +418,8 @@ def chrome_trace(events, *, origin=None):
          "args": {"name": "host phases"}},
         {"ph": "M", "name": "process_name", "pid": _ENGINE_PID,
          "args": {"name": "engine"}},
+        {"ph": "M", "name": "process_name", "pid": _SCALE_PID,
+         "args": {"name": "autoscaler"}},
     ]
     by_rid = {}
     span_tids = {}
@@ -424,6 +432,23 @@ def chrome_trace(events, *, origin=None):
                         "cat": "phase", "pid": _SPAN_PID, "tid": tid,
                         "ts": us(e["t"]),
                         "dur": round(e.get("dur_ms", 0.0) * 1e3, 3)})
+            continue
+        if e["ev"] == "scale":
+            # scale decisions get their OWN track (ISSUE 12): a global
+            # instant per decision — args carry the full evidence — and
+            # a counter series so the fleet size renders as a stepped
+            # timeline next to the request waterfalls it explains
+            out.append({"ph": "i", "s": "g",
+                        "name": f"scale {e.get('action', '?')}",
+                        "cat": "autoscale", "pid": _SCALE_PID, "tid": 0,
+                        "ts": us(e["t"]),
+                        "args": {k: v for k, v in e.items()
+                                 if k not in ("rid", "ev", "t")}})
+            if e.get("to_size") is not None:
+                out.append({"ph": "C", "name": "fleet_size",
+                            "pid": _SCALE_PID, "tid": 0,
+                            "ts": us(e["t"]),
+                            "args": {"replicas": e["to_size"]}})
             continue
         if rid is None:
             out.append({"ph": "i", "s": "g", "name": e["ev"],

@@ -27,11 +27,23 @@ plus the multi-replica fleet layer over it (ISSUE 6).
 - proc.py:      ProcReplica (the Replica surface over a worker process:
                 per-op RPC timeouts, EOF/CRC/timeout -> dead) + the
                 capped-backoff RespawnSupervisor
+- autoscale.py: trace-driven elastic control plane (ISSUE 12) — fleet
+                SLO engine (windowed attainment + burn rate), traced
+                queue-wait predictor behind projected-wait admission,
+                and the Autoscaler that grows/retires the fleet with
+                hysteresis, scale-to-zero and compile pre-warm, leaving
+                an auditable `scale` trace per decision
 
 See docs/SERVING.md for the design, the parity contract, and the
 router's failover semantics.
 """
 
+from avenir_tpu.serve.autoscale import (
+    Autoscaler,
+    ScaleDecision,
+    SLOEngine,
+    WaitPredictor,
+)
 from avenir_tpu.serve.engine import Engine, FinishedRequest
 from avenir_tpu.serve.pages import (
     AdmitPlan,
@@ -57,6 +69,7 @@ from avenir_tpu.serve.scheduler import FCFSScheduler, Request
 from avenir_tpu.serve.slots import SlotPool, init_slot_pool
 
 __all__ = [
+    "Autoscaler", "SLOEngine", "WaitPredictor", "ScaleDecision",
     "Engine", "FinishedRequest", "FCFSScheduler", "Request", "SlotPool",
     "init_slot_pool", "PageAllocator", "AdmitPlan", "PagedPool",
     "init_paged_pool", "paged_kv_ops", "Replica", "ReplicaGone",

@@ -1,0 +1,756 @@
+"""Trace-driven elastic control plane: fleet SLO engine + autoscaler
+(ISSUE 12 tentpole).
+
+PR 9 made every millisecond of TTFT attributable and PR 7 made replicas
+spawnable/killable OS processes — but nothing ACTED on what the
+observability layer sees: fleet size was static, admission shed on a
+static projected-wait heuristic, and a fresh worker served its first
+compile to a user. This module closes the observe -> decide -> act loop
+while keeping every decision itself observable:
+
+- **SLOEngine** — windowed (ring-buffer, injectable-clock) per-priority
+  SLO attainment and burn rate computed from the SAME finished-request
+  stream serve_bench already scores (`slo_attainment`): a request meets
+  its SLO iff it was SERVED (stop/length) within the TTFT target and,
+  where defined, the TPOT target; shed and timed-out requests are
+  violations (they are exactly the user-visible symptom of an
+  under-provisioned fleet), door rejections (impossible shapes) are
+  excluded. Burn rate is the SRE error-budget form: with a target
+  attainment A*, burn = (1 - attainment) / (1 - A*) — 1.0 means the
+  error budget is being spent exactly at its sustainable rate, above it
+  the fleet is burning reserve. Exported as schema-pinned gauges
+  (`slo_attainment_interactive`/`_batch`, `slo_burn_rate`).
+
+- **WaitPredictor** — per-class queue-wait predictor fit on traced
+  dispatch history (the submit -> dispatch deltas the PR 9 tracer
+  stamps as `submit`/`dispatch` events; the router feeds it the same
+  (depth-at-submit, wait) pairs those events carry, and only builds it
+  when tracing is armed). `Router.projected_wait_ms` consults it so
+  admission shedding tracks MEASURED queue behavior under shifting load
+  instead of the static median-slot-hold rule — which remains the
+  fallback when tracing is off or the predictor is not yet fit.
+
+- **Autoscaler** — watches burn rate and queue-wait attribution and
+  spawns/retires replicas through the router's fleet surface (process
+  backend: real worker processes via the ProcReplica/RespawnSupervisor
+  machinery; new replicas pre-warm their compile caches before taking
+  work — `Engine.prewarm`). No flapping by construction: scale-up needs
+  the up-condition SUSTAINED for `up_stable_s`, scale-down needs the
+  down-condition (burn low AND the shrunken fleet would still be
+  comfortably utilized) sustained for `down_stable_s`, and every action
+  starts a `cooldown_s` window in which no further decision fires
+  (tests pin zero decisions under steady load). Scale-to-zero
+  (`scale_to_zero=True`, the batch-class mode) retires the whole fleet
+  after `idle_to_zero_s` of no work and wakes it the moment work
+  arrives — the wake bypasses the cooldown (an empty fleet with queued
+  work is an outage, not an oscillation), paying spawn + pre-warm
+  latency once per burst (docs/OPERATIONS.md).
+
+Every decision is simultaneously (a) a `scale_up`/`scale_down` counter
+bump, (b) a `scale` trace event carrying the evidence that triggered it
+(burn rate, per-class attainment, queue wait, utilization, evidence
+window, before/after fleet size) into the PR 9 tracer — and therefore
+the flight recorder and the Perfetto export, where scale decisions
+render as their own track with a fleet-size counter — and (c) a row in
+`tools/fleet_report.py`'s decision log. An operator can answer "why did
+the fleet grow at 14:03" from the artifacts alone.
+"""
+
+import dataclasses
+import math
+import time
+from collections import deque
+
+from avenir_tpu.obs import get_registry
+from avenir_tpu.serve.replica import DEAD, HEALTHY
+
+# literal gauge keys (METRIC_SCHEMA-pinned); a dict lookup rather than
+# an f-string so the schema lint's source scan sees only declared keys
+_ATT_GAUGE = {
+    "interactive": "slo_attainment_interactive",
+    "batch": "slo_attainment_batch",
+}
+
+SERVED = ("stop", "length")
+
+
+def request_met_slo(f, *, slo_ttft_ms, slo_tpot_ms):
+    """The ONE definition of 'this request met its SLO' — shared with
+    serve_bench's slo_attainment so the autoscaler optimizes exactly
+    the number the bench scores: served (tokens delivered, not shed or
+    timed out), TTFT within target, TPOT within target where defined."""
+    return (f.finish_reason in SERVED
+            and f.ttft_ms is not None and f.ttft_ms <= slo_ttft_ms
+            and (f.n_out <= 1 or f.tpot_ms <= slo_tpot_ms))
+
+
+class SLOEngine:
+    """Windowed per-priority-class SLO attainment + burn rate over the
+    finished-request stream (ring buffer, injectable clock).
+
+    `observe(finished)` ingests terminal records (engine or router
+    FinishedRequests); door rejections are excluded (bad input, not
+    capacity), everything else scores against the TTFT/TPOT targets.
+    `attainment(cls)` / `burn_rate()` answer over the trailing
+    `window_s` seconds; gauges are refreshed on every burn_rate()."""
+
+    def __init__(self, *, slo_ttft_ms, slo_tpot_ms,
+                 target_attainment=0.9, window_s=30.0, clock=None,
+                 registry=None):
+        assert 0.0 < target_attainment < 1.0, (
+            "target_attainment must be in (0, 1) — 1.0 makes the error "
+            "budget zero and the burn rate undefined")
+        self.slo_ttft_ms = float(slo_ttft_ms)
+        self.slo_tpot_ms = float(slo_tpot_ms)
+        self.target_attainment = float(target_attainment)
+        self.window_s = float(window_s)
+        self.clock = clock if clock is not None else time.perf_counter
+        self._reg = registry if registry is not None else get_registry()
+        self._obs = deque()   # (t, priority, ok) — evicted past window_s
+        self.n_observed = 0
+
+    def observe(self, finished):
+        now = self.clock()
+        for f in finished:
+            if f.finish_reason == "rejected":
+                continue  # impossible shape: user error, not capacity
+            ok = request_met_slo(f, slo_ttft_ms=self.slo_ttft_ms,
+                                 slo_tpot_ms=self.slo_tpot_ms)
+            cls = getattr(f, "priority", "interactive")
+            self._obs.append((now, cls, bool(ok)))
+            self.n_observed += 1
+        self._evict(now)
+
+    def _evict(self, now):
+        horizon = now - self.window_s
+        while self._obs and self._obs[0][0] < horizon:
+            self._obs.popleft()
+
+    def attainment(self, priority=None):
+        """Fraction of windowed observations meeting the SLO (None with
+        no samples). `priority=None` pools every class."""
+        self._evict(self.clock())
+        obs = [ok for _, c, ok in self._obs
+               if priority is None or c == priority]
+        if not obs:
+            return None
+        return sum(obs) / len(obs)
+
+    def attainments(self):
+        """Per-class windowed attainment ({cls: fraction or None}).
+        Gauge convention: an EMPTY window writes 1.0 (no observed
+        violations) — otherwise a gauge frozen at the last crisis
+        value would report an SLO fire on an idle fleet forever; the
+        returned None still tells control logic idle from healthy."""
+        out = {}
+        for cls, key in _ATT_GAUGE.items():
+            a = self.attainment(cls)
+            out[cls] = a
+            self._reg.gauge(key).set(1.0 if a is None else a)
+        return out
+
+    def burn_rate(self):
+        """Worst-class error-budget burn over the window: with target
+        attainment A*, burn = (1 - attainment) / (1 - A*). 1.0 = the
+        budget is being spent exactly at its sustainable rate; None
+        with no windowed samples (an idle fleet burns nothing)."""
+        return self.burn_from(self.attainments())
+
+    def burn_from(self, atts):
+        """Burn rate from an attainments() snapshot — the poll loop
+        computes the snapshot once and derives both from it (the
+        window scan is per-poll hot-path work)."""
+        budget = 1.0 - self.target_attainment
+        burns = [(1.0 - a) / budget for a in atts.values()
+                 if a is not None]
+        if not burns:
+            # idle fleet burns nothing: the gauge must not stay frozen
+            # at the last crisis value after the window empties
+            self._reg.gauge("slo_burn_rate").set(0.0)
+            return None
+        burn = max(burns)
+        self._reg.gauge("slo_burn_rate").set(burn)
+        return burn
+
+
+class WaitPredictor:
+    """Per-class queue-wait predictor fit on traced dispatch history
+    (ISSUE 12 tentpole, part 3).
+
+    Observations are the submit -> dispatch deltas the PR 9 trace
+    events stamp, paired with the class queue depth at submit; the
+    router feeds them only on a request's FIRST dispatch (failover
+    requeues measure replica death, not queue behavior). The model is
+    a small online least squares `wait ~= a + b * depth` over a bounded
+    ring — depth is the one admission-time observable, and the fitted
+    slope IS the measured drain rate the static rule only guesses at
+    (median slot hold / fair-share capacity). Until `min_samples`
+    observations land, `predict_ms` returns None and the router keeps
+    the static rule — tracing off means no predictor at all."""
+
+    # below this fitted slope (ms of wait per unit of queue depth) the
+    # model has learned no drain-rate information — outside its
+    # observed depth support it abstains and the static rule answers
+    MIN_SLOPE_MS = 1.0
+    SUPPORT_SLACK = 2.0
+    RESYNC = 4096
+
+    def __init__(self, cap=256, min_samples=8):
+        self._obs = deque(maxlen=int(cap))   # (depth, wait_s)
+        self.min_samples = int(min_samples)
+        # running sums — the fit is O(1) per call, not an O(cap)
+        # rescan on the per-submit admission hot path; re-synced
+        # exactly every RESYNC observes so eviction drift cannot
+        # accumulate over a long-lived fleet
+        self._sx = self._sy = self._sxx = self._sxy = 0.0
+        self._n_observed = 0
+        self._max_depth = 0.0   # lifetime support bound (monotone)
+
+    def observe(self, depth, wait_s):
+        d, w = float(depth), max(0.0, float(wait_s))
+        if len(self._obs) == self._obs.maxlen:
+            od, ow = self._obs[0]   # deque eviction, mirrored in sums
+            self._sx -= od
+            self._sy -= ow
+            self._sxx -= od * od
+            self._sxy -= od * ow
+        self._obs.append((d, w))
+        self._sx += d
+        self._sy += w
+        self._sxx += d * d
+        self._sxy += d * w
+        self._max_depth = max(self._max_depth, d)
+        self._n_observed += 1
+        if self._n_observed % self.RESYNC == 0:
+            self._sx = sum(x for x, _ in self._obs)
+            self._sy = sum(y for _, y in self._obs)
+            self._sxx = sum(x * x for x, _ in self._obs)
+            self._sxy = sum(x * y for x, y in self._obs)
+
+    @property
+    def n_samples(self):
+        return len(self._obs)
+
+    def predict_ms(self, depth):
+        """Predicted queue wait (ms) for a request arriving at this
+        class queue depth; None until the predictor is fit — and None
+        again when the fit carries no drain-rate information (flat or
+        single-depth samples) and the queried depth sits outside its
+        observed support: a calm-period fit of '~0 ms at depth 0-1'
+        must not blind shedding (or the predictive scale-up trigger)
+        to a sudden 50-deep burst — the static rule answers instead."""
+        n = len(self._obs)
+        if n < self.min_samples:
+            return None
+        depth = float(depth)
+        mx = self._sx / n
+        my = self._sy / n
+        var = max(0.0, self._sxx - n * mx * mx)
+        outside = depth > self._max_depth + self.SUPPORT_SLACK
+        if var < 1e-9:
+            # every sample at one depth: the mean speaks only nearby
+            return my * 1e3 if abs(depth - mx) <= 1.0 else None
+        b = max(0.0, (self._sxy - n * mx * my) / var)
+        #       deeper queues never predict SHORTER waits ^
+        if outside and b * 1e3 < self.MIN_SLOPE_MS:
+            return None
+        a = my - b * mx
+        return max(0.0, a + b * depth) * 1e3
+
+
+@dataclasses.dataclass
+class ScaleDecision:
+    """One autoscale decision, as recorded in the host-side log (the
+    trace event carries the same fields as attrs)."""
+
+    t: float
+    action: str            # 'up' | 'down' | 'wake' | 'replace_dead'
+    reason: str
+    from_size: int
+    to_size: int
+    evidence: dict
+
+
+class Autoscaler:
+    """Observes the SLO engine + router queue state, spawns/retires
+    replicas with hysteresis + cooldown, and leaves an auditable trail.
+
+    Drive it from the serving loop:
+
+        fins = router.step()
+        scaler.observe(fins)
+        scaler.poll()            # decisions happen here
+
+    (or `scaler.run_step()`, which does all three). Decisions actuate
+    through `Router.add_replica` / `Router.retire_replica`: inproc
+    replicas are built in place; process-backend replicas spawn a real
+    worker through the ProcReplica machinery, whose hello pre-warms the
+    compile caches (`prewarm=True` default) so a fresh replica is never
+    dispatchable until a synthetic prefill + decode tick per bucket has
+    compiled — a user never eats a fresh worker's first compile.
+
+    Knobs (docs/SERVING.md table):
+      min_replicas/max_replicas  fleet bounds (scale_to_zero forces
+                                 min to 0)
+      up_burn / down_burn        burn-rate hysteresis band: up above,
+                                 down below — never both
+      up_queue_wait_ms           queue-wait trigger (default: half the
+                                 SLO TTFT) — predictive scale-up BEFORE
+                                 attainment is lost, when tracing feeds
+                                 the wait predictor
+      up_stable_s/down_stable_s  how long a condition must hold
+      cooldown_s                 dead time after any action
+      down_util                  scale-down only if the SHRUNKEN fleet
+                                 would still sit below this busy
+                                 fraction (surplus must be provable)
+      scale_to_zero/idle_to_zero_s  batch-class mode: retire the whole
+                                 fleet when idle, wake on queued work
+      prewarm                    pre-warm compile caches on every spawn
+    """
+
+    def __init__(self, router, slo: SLOEngine, *, min_replicas=1,
+                 max_replicas=4, up_burn=1.0, down_burn=0.3,
+                 up_queue_wait_ms=None, up_stable_s=2.0,
+                 down_stable_s=10.0, cooldown_s=5.0, down_util=0.6,
+                 scale_to_zero=False, idle_to_zero_s=10.0, prewarm=True,
+                 spawn_async=False, spawn_parallelism=1, registry=None,
+                 clock=None, echo=print):
+        self.router = router
+        self.slo = slo
+        self.scale_to_zero = bool(scale_to_zero)
+        self.min_replicas = 0 if scale_to_zero else int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        assert self.max_replicas >= max(1, self.min_replicas)
+        self.up_burn = float(up_burn)
+        self.down_burn = float(down_burn)
+        assert self.down_burn < self.up_burn, (
+            "hysteresis band inverted: down_burn must sit below up_burn "
+            "or the fleet flaps between the two thresholds")
+        self.up_queue_wait_ms = (float(up_queue_wait_ms)
+                                 if up_queue_wait_ms is not None
+                                 else slo.slo_ttft_ms / 2.0)
+        self.up_stable_s = float(up_stable_s)
+        self.down_stable_s = float(down_stable_s)
+        self.cooldown_s = float(cooldown_s)
+        self.down_util = float(down_util)
+        self.idle_to_zero_s = float(idle_to_zero_s)
+        self.prewarm = bool(prewarm)
+        # spawn_async: grow via Router.begin_add_replica on a
+        # background thread — the fleet keeps serving while the
+        # newcomer pays its spawn + pre-warm, and it joins at the first
+        # poll() that finds it ready. One spawn in flight at a time; no
+        # other decision fires while one is warming (fresh capacity
+        # must land before the stale evidence window can demand more).
+        # Default off: synchronous spawns keep tests deterministic;
+        # real serving loops (serve_bench --autoscale) turn it on.
+        self.spawn_async = bool(spawn_async)
+        # how many newcomers may warm CONCURRENTLY: on a many-host
+        # deployment each spawn compiles on its own machine, but on a
+        # shared host every warming replica steals compute from the
+        # serving loop — default 1 (serial), raise it only when spawn
+        # compute is actually elsewhere
+        self.spawn_parallelism = max(1, int(spawn_parallelism))
+        self._spawns = []           # in-flight background builds
+        self._util_hist = deque()   # (t, busy_frac) samples per poll
+        self._reg = registry if registry is not None else router._reg
+        self._clock = clock if clock is not None else router._clock
+        # wake-on-shed baseline: at fleet zero, deadline-carrying
+        # submits are refused at the door (projected wait is infinite)
+        # and never enter the queues — a rising serve_shed count is
+        # then the ONLY evidence that traffic wants the fleet back
+        self._shed_seen = self._reg.counter("serve_shed").total
+        self._echo = echo
+        self.decisions = []       # host-side ScaleDecision log
+        self._last_action_t = -math.inf
+        # pacing for the wake/replace_dead branches, which bypass the
+        # normal cooldown: only a FAILED spawn arms it, so a healthy
+        # wake stays instant but a persistently failing spawn (fd or
+        # process limit) retries at cooldown cadence, not every poll
+        self._last_spawn_fail_t = -math.inf
+        self._up_since = None
+        self._down_since = None
+        self._idle_since = None
+        self._last_poll_t = None
+
+    # -- the loop surface --
+
+    def run_step(self):
+        """One elastic fleet iteration: step the router, feed the SLO
+        engine, make any due decision. Returns the finished requests."""
+        fins = self.router.step()
+        self.observe(fins)
+        self.poll()
+        return fins
+
+    def observe(self, finished):
+        self.slo.observe(finished)
+
+    def poll(self, now=None):
+        """Account replica-seconds, refresh the SLO gauges, and make at
+        most ONE scale decision if its condition has been sustained and
+        the cooldown allows. Returns the decision (or None)."""
+        now = self._clock() if now is None else now
+        r = self.router
+        # a draining (retiring) replica still holds its chip until
+        # reaped, and in-flight background spawns hold theirs while
+        # they warm — all bill like serving replicas
+        billable = (sum(rep.state != DEAD for rep in r.replicas)
+                    + len(self._spawns))
+        if self._last_poll_t is not None and now > self._last_poll_t:
+            self._reg.counter("fleet_replica_seconds").add(
+                (now - self._last_poll_t) * billable)
+        self._last_poll_t = now
+        for spawn in [s for s in self._spawns if s.ready()]:
+            self._spawns.remove(spawn)
+            try:
+                rep = r.finish_add_replica(spawn)
+                self._echo(f"[autoscale] replica {rep.replica_id} "
+                           "warmed and joined the fleet")
+            except Exception as e:  # noqa: BLE001 — spawn failure is
+                # an event, not a fleet crash; the next poll's
+                # conditions decide whether to try again (paced by
+                # the spawn-fail clock for the cooldown-free branches)
+                self._echo(f"[autoscale] background spawn failed: "
+                           f"{e!r}")
+                self._last_spawn_fail_t = now
+                # COMPENSATING audit record: the up decision's to_size
+                # never materialized — without this, the trace/
+                # fleet_report/Perfetto fleet-size trail (and every
+                # replica-second integral over it) would overstate the
+                # fleet forever on exactly the failure case
+                actual = r.fleet_size
+                if r.tracer is not None:
+                    r.tracer.emit(None, "scale", t=now,
+                                  action="spawn_failed",
+                                  reason=repr(e)[:160],
+                                  from_size=actual, to_size=actual,
+                                  replica=spawn.replica_id)
+                self.decisions.append(ScaleDecision(
+                    t=now, action="spawn_failed", reason=repr(e)[:160],
+                    from_size=actual, to_size=actual,
+                    evidence={"replica": spawn.replica_id}))
+        if self._spawns:
+            # capacity is already on its way: no further decision until
+            # it lands — stale window evidence must not stack replicas
+            # the warming ones will already answer
+            self._reg.gauge("fleet_size").set(r.fleet_size)
+            return None
+        alive = r.fleet_size
+        self._reg.gauge("fleet_size").set(alive)
+        atts = self.slo.attainments()
+        burn = self.slo.burn_from(atts)
+        qw = self._queue_wait_ms()
+        # utilization is sampled per poll and averaged over the
+        # down-stability window: an instantaneous sample flickers with
+        # every lone arrival (one request on an otherwise idle replica
+        # reads as util=1/slots for a service time), and the
+        # scale-down check must see sustained occupancy, not noise
+        util = self._busy_frac()
+        self._util_hist.append((now, util))
+        horizon = now - max(self.down_stable_s, 1.0)
+        while self._util_hist and self._util_hist[0][0] < horizon:
+            self._util_hist.popleft()
+        util_avg = (sum(u for _, u in self._util_hist)
+                    / len(self._util_hist))
+        evidence = {
+            "burn_rate": None if burn is None else round(burn, 4),
+            "queue_wait_ms": None if qw is None else round(qw, 2),
+            "busy_frac": round(util_avg, 4),
+            "queue_depth": r.queue_depth,
+            "window_s": self.slo.window_s,
+        }
+        for cls, a in atts.items():
+            evidence[f"attainment_{cls}"] = (None if a is None
+                                             else round(a, 4))
+
+        has_work = bool(r.open_requests or r.queue_depth)
+        # 1) burst wake: an empty fleet with queued work — or with
+        # fresh door sheds: an all-deadline class never queues at zero
+        # capacity (every submit is refused with projected wait
+        # infinite), so the shed counter movement IS the burst — is an
+        # OUTAGE, not an oscillation: bypass stability and cooldown.
+        # The requests shed before the wake are already refused; the
+        # wake restores capacity for the next ones (docs/OPERATIONS.md
+        # wake-latency row).
+        # ... unless a RespawnSupervisor still owns revival of the
+        # dead fleet (same deference as replace_dead below): waking
+        # on top of its pending respawns would double-provision
+        shed_total = self._reg.counter("serve_shed").total
+        fresh_sheds = shed_total > self._shed_seen
+        self._shed_seen = shed_total
+        sup = getattr(r, "_supervisor", None)
+        # both floor-restoring branches bypass the normal cooldown
+        # (waiting out a scale-down's dead time on an OUTAGE would be
+        # absurd) but still pace RETRIES after a failed spawn — without
+        # this gate a persistent spawn failure re-forks on every poll
+        spawn_ok = now - self._last_spawn_fail_t >= self.cooldown_s
+        if (alive == 0 and (has_work or fresh_sheds) and spawn_ok
+                and (sup is None or not sup.pending())):
+            return self._scale_up(now, "wake", evidence)
+        # 2) replace-dead: under the process backend the respawn
+        # supervisor owns revival (same replica id, backoff schedule);
+        # without one, the autoscaler restores the floor itself
+        if (alive < self.min_replicas and spawn_ok
+                and getattr(r, "_supervisor", None) is None):
+            return self._scale_up(now, "replace_dead", evidence)
+
+        # 3) scale-to-zero idle retirement (batch-class mode)
+        if self.scale_to_zero and alive > 0 and not has_work:
+            if self._idle_since is None:
+                self._idle_since = now
+            elif (now - self._idle_since >= self.idle_to_zero_s
+                  and now - self._last_action_t >= self.cooldown_s):
+                return self._scale_down(now, "idle_to_zero", evidence)
+        else:
+            self._idle_since = None
+
+        # 4) scale up: burn above the band, or measured queue wait past
+        # the predictive trigger — sustained
+        up = ((burn is not None and burn >= self.up_burn)
+              or (qw is not None and qw >= self.up_queue_wait_ms))
+        if up and alive < self.max_replicas:
+            if self._up_since is None:
+                self._up_since = now
+            elif (now - self._up_since >= self.up_stable_s
+                  and now - self._last_action_t >= self.cooldown_s):
+                reason = ("burn_rate"
+                          if burn is not None and burn >= self.up_burn
+                          else "queue_wait")
+                return self._scale_up(now, reason, evidence)
+        else:
+            self._up_since = None
+
+        # 5) scale down: burn below the band AND the shrunken fleet
+        # would still sit below the utilization ceiling — sustained
+        surplus = ((burn is None or burn <= self.down_burn)
+                   and alive > max(1, self.min_replicas)
+                   and util_avg * alive / (alive - 1) <= self.down_util)
+        if surplus:
+            if self._down_since is None:
+                self._down_since = now
+            elif (now - self._down_since >= self.down_stable_s
+                  and now - self._last_action_t >= self.cooldown_s):
+                return self._scale_down(now, "surplus", evidence)
+        else:
+            self._down_since = None
+        return None
+
+    # -- evidence --
+
+    def _queue_wait_ms(self):
+        """Queue-wait evidence: the router's projected wait at the
+        CURRENT class queue depth — which is the traced predictor's
+        forward-looking answer when tracing is armed (it reacts the
+        poll a backlog forms, where a trailing mean of finished waits
+        lags by its window) and the static rule otherwise. Worst class
+        wins; an infinite projection (no healthy replica) is the wake
+        path's business, not a number."""
+        waits = []
+        for cls in self.router.weights:
+            w = self.router.projected_wait_ms(cls)
+            if w is not None and math.isfinite(w):
+                waits.append(w)
+        return max(waits) if waits else None
+
+    def _busy_frac(self):
+        """Occupied-slot fraction across the non-dead fleet (the
+        scale-down surplus check's utilization)."""
+        total = occupied = 0
+        for rep in self.router.replicas:
+            if (rep.state == DEAD
+                    or rep.replica_id in self.router._retiring):
+                # the surplus projection divides by the SERVING fleet
+                # (`alive`); counting a draining retiree's mostly-empty
+                # slots in the denominator would dilute utilization and
+                # enable cascade retirements right at the threshold
+                continue
+            total += rep.n_slots
+            occupied += len(rep.engine._live)
+            # mid-chunked-prefill slots hold a slot and burn compute:
+            # inproc paged engines expose them as pg.prefill, a process
+            # replica's heartbeat mirrors the count as _prefilling —
+            # missing either would understate utilization and let the
+            # surplus check retire a replica the fleet still needs
+            paged = getattr(rep.engine, "_paged", None)
+            if paged is not None:
+                occupied += len(paged.prefill)
+            else:
+                occupied += getattr(rep.engine, "_prefilling", 0)
+        return occupied / total if total else 0.0
+
+    # -- actuation + audit trail --
+
+    def _scale_up(self, now, reason, evidence):
+        before = self.router.fleet_size
+        action = reason if reason in ("wake", "replace_dead") else "up"
+        if self.spawn_async:
+            # STEP SIZE follows the measured need: a queue wait at N x
+            # the trigger threshold asks for ~N replicas' worth of
+            # drain, and a fleet caught small by a fast ramp must not
+            # climb one serial spawn at a time (the newcomers warm
+            # CONCURRENTLY and join as each is ready). Wake/replace
+            # restore exactly one.
+            k = 1
+            qw = evidence.get("queue_wait_ms")
+            if action == "up" and qw:
+                k = max(1, math.ceil(qw / self.up_queue_wait_ms))
+            k = min(k, self.spawn_parallelism,
+                    self.max_replicas - before)
+            for _ in range(k):
+                self._spawns.append(self.router.begin_add_replica(
+                    prewarm=self.prewarm))
+            return self._decide(
+                now, action, reason, before, before + k,
+                {**evidence,
+                 "replica": [s.replica_id for s in self._spawns[-k:]],
+                 "n_spawn": k, "spawn_async": True})
+        t0 = self._clock()
+        try:
+            rep = self.router.add_replica(prewarm=self.prewarm)
+        except Exception as e:  # noqa: BLE001 — same policy as the
+            # async join: a spawn failure is an event, not a reason to
+            # crash a loop that is still serving on the healthy fleet.
+            # Nothing is recorded as a decision (the fleet never grew);
+            # both retry clocks back off — _last_action_t paces the
+            # sustained-condition branches, _last_spawn_fail_t paces
+            # the cooldown-bypassing wake/replace_dead branches
+            self._echo(f"[autoscale] spawn failed: {e!r}")
+            self._last_action_t = now
+            self._last_spawn_fail_t = now
+            return None
+        spawn_s = self._clock() - t0
+        return self._decide(now, action, reason, before, before + 1,
+                            {**evidence, "replica": rep.replica_id,
+                             "spawn_s": round(spawn_s, 4)})
+
+    def _scale_down(self, now, reason, evidence):
+        before = self.router.fleet_size
+        if reason == "idle_to_zero":
+            # the documented contract: the WHOLE idle fleet retires in
+            # one decision after idle_to_zero_s, not one replica per
+            # idle window (the fleet has no work — every drain is a
+            # no-op — so retiring serially would just bill
+            # ~fleet_size x (idle_to_zero_s + cooldown_s) of extra
+            # replica-seconds per idle period)
+            victims = [rep for rep in self.router.replicas
+                       if rep.state == HEALTHY
+                       and rep.replica_id not in self.router._retiring]
+            if not victims:
+                return None
+            for rep in victims:
+                self.router.retire_replica(rep.replica_id)
+            return self._decide(
+                now, "down", reason, before, before - len(victims),
+                {**evidence,
+                 "replica": [rep.replica_id for rep in victims]})
+        victim = self._pick_victim()
+        if victim is None:
+            return None
+        self.router.retire_replica(victim.replica_id)
+        return self._decide(now, "down", reason, before, before - 1,
+                            {**evidence, "replica": victim.replica_id})
+
+    def _pick_victim(self):
+        """Retire the least-loaded healthy replica; ties retire the
+        newest (LIFO keeps the longest-warmed caches serving)."""
+        cands = [rep for rep in self.router.replicas
+                 if rep.state == HEALTHY
+                 and rep.replica_id not in self.router._retiring]
+        if not cands:
+            return None
+        return min(cands, key=lambda rep: (len(rep.engine._live),
+                                           -rep.replica_id))
+
+    def _decide(self, now, action, reason, from_size, to_size,
+                evidence):
+        """The audit trail: counter bump + trace event (-> flight
+        recorder + Perfetto `autoscaler` track + fleet_report) + host
+        log, atomically per decision."""
+        grew = to_size > from_size
+        self._reg.counter("scale_up" if grew else "scale_down").add(1)
+        self._reg.gauge("fleet_size").set(self.router.fleet_size)
+        tracer = self.router.tracer
+        if tracer is not None:
+            tracer.emit(None, "scale", t=now, action=action,
+                        reason=reason, from_size=from_size,
+                        to_size=to_size,
+                        **{k: v for k, v in evidence.items()
+                           if v is not None})
+        d = ScaleDecision(t=now, action=action, reason=reason,
+                          from_size=from_size, to_size=to_size,
+                          evidence=dict(evidence))
+        self.decisions.append(d)
+        self._last_action_t = now
+        self._up_since = self._down_since = self._idle_since = None
+        self._echo(f"[autoscale] {action} {from_size} -> {to_size} "
+                   f"(reason={reason}, burn={evidence.get('burn_rate')}"
+                   f", queue_wait={evidence.get('queue_wait_ms')} ms)")
+        return d
+
+    def close(self):
+        """Reap in-flight background spawns (join the build, shut the
+        finished replica down without joining it to the fleet) — call
+        BEFORE Router.close() at end of run, or a warming worker
+        process outlives the fleet it was meant to join."""
+        for spawn in self._spawns:
+            try:
+                spawn.thread.join()
+                if spawn.result is not None and hasattr(spawn.result,
+                                                        "close"):
+                    spawn.result.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        self._spawns = []
+
+    # -- convenience for benches/tests --
+
+    def drain(self, max_steps=None):
+        """Router.drain with the autoscaler in the loop (a zero fleet
+        with queued work wakes instead of failing loud)."""
+        out = []
+        steps = 0
+        bound = max_steps or 200_000
+        while (self.router.open_requests or self.router._pending):
+            out.extend(self.run_step())
+            steps += 1
+            if steps > bound:
+                raise RuntimeError("autoscaled fleet failed to drain")
+        return out
+
+
+def mean_fleet_size(decisions, *, t0, t1, initial_size):
+    """Time-weighted mean fleet size over [t0, t1] from a decision log
+    (each decision switches the size at its timestamp) — the
+    fleet_report summary's cheap integral."""
+    if t1 <= t0:
+        return float(initial_size)
+    size = initial_size
+    t = t0
+    area = 0.0
+    for d in sorted(decisions, key=lambda d: d.t if hasattr(d, "t")
+                    else d["t"]):
+        dt_ = d.t if hasattr(d, "t") else d["t"]
+        to = d.to_size if hasattr(d, "to_size") else d["to_size"]
+        if dt_ <= t0:
+            size = to
+            continue
+        if dt_ >= t1:
+            break
+        area += size * (dt_ - t)
+        size, t = to, dt_
+    area += size * (t1 - t)
+    return area / (t1 - t0)
+
+
+def steady_window_s(decisions, *, t0, t1):
+    """Longest decision-free stretch in [t0, t1] — the no-flapping
+    number fleet_report prints."""
+    ts = sorted([t0] + [d.t if hasattr(d, "t") else d["t"]
+                        for d in decisions] + [t1])
+    return max(b - a for a, b in zip(ts, ts[1:])) if len(ts) > 1 else 0.0
+
+
+__all__ = [
+    "SLOEngine", "WaitPredictor", "Autoscaler", "ScaleDecision",
+    "request_met_slo", "mean_fleet_size", "steady_window_s",
+]

@@ -887,6 +887,7 @@ class Engine:
                         jnp.float32(req.temperature), jnp.int32(k_eff),
                     )
                     live.pending = [int(tail)]
+                    self._stamp_admission_first_token(live, slot)
                 else:
                     self.pool = self._admit(
                         state, self.pool, jnp.asarray(idx), jnp.int32(slot),
@@ -1020,6 +1021,7 @@ class Engine:
                     # chunks' samples were idempotent overwrites) — one
                     # small D2H per finished prefill, never per token
                     live.pending = [int(tail)]
+                    self._stamp_admission_first_token(live, slot)
                 self._live[slot] = live
         if self._live:
             spec_on = self.spec_decode == "draft"
@@ -1078,6 +1080,24 @@ class Engine:
         )
         assert len(self.traces["cow"]) <= 1, "the COW copy retraced"
         return finished
+
+    def _stamp_admission_first_token(self, live, slot):
+        """Spec decoding samples the request's FIRST token INSIDE the
+        admission prefill (`_seed_spec_slot`; the `int(tail)` above is
+        a host-visible fetch) — TTFT truth anchors here, not at the
+        verify tick that happens to harvest the `pending` token, which
+        for an engine's first request would silently fold the decode-
+        step COMPILE into prefill attribution. Stamping at admission
+        keeps the trace partition exact: queue + prefill (+ failover)
+        ends where the token actually landed (ISSUE 12 satellite;
+        regression-pinned in tests/test_spec_decode.py)."""
+        now = self._clock()
+        live.t_first = live.t_last = now
+        self._reg.hist("ttft_ms").observe(
+            (now - live.req.submit_t) * 1e3)
+        if self._tr is not None:
+            self._tr.emit(live.req.req_id, "first_token", t=now,
+                          slot=slot, admission=True)
 
     def _harvest_tokens(self, toks, t_tick, finished, counts=None):
         """Post-decode harvest shared by both KV impls: per-slot token
@@ -1241,10 +1261,70 @@ class Engine:
         if self._paged is not None:
             self._paged.reset()
 
+    def prewarm(self):
+        """Compile pre-warm (ISSUE 12): one synthetic prefill + decode
+        tick per prompt bucket (slab) / chunk bucket (paged), run at
+        spawn — inside the worker hello for the process backend —
+        BEFORE the replica is dispatchable, so a fresh replica never
+        serves its first compile to a user (the p99 cliff the trace
+        reports attributed to fresh workers).
+
+        Muted: the synthetic requests run against a throwaway registry,
+        a NullSink and no tracer — only `prewarm_ticks` lands on the
+        real registry, so prewarmed and cold engines tell identical
+        serving stories. The request-id counter is restored afterwards
+        so default per-rid rng streams match an un-warmed engine's.
+        Returns the tick count."""
+        assert not self.open_work, "prewarm needs an idle engine"
+        from avenir_tpu.infer.decode import prompt_bucket
+        from avenir_tpu.obs.metrics import MetricsRegistry
+
+        reg, self._reg = self._reg, MetricsRegistry()
+        sink, self.sink = self.sink, NullSink()
+        tr, self._tr = self._tr, None
+        next_id = self._next_id
+        ticks = 0
+        try:
+            if self._paged is not None:
+                ladder, cap = (self._paged.chunk_ladder,
+                               self.prefill_chunk)
+            else:
+                ladder, cap = self.sched.ladder, self.T_max
+            V = self.model.config.vocab_size
+            for bi, b in enumerate(ladder):
+                n = min(b, self.max_total_tokens - 1)
+                if n < 1 or prompt_bucket(n, cap) != b:
+                    continue  # token budget cannot reach this bucket
+                # distinct token content per bucket: identical prompts
+                # would prefix-hit under paged sharing and the shared
+                # chunk would skip the very compile being warmed
+                self.submit([(bi + 1) % V] * n, max_new_tokens=1,
+                            rng=jax.random.key(0))
+                while self.open_work:
+                    self.step()
+                    ticks += 1
+        finally:
+            self._reg, self.sink, self._tr = reg, sink, tr
+            self._next_id = next_id
+        self._reg.counter("prewarm_ticks").add(ticks)
+        return ticks
+
     # ---- internals ----
 
     def _finish(self, slot, live, reason):
         req = live.req
+        if live.pending:
+            # spec decoding: an admission-sampled first token that was
+            # never harvested (evicted between admission and its first
+            # verify tick) is still a PRODUCED token — its t_first is
+            # already stamped, so dropping it here would finish a
+            # request with ttft_ms set and n_out=0; deliver it instead
+            for tok in live.pending:
+                live.emitted.append(tok)
+                if self.detokenize is not None:
+                    live.text += self.detokenize([tok])
+            self._reg.counter("tokens_out").add(len(live.pending))
+            live.pending = []
         del self._live[slot]
         self.sched.release(slot)
         if self._paged is not None:

@@ -298,6 +298,14 @@ class ProcReplica(ReplicaHealth):
         self.engine.kv_impl = reply.get("kv_impl", "slab")
         self.engine.n_slots = int(reply["n_slots"])
         self.engine.sched.free_slots = int(reply["n_slots"])
+        # compile pre-warm (ISSUE 12): when the hello's engine kwargs
+        # carried `prewarm`, the worker ran one synthetic prefill +
+        # decode tick per bucket BEFORE this reply — so by the time the
+        # router can dispatch to this replica, its compiles are paid
+        # (respawns re-send the same hello, so a supervisor-revived
+        # worker pre-warms too; `prewarm_ticks` mirrors via the usual
+        # counter deltas)
+        self.prewarm_ticks = int(reply.get("prewarm_ticks", 0))
         self.last_beat = self._clock()
         return self
 

@@ -154,17 +154,26 @@ class Replica(ReplicaHealth):
 
             self._trace_buf = TraceBuffer(clock=clock,
                                           decode_sample=int(trace))
+        ekw = dict(engine_kwargs or {})
+        # compile pre-warm (ISSUE 12): rides engine_kwargs — the same
+        # key a process worker's hello consumes — so the autoscaler's
+        # spawn path is one flag on either backend
+        prewarm = ekw.pop("prewarm", False)
         self.engine = Engine(
             model, n_slots=n_slots, max_seq_len=max_seq_len,
             detokenize=detokenize, registry=registry, sink=sink,
             seed=seed, clock=clock, tracer=self._trace_buf,
             draft_model=draft_model,
-            **(engine_kwargs or {}),
+            **ekw,
         )
         if self._trace_buf is not None:
             # share the engine's resolved clock (clock=None means the
             # engine picked perf_counter; events must ride that too)
             self._trace_buf.clock = self.engine._clock
+        if prewarm:
+            # a fresh replica compiles BEFORE it is dispatchable — the
+            # router only sees it once construction returns
+            self.engine.prewarm()
         super().__init__(replica_id, clock=self.engine._clock,
                          stall_floor_secs=stall_floor_secs,
                          stall_factor=stall_factor)

@@ -81,6 +81,9 @@ class RoutedRequest:
     submit_t: float            # ORIGINAL submission (router clock secs)
     failovers: int = 0
     dispatch_t: Optional[float] = None
+    # class queue depth the moment this request was enqueued — the
+    # wait predictor's feature (ISSUE 12; None when tracing is off)
+    depth_at_submit: Optional[int] = None
 
     def expired(self, now):
         return (self.deadline_ms is not None
@@ -96,6 +99,21 @@ class RouterFinished(FinishedRequest):
     priority: str = "interactive"
     replica: int = -1
     failovers: int = 0
+
+
+class _SpawnHandle:
+    """In-flight background replica build (Router.begin_add_replica)."""
+
+    __slots__ = ("replica_id", "thread", "result", "error")
+
+    def __init__(self, replica_id):
+        self.replica_id = replica_id
+        self.thread = None
+        self.result = None
+        self.error = None
+
+    def ready(self):
+        return self.thread is not None and not self.thread.is_alive()
 
 
 class Router:
@@ -154,30 +172,33 @@ class Router:
         self.tracer = tracer
         self.backend = backend
         self._supervisor = None
+        # replica build recipe, retained so the autoscaler can grow the
+        # fleet after construction (add_replica, ISSUE 12)
+        self._model = model
+        self._rep_cfg = dict(
+            n_slots=int(n_slots), max_seq_len=max_seq_len,
+            detokenize=detokenize, seed=seed,
+            stall_floor_secs=stall_floor_secs,
+            stall_factor=stall_factor)
+        self._engine_kwargs = dict(engine_kwargs or {})
+        self._draft_model = draft_model
+        self._spec = None
+        self._pk = {}
+        self._retiring = set()   # replica_ids draining toward removal
+        self._next_replica_id = n_replicas
         if backend == "process":
             from avenir_tpu.serve.proc import (
-                ProcReplica,
                 RespawnSupervisor,
                 model_spec_from_model,
             )
 
-            spec = model_spec if model_spec is not None \
+            self._spec = model_spec if model_spec is not None \
                 else model_spec_from_model(model)
-            pk = dict(proc_kwargs or {})
-            if draft_model is not None and "draft_spec" not in pk:
-                pk["draft_spec"] = model_spec_from_model(draft_model)
+            self._pk = dict(proc_kwargs or {})
+            if draft_model is not None and "draft_spec" not in self._pk:
+                self._pk["draft_spec"] = model_spec_from_model(draft_model)
             self.replicas = [
-                ProcReplica(spec, i, n_slots=n_slots,
-                            max_seq_len=max_seq_len,
-                            detokenize=detokenize, registry=self._reg,
-                            sink=self.sink, seed=seed, clock=self._clock,
-                            stall_floor_secs=stall_floor_secs,
-                            stall_factor=stall_factor,
-                            defer_handshake=True,
-                            engine_kwargs=engine_kwargs,
-                            trace=(tracer.decode_sample
-                                   if tracer is not None else 0),
-                            **pk)
+                self._make_replica(i, defer_handshake=True)
                 for i in range(n_replicas)
             ]
             for r in self.replicas:  # workers warmed up concurrently
@@ -192,19 +213,8 @@ class Router:
                 "supervised respawn is the process backend's restart "
                 "story; in-process replicas are revived explicitly "
                 "(revive_replica)")
-            self.replicas = [
-                Replica(model, i, n_slots=n_slots,
-                        max_seq_len=max_seq_len,
-                        detokenize=detokenize, registry=self._reg,
-                        sink=self.sink, seed=seed, clock=self._clock,
-                        stall_floor_secs=stall_floor_secs,
-                        stall_factor=stall_factor,
-                        engine_kwargs=engine_kwargs,
-                        trace=(tracer.decode_sample
-                               if tracer is not None else 0),
-                        draft_model=draft_model)
-                for i in range(n_replicas)
-            ]
+            self.replicas = [self._make_replica(i)
+                             for i in range(n_replicas)]
         eng0 = self.replicas[0].engine
         self.T_max = eng0.T_max
         # budget-aware admission limit (ISSUE 9): under paged KV the
@@ -230,6 +240,136 @@ class Router:
         self._by_replica = {r.replica_id: {} for r in self.replicas}
         #                    replica_id -> {engine_rid: rid}
         self._holds = []       # recent slot-hold durations (clock secs)
+        # predictive admission (ISSUE 12): when tracing is armed, a
+        # per-class WaitPredictor is fit on the submit -> dispatch
+        # deltas the trace events stamp, and projected_wait_ms consults
+        # it; with tracing off the static median-slot-hold rule stands
+        self.wait_predictor = None
+        if tracer is not None:
+            from avenir_tpu.serve.autoscale import WaitPredictor
+
+            self.wait_predictor = {c: WaitPredictor()
+                                   for c in PRIORITIES}
+
+    # ---- replica construction (ctor + autoscaler growth) ----
+
+    def _make_replica(self, i, *, prewarm=False, defer_handshake=False):
+        """Build one replica from the retained recipe. `prewarm` rides
+        the engine kwargs: the engine (worker hello, for the process
+        backend) runs one synthetic prefill + decode tick per bucket
+        BEFORE the replica is dispatchable, so a fresh replica never
+        serves its first compile to a user (Engine.prewarm)."""
+        ekw = dict(self._engine_kwargs)
+        if prewarm:
+            ekw["prewarm"] = True
+        trace = (self.tracer.decode_sample
+                 if self.tracer is not None else 0)
+        if self.backend == "process":
+            from avenir_tpu.serve.proc import ProcReplica
+
+            return ProcReplica(self._spec, i, registry=self._reg,
+                               sink=self.sink, clock=self._clock,
+                               defer_handshake=defer_handshake,
+                               engine_kwargs=ekw, trace=trace,
+                               **self._rep_cfg, **self._pk)
+        return Replica(self._model, i, registry=self._reg,
+                       sink=self.sink, clock=self._clock,
+                       engine_kwargs=ekw, trace=trace,
+                       draft_model=self._draft_model, **self._rep_cfg)
+
+    # ---- fleet elasticity (the autoscaler's actuators, ISSUE 12) ----
+
+    @property
+    def fleet_size(self):
+        """Serving replicas: non-dead and not retiring (a draining
+        retiree still finishes its in-flight work — and still bills
+        replica-seconds — but takes no new dispatches)."""
+        return sum(r.state != DEAD and r.replica_id not in self._retiring
+                   for r in self.replicas)
+
+    def add_replica(self, *, prewarm=False):
+        """Grow the fleet by one replica (blocking: a process-backend
+        spawn pays its jax import, handshake, and — with `prewarm` —
+        its compile pre-warm before returning). Returns the replica."""
+        return self.finish_add_replica(
+            self.begin_add_replica(prewarm=prewarm))
+
+    def begin_add_replica(self, *, prewarm=False):
+        """Start building the next replica on a BACKGROUND thread and
+        return a handle: the fleet keeps serving while the newcomer
+        pays its spawn + compile pre-warm (seconds), and
+        `finish_add_replica(handle)` joins it in once
+        `handle.ready()`. Construction touches no router state beyond
+        reserving the replica id, so the serving loop and the build
+        never race — the newcomer only becomes visible at finish."""
+        import threading
+
+        i = self._next_replica_id
+        self._next_replica_id += 1
+        h = _SpawnHandle(i)
+
+        def build():
+            try:
+                h.result = self._make_replica(i, prewarm=prewarm)
+            except BaseException as e:  # noqa: BLE001 — surfaced at join
+                h.error = e
+
+        h.thread = threading.Thread(
+            target=build, daemon=True,
+            name=f"replica-{i}-spawn")
+        h.thread.start()
+        return h
+
+    def finish_add_replica(self, handle):
+        """Join a begin_add_replica build into the fleet (blocks until
+        the build finishes; call after `handle.ready()` to not block).
+        Raises whatever the build raised — the replica id is burned
+        but no fleet state changed."""
+        handle.thread.join()
+        if handle.error is not None:
+            raise handle.error
+        rep = handle.result
+        self.replicas.append(rep)
+        self._by_replica[rep.replica_id] = {}
+        if self._supervisor is not None:
+            self._supervisor.attach(
+                [r for r in self.replicas
+                 if r.replica_id not in self._retiring])
+        return rep
+
+    def retire_replica(self, i):
+        """Begin retiring a replica: it drains (no new admissions,
+        in-flight work finishes) and is removed — process workers shut
+        down — by the first step() that finds it idle. A retiree that
+        dies instead fails its work over like any death and is removed
+        without waiting."""
+        rep = self._rep(i)
+        self._retiring.add(rep.replica_id)
+        rep.drain()
+        if self._supervisor is not None:
+            # the supervisor must not respawn a replica the control
+            # plane decided to retire
+            self._supervisor.attach(
+                [r for r in self.replicas
+                 if r.replica_id not in self._retiring])
+
+    def _reap_retired(self):
+        for rep in [r for r in self.replicas
+                    if r.replica_id in self._retiring]:
+            if rep.state == DEAD or not rep.busy:
+                assert not self._by_replica[rep.replica_id], (
+                    "retiring an idle replica left mapped work behind")
+                self._retiring.discard(rep.replica_id)
+                self._by_replica.pop(rep.replica_id)
+                self.replicas.remove(rep)
+                if hasattr(rep, "close"):
+                    rep.close()
+
+    def _rep(self, i):
+        for r in self.replicas:
+            if r.replica_id == i:
+                return r
+        raise KeyError(f"no replica with id {i}")
 
     # ---- API ----
 
@@ -279,6 +419,8 @@ class Router:
             priority=priority,
             deadline_ms=None if deadline_ms is None else float(deadline_ms),
             submit_t=now,
+            depth_at_submit=(len(q) if self.wait_predictor is not None
+                             else None),
         )
         q.append(req)
         self._open[rid] = req
@@ -356,6 +498,10 @@ class Router:
                 self._failover(rep)
         finished.extend(self._pending)
         self._pending = []
+        # reap retirees that finished draining (ISSUE 12): their slots
+        # left the capacity pool at retire time (dispatchable_slots is
+        # 0 while draining); removal frees the process/engine itself
+        self._reap_retired()
         self._reg.gauge("router_queue_depth").set(self.queue_depth)
         self._reg.gauge("replica_healthy").set(self.n_healthy)
         # the engines share ONE registry, so their per-step gauge writes
@@ -365,8 +511,12 @@ class Router:
         self._reg.gauge("queue_depth").set(
             sum(r.engine.sched.queue_depth for r in self.replicas))
         total = sum(r.n_slots for r in self.replicas)
+        # a scaled-to-zero fleet has no slots to occupy — write 0.0
+        # rather than skipping, or the gauge freezes at its last
+        # pre-retirement value for as long as the fleet sleeps
         self._reg.gauge("slot_occupancy").set(
-            sum(len(r.engine._live) for r in self.replicas) / total)
+            sum(len(r.engine._live) for r in self.replicas) / total
+            if total else 0.0)
         alive = [r for r in self.replicas if r.state != DEAD]
         if alive:
             # oldest heartbeat across the live fleet: a rising value is
@@ -438,7 +588,11 @@ class Router:
                 if self.tracer is not None:
                     self.tracer.flight_dump("drain-all-dead")
                 raise RuntimeError(
-                    "all replicas dead with open requests — revive one"
+                    ("fleet scaled to zero with open requests — drive "
+                     "the loop through Autoscaler.run_step/drain so "
+                     "the burst wake can fire"
+                     if not self.replicas else
+                     "all replicas dead with open requests — revive one")
                     + (" (supervisor exhausted its respawn budget)"
                        if self._supervisor is not None else "")
                     + (f" (causes of death: {causes})" if causes else ""))
@@ -461,20 +615,21 @@ class Router:
 
     def kill_replica(self, i):
         """Abrupt replica death (the chaos drill's kill): mark dead and
-        fail its work over immediately."""
-        rep = self.replicas[i]
+        fail its work over immediately. `i` is the replica_id — under
+        an elastic fleet (add/retire) list positions drift, ids don't."""
+        rep = self._rep(i)
         if rep.state != DEAD:
             rep.mark_dead()
             self._failover(rep)
 
     def drain_replica(self, i):
-        self.replicas[i].drain()
+        self._rep(i).drain()
 
     def revive_replica(self, i):
         # a dead replica's assignments were already requeued by
         # _failover, so there is nothing to clear here; reviving a
         # draining replica must keep its live assignment map intact
-        self.replicas[i].revive()
+        self._rep(i).revive()
 
     # -- observable surface --
 
@@ -499,10 +654,23 @@ class Router:
         its deadline ANYWAY, so erring generous (0 until the first
         completion lands) only delays shedding, never loses work.
         With no healthy replica the wait is infinite and every
-        deadline-carrying submit sheds."""
-        cap = sum(r.n_slots for r in self.replicas if r.state == HEALTHY)
+        deadline-carrying submit sheds.
+
+        Predictive upgrade (ISSUE 12): when tracing is armed, a
+        per-class WaitPredictor fit on the traced submit -> dispatch
+        history answers instead — measured drain behavior under the
+        CURRENT load shape, not a static median — and this rule is the
+        fallback until it is fit (or whenever tracing is off)."""
+        cap = sum(r.n_slots for r in self.replicas
+                  if r.state == HEALTHY
+                  and r.replica_id not in self._retiring)
         if cap == 0:
             return float("inf")
+        if self.wait_predictor is not None:
+            p = self.wait_predictor[priority].predict_ms(
+                len(self._queues[priority]))
+            if p is not None:
+                return p
         hold = statistics.median_low(self._holds) if self._holds else 0.0
         contending = [c for c in PRIORITIES
                       if self._queues[c] or c == priority]
@@ -629,6 +797,14 @@ class Router:
                 self._failover(rep)
                 continue
             req.dispatch_t = self._clock()
+            if (self.wait_predictor is not None and req.failovers == 0
+                    and req.depth_at_submit is not None):
+                # the predictor learns from FIRST dispatches only: a
+                # failover requeue's wait measures replica death, not
+                # queue behavior (these are the same submit->dispatch
+                # deltas the trace events below stamp)
+                self.wait_predictor[req.priority].observe(
+                    req.depth_at_submit, req.dispatch_t - req.submit_t)
             self._where[req.rid] = rep.replica_id
             self._by_replica[rep.replica_id][eng_rid] = req.rid
             if self.tracer is not None:

@@ -164,6 +164,16 @@ def _serve(stream):
         return 2
     if tbuf is not None:
         tbuf.clock = engine._clock  # ages measured on the event clock
+    # compile pre-warm (ISSUE 12): the hello triggers one synthetic
+    # prefill + decode tick per bucket BEFORE the ok reply goes out —
+    # the parent's ProcReplica is not dispatchable until the handshake
+    # returns, so a fresh worker never serves its first compile to a
+    # user. The tick count rides the hello reply; the worker-registry
+    # `prewarm_ticks` counter mirrors to the fleet via the usual
+    # per-reply counter deltas.
+    prewarm_ticks = 0
+    if ekw.get("prewarm"):
+        prewarm_ticks = engine.prewarm()
 
     def drain_trace():
         if tbuf is None:
@@ -180,6 +190,7 @@ def _serve(stream):
                   "kv_impl": engine.kv_impl,
                   "kv_dtype": engine.kv_dtype,
                   "spec_decode": engine.spec_decode,
+                  "prewarm_ticks": prewarm_ticks,
                   "pid": os.getpid()})
 
     def hb():

@@ -57,6 +57,41 @@ def pytest_runtest_logreport(report):
             report.duration, "slow" in report.keywords)
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """Per-FILE duration report artifact (ISSUE 12 satellite): tier-1
+    on this container is timeout-bound, so every run leaves a JSON
+    ranking of where the 870s budget went — the first thing to read
+    when the suite creeps toward the wall. Path override:
+    AVENIR_TEST_DURATIONS (set empty to disable)."""
+    import json
+    import tempfile
+
+    path = os.environ.get(
+        "AVENIR_TEST_DURATIONS",
+        os.path.join(tempfile.gettempdir(),
+                     "avenir_test_file_durations.json"))
+    if not path or not TEST_DURATIONS:
+        return
+    per_file = {}
+    for nodeid, (dur, _slow) in TEST_DURATIONS.items():
+        f = per_file.setdefault(nodeid.split("::")[0],
+                                {"calls": 0, "secs": 0.0})
+        f["calls"] += 1
+        f["secs"] += dur
+    ranked = sorted(per_file.items(), key=lambda kv: -kv[1]["secs"])
+    try:
+        with open(path, "w") as fh:
+            json.dump({
+                "total_call_secs": round(
+                    sum(v["secs"] for v in per_file.values()), 2),
+                "n_tests": len(TEST_DURATIONS),
+                "files": [{"file": k, "secs": round(v["secs"], 2),
+                           "calls": v["calls"]} for k, v in ranked],
+            }, fh, indent=1)
+    except OSError:
+        pass  # a read-only tmpdir must not fail the suite
+
+
 from avenir_tpu.compat import get_mesh, install_jax_compat, set_mesh  # noqa: E402
 
 install_jax_compat()  # legacy runtimes: give tests the modern jax.set_mesh API

@@ -403,6 +403,61 @@ def test_spec_trace_events(slab_spec):
     assert evs and all("proposed" in e and "accepted" in e for e in evs)
 
 
+def test_spec_ttft_attribution_exact_over_traced_run(slab_spec):
+    """ISSUE 12 satellite regression: spec decoding samples the FIRST
+    token inside admission prefill (_Live.pending), so the trace
+    partition must anchor TTFT at the sample — the prefill that
+    produced it — not at the verify tick that harvests the pending
+    token (which would silently fold a decode tick, compile included,
+    into 'prefill'). Pins: (1) exactly one first_token event per
+    request, stamped at admission; (2) it precedes every decode tick;
+    (3) queue + prefill + failover still PARTITIONS the measured
+    ttft_ms exactly."""
+    from avenir_tpu.obs.trace import (
+        TraceBuffer,
+        Tracer,
+        ttft_attribution,
+    )
+
+    engine = slab_spec
+    reg = MetricsRegistry()
+    tr = Tracer(registry=reg, clock=engine._clock)
+    buf = TraceBuffer(clock=engine._clock, decode_sample=1)
+    old_tr, engine._tr = engine._tr, buf
+    try:
+        rids = []
+        for i in range(3):
+            t_sub = engine._clock()
+            rid = engine.submit([1, 2, 3 + i], max_new_tokens=4,
+                                rng=jax.random.key(100 + i))
+            # the router normally emits these fleet events; driving the
+            # engine directly, the test stamps them itself
+            tr.emit(rid, "submit", t=t_sub)
+            tr.emit(rid, "dispatch", t=t_sub)
+            rids.append(rid)
+        fins = engine.drain()
+    finally:
+        engine._tr = old_tr
+    tr.absorb(buf.drain(), rid_map={r: r for r in rids})
+    ticks = [e["t"] for e in tr.events() if e["ev"] == "decode_tick"]
+    assert ticks, "decode ticks must have been sampled (sample=1)"
+    for f in fins:
+        evs = tr.events_for(f.req_id)
+        ft = [e for e in evs if e["ev"] == "first_token"]
+        assert len(ft) == 1, "exactly one first_token per attempt"
+        assert ft[0].get("admission") is True, (
+            "spec first token must be stamped at admission prefill")
+        assert ft[0]["t"] <= min(ticks) + 1e-9, (
+            "the admission-sampled first token must precede the verify "
+            "tick that harvests it")
+        a = ttft_attribution(evs)
+        assert a is not None
+        assert a["queue_s"] + a["prefill_s"] + a["failover_s"] == \
+            pytest.approx(a["ttft_s"], abs=1e-9)
+        assert a["ttft_s"] * 1e3 == pytest.approx(f.ttft_ms, abs=2.0)
+        assert f.n_out == 4 and f.finish_reason == "length"
+
+
 @pytest.mark.slow
 def test_spec_process_worker_parity(gpt_pair):
     """Draft weights ship in the worker hello like target weights: a

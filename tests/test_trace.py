@@ -197,14 +197,16 @@ def test_chrome_trace_is_well_formed():
     tr.emit(0, "finish", t=4.0, reason="length", n_out=2)
     tr.emit(None, "decode_tick", t=3.5, n_live=1)
     tr.span("serve_decode", 2.5, 100.0)
+    tr.emit(None, "scale", t=3.7, action="up", reason="burn_rate",
+            from_size=1, to_size=2, burn_rate=2.5)
     j = tr.chrome()
     # round-trips through JSON (the file Perfetto actually loads)
     j = json.loads(json.dumps(j))
     assert set(j) == {"traceEvents", "displayTimeUnit"}
     for e in j["traceEvents"]:
-        assert e["ph"] in ("X", "i", "M")
+        assert e["ph"] in ("X", "i", "M", "C")
         assert "name" in e and "pid" in e
-        if e["ph"] in ("X", "i"):
+        if e["ph"] in ("X", "i", "C"):
             assert "ts" in e and "tid" in e
             assert isinstance(e["ts"], (int, float))
         if e["ph"] == "X":
@@ -218,6 +220,15 @@ def test_chrome_trace_is_well_formed():
           if e["ph"] == "X" and e["pid"] == 2]
     assert len(sp) == 1 and sp[0]["name"] == "serve_decode"
     assert sp[0]["dur"] == pytest.approx(100.0 * 1e3)
+    # the scale decision gets its OWN track (pid 4): an instant with
+    # the evidence in args plus a fleet_size counter series (ISSUE 12)
+    sc = [e for e in j["traceEvents"] if e["pid"] == 4 and e["ph"] == "i"]
+    assert len(sc) == 1 and sc[0]["name"] == "scale up"
+    assert sc[0]["args"]["burn_rate"] == 2.5
+    assert sc[0]["args"]["to_size"] == 2
+    ctr = [e for e in j["traceEvents"] if e["ph"] == "C"]
+    assert len(ctr) == 1 and ctr[0]["name"] == "fleet_size"
+    assert ctr[0]["args"]["replicas"] == 2
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,175 @@
+"""Autoscale decision-log report (ISSUE 12 tentpole, part 4).
+
+Answers "why did the fleet grow at 14:03" from artifacts alone: every
+`scale` trace event the autoscaler emitted (serve/autoscale.py) carries
+the evidence that triggered it — burn rate, per-class SLO attainment,
+measured queue wait, utilization, and the before/after fleet size —
+and this tool renders the decision log with that per-decision evidence,
+plus the run-level fleet economics (replica-seconds, time-weighted mean
+fleet size, longest decision-free stretch).
+
+Input: any JSONL carrying `trace` records — a `--metrics_log` from
+`tools/serve_bench.py --trace --autoscale=...`, the `.events.jsonl`
+written next to the Perfetto JSON, or a `flight-*.jsonl` dump. A
+`run_end` record's counters (when present) supply the authoritative
+`fleet_replica_seconds` / `scale_up` / `scale_down` totals; without
+one, the decision events alone still tell the story.
+
+Usage:
+    python tools/fleet_report.py out/metrics.jsonl
+    python tools/fleet_report.py serve_trace.events.jsonl --json
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from avenir_tpu.obs.report import load_records_with_skips  # noqa: E402
+from avenir_tpu.obs.trace import record_event  # noqa: E402
+from avenir_tpu.serve.autoscale import (  # noqa: E402
+    mean_fleet_size,
+    steady_window_s,
+)
+
+_EVIDENCE_KEYS = (
+    "burn_rate", "attainment_interactive", "attainment_batch",
+    "queue_wait_ms", "busy_frac", "queue_depth", "window_s", "replica",
+    "spawn_s",
+)
+
+
+def load_fleet_records(path):
+    records, skipped = load_records_with_skips(path)
+    events = [record_event(r) for r in records
+              if r.get("kind") == "trace" and "ev" in r]
+    end = next((r for r in reversed(records)
+                if r.get("kind") == "run_end"), None)
+    return events, end, skipped
+
+
+def summarize_fleet(events, run_end=None):
+    """Decision log + run-level fleet facts as a plain dict;
+    `format_fleet_report` renders it."""
+    scales = sorted((e for e in events if e.get("ev") == "scale"),
+                    key=lambda e: e["t"])
+    ts = [e["t"] for e in events]
+    t0 = min(ts) if ts else 0.0
+    t1 = max(ts) if ts else 0.0
+    decisions = []
+    for e in scales:
+        decisions.append({
+            "t": e["t"],
+            "t_rel_s": e["t"] - t0,
+            "action": e.get("action"),
+            "reason": e.get("reason"),
+            "from_size": e.get("from_size"),
+            "to_size": e.get("to_size"),
+            "evidence": {k: e[k] for k in _EVIDENCE_KEYS if k in e},
+        })
+    by_action = {}
+    for d in decisions:
+        by_action[d["action"]] = by_action.get(d["action"], 0) + 1
+    counters = (run_end or {}).get("counters") or {}
+    initial = (decisions[0]["from_size"] if decisions else None)
+    mean_size = None
+    if decisions and t1 > t0:
+        mean_size = mean_fleet_size(decisions, t0=t0, t1=t1,
+                                    initial_size=initial)
+    return {
+        "n_decisions": len(decisions),
+        "by_action": by_action,
+        "decisions": decisions,
+        "window_s": t1 - t0,
+        "mean_fleet_size": mean_size,
+        "steady_stretch_s": (steady_window_s(decisions, t0=t0, t1=t1)
+                             if ts else 0.0),
+        "replica_seconds": counters.get("fleet_replica_seconds"),
+        "scale_up_counter": counters.get("scale_up"),
+        "scale_down_counter": counters.get("scale_down"),
+        "prewarm_ticks": counters.get("prewarm_ticks"),
+    }
+
+
+def _fmt_evidence(ev):
+    bits = []
+    if ev.get("burn_rate") is not None:
+        bits.append(f"burn {ev['burn_rate']:.2f}")
+    for cls in ("interactive", "batch"):
+        a = ev.get(f"attainment_{cls}")
+        if a is not None:
+            bits.append(f"att[{cls}] {a:.0%}")
+    if ev.get("queue_wait_ms") is not None:
+        bits.append(f"queue_wait {ev['queue_wait_ms']:.0f}ms")
+    if ev.get("busy_frac") is not None:
+        bits.append(f"util {ev['busy_frac']:.0%}")
+    if ev.get("queue_depth"):
+        bits.append(f"qdepth {ev['queue_depth']}")
+    if ev.get("window_s") is not None:
+        bits.append(f"window {ev['window_s']:.0f}s")
+    if ev.get("spawn_s") is not None:
+        bits.append(f"spawn {ev['spawn_s'] * 1e3:.0f}ms")
+    return "  ".join(bits)
+
+
+def format_fleet_report(s):
+    lines = ["== avenir fleet report (autoscale decision log) =="]
+    head = [f"decisions: {s['n_decisions']}"]
+    if s["by_action"]:
+        head.append("(" + "  ".join(
+            f"{k}={v}" for k, v in sorted(s["by_action"].items())) + ")")
+    if s["window_s"]:
+        head.append(f"over {s['window_s']:.1f}s traced")
+    lines.append("  ".join(head))
+    bill = []
+    if s["replica_seconds"] is not None:
+        bill.append(f"replica-seconds {s['replica_seconds']:.1f}")
+    if s["mean_fleet_size"] is not None:
+        bill.append(f"mean fleet {s['mean_fleet_size']:.2f}")
+    if s["prewarm_ticks"]:
+        bill.append(f"prewarm ticks {s['prewarm_ticks']:.0f}")
+    if bill:
+        lines.append("bill:      " + "   ".join(bill))
+    if s["n_decisions"]:
+        lines.append(f"steadiest: {s['steady_stretch_s']:.1f}s without "
+                     "a decision (no-flapping check)")
+        lines.append("")
+        lines.append("-- decisions (each with the evidence that "
+                     "triggered it) --")
+        for d in s["decisions"]:
+            lines.append(
+                f"  t=+{d['t_rel_s']:8.2f}s  {d['action']:<12} "
+                f"{d['from_size']} -> {d['to_size']}  "
+                f"reason={d['reason']}")
+            ev = _fmt_evidence(d["evidence"])
+            if ev:
+                lines.append(f"      {ev}")
+    else:
+        lines.append("no scale decisions in this log — a steady fleet "
+                     "(or the autoscaler was not armed)")
+    return "\n".join(lines)
+
+
+def main(argv):
+    as_json = "--json" in argv
+    paths = [a for a in argv if not a.startswith("--")]
+    assert len(paths) == 1, (
+        "usage: python tools/fleet_report.py <trace-events .jsonl> "
+        "[--json]\n(a serve_bench --metrics_log, a *.events.jsonl, or "
+        "a flight-*.jsonl dump)")
+    events, run_end, _skipped = load_fleet_records(paths[0])
+    if not events:
+        print(f"no trace records in {paths[0]} — was the run traced? "
+              "(tools/serve_bench.py --trace)", file=sys.stderr)
+        return 1
+    s = summarize_fleet(events, run_end)
+    if as_json:
+        print(json.dumps(s, indent=1))
+    else:
+        print(format_fleet_report(s))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

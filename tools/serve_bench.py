@@ -47,8 +47,20 @@ sibling `.events.jsonl`, `trace` records into --metrics_log, and
 flight-recorder dumps (`flight-*.jsonl`) on every replica death.
 `python tools/trace_report.py <events/log>` attributes TTFT across
 queue vs prefill vs failover per request.
+
+`--load_shape={poisson,bursty,diurnal}` (ISSUE 12) swaps the arrival
+process: seeded non-homogeneous generators (thinning) whose config
+rides run_meta, so any shape replays bit-identically.
+`--autoscale=<max_replicas>` arms the elastic control plane
+(serve/autoscale.py): the fleet follows SLO burn rate + measured
+queue wait between --min_replicas and max, every decision a traced
+`scale` event (`python tools/fleet_report.py <log>` prints the
+decision log). `--autoscale_bench` runs the ISSUE 12 acceptance
+sweep — autoscale vs every static fleet size on the seeded diurnal
+shape — and writes BENCH_autoscale.json.
 """
 
+import math
 import os
 import sys
 import time
@@ -64,6 +76,65 @@ import numpy as np  # noqa: E402
 from avenir_tpu.obs.report import percentile  # noqa: E402
 
 
+def gen_arrivals(shape, rng, n, rate, *, burst_mult=6.0, quiet_frac=0.25,
+                 burst_period_s=6.0, burst_duty=0.25, period_s=20.0,
+                 amp=0.8):
+    """Seeded arrival-time generators (ISSUE 12 satellite). Returns
+    (arrival times, config dict) — the config rides run_meta and the
+    BENCH json so any run replays bit-identically from (seed, params).
+
+      poisson   homogeneous exponential interarrivals (the PR 2 shape)
+      bursty    Poisson bursts over a quiet floor: rate x quiet_frac
+                outside bursts, rate x burst_mult inside; bursts occupy
+                the first burst_duty of every burst_period_s window
+      diurnal   sinusoidal rate: rate x (1 + amp sin(2 pi t/period_s))
+                — the day/night swing, compressed to bench scale
+
+    Non-homogeneous shapes draw by Lewis-Shedler thinning: candidates
+    at the peak rate, each kept with probability lambda(t)/lambda_max
+    from the SAME seeded stream — a pure function of (seed, params)."""
+    cfg = {"load_shape": shape, "rate": rate}
+    if shape == "poisson":
+        return np.cumsum(rng.exponential(1.0 / rate, n)), cfg
+    if shape == "bursty":
+        cfg.update(burst_mult=burst_mult, quiet_frac=quiet_frac,
+                   burst_period_s=burst_period_s, burst_duty=burst_duty)
+        lam_max = rate * burst_mult
+
+        def lam(t):
+            in_burst = (t % burst_period_s) < burst_duty * burst_period_s
+            return rate * (burst_mult if in_burst else quiet_frac)
+    elif shape == "diurnal":
+        cfg.update(period_s=period_s, amp=amp)
+        assert 0.0 <= amp < 1.0, "amp must be in [0, 1) — the rate " \
+            "must stay positive for thinning"
+        lam_max = rate * (1.0 + amp)
+
+        def lam(t):
+            return rate * (1.0 + amp * math.sin(2.0 * math.pi * t
+                                                / period_s))
+    else:
+        raise ValueError(f"unknown load_shape {shape!r} "
+                         "(poisson | bursty | diurnal)")
+    out, t = [], 0.0
+    while len(out) < n:
+        t += rng.exponential(1.0 / lam_max)
+        if rng.random() * lam_max <= lam(t):
+            out.append(t)
+    return np.asarray(out), cfg
+
+
+def _load_cfg_from_args(args):
+    shape = args.get("load_shape", "poisson")
+    kw = {}
+    for flag, cast in (("burst_mult", float), ("quiet_frac", float),
+                       ("burst_period_s", float), ("burst_duty", float),
+                       ("period_s", float), ("amp", float)):
+        if flag in args:
+            kw[flag] = cast(args[flag])
+    return shape, kw
+
+
 def _pct(xs, q):
     """percentile, rendered as nan on an empty list for the f-strings."""
     p = percentile(xs, q)
@@ -71,18 +142,21 @@ def _pct(xs, q):
 
 
 def slo_attainment(finished, *, slo_ttft_ms, slo_tpot_ms):
-    """Fraction of a class's requests that were SERVED (tokens
-    delivered, not shed/rejected/timed out) within both targets; tpot
-    applies only where it is defined (n_out > 1)."""
-    if not finished:
+    """Fraction of requests meeting the SLO — served (tokens
+    delivered, not shed/timed out) within both targets, tpot binding
+    only where defined. Delegates per-request scoring to the ONE
+    shared rule (`serve/autoscale.request_met_slo`) so the number the
+    bench scores IS the number the autoscaler steers on; door
+    rejections (impossible shapes — user error, not capacity) are
+    excluded from the denominator, same as the SLOEngine window."""
+    from avenir_tpu.serve.autoscale import request_met_slo
+
+    scored = [f for f in finished if f.finish_reason != "rejected"]
+    if not scored:
         return None
-    met = 0
-    for f in finished:
-        ok = (f.finish_reason in ("stop", "length")
-              and f.ttft_ms is not None and f.ttft_ms <= slo_ttft_ms
-              and (f.n_out <= 1 or f.tpot_ms <= slo_tpot_ms))
-        met += bool(ok)
-    return met / len(finished)
+    return sum(request_met_slo(f, slo_ttft_ms=slo_ttft_ms,
+                               slo_tpot_ms=slo_tpot_ms)
+               for f in scored) / len(scored)
 
 
 def _kv_engine_kwargs(args):
@@ -341,11 +415,225 @@ def sweep(args):
     return 0 if bench["ok"] else 1
 
 
+def autoscale_bench(args):
+    """BENCH_autoscale.json (ISSUE 12 acceptance): on the seeded
+    diurnal shape, the autoscaled fleet must meet --min_attainment at
+    >= 25% fewer replica-seconds than the smallest STATIC fleet that
+    also meets it. Every cell replays the same seeded arrival/prompt
+    schedule; every replica (static or spawned) pre-warms its compile
+    caches before taking work, so no cell serves compiles to users and
+    the comparison is pure capacity economics: a static fleet must be
+    provisioned for the diurnal PEAK all day, the autoscaled fleet
+    follows the curve."""
+    import json as _json
+
+    from flax import nnx
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+    from avenir_tpu.obs import MetricsRegistry
+    from avenir_tpu.obs.trace import Tracer
+    from avenir_tpu.serve import Router
+    from avenir_tpu.serve.autoscale import Autoscaler, SLOEngine
+
+    seed = int(args.get("seed", 0))
+    n_requests = int(args.get("n_requests", 1248))
+    rate = float(args.get("rate", 13.0))
+    period_s = float(args.get("period_s", 48.0))
+    amp = float(args.get("amp", 0.85))
+    n_slots = int(args.get("n_slots", 2))
+    max_new = int(args.get("max_new_tokens", 8))
+    max_prompt = int(args.get("max_prompt", 8))
+    slo_ttft_ms = float(args.get("slo_ttft_ms", 1000.0))
+    slo_tpot_ms = float(args.get("slo_tpot_ms", 250.0))
+    min_att = float(args.get("min_attainment", 0.9))
+    max_static = int(args.get("max_static", 3))
+    # the elastic fleet gets the same ceiling as the static sweep: the
+    # comparison is pure follow-the-curve economics (pass --autoscale
+    # above max_static to let it burst past the best static size —
+    # useful when ramp backlogs need fast drain, not at this SLO slack)
+    autoscale_max = int(args.get("autoscale", max_static))
+    auto_start = int(args.get("auto_start", 2))
+    slo_window_s = float(args.get("slo_window_s", 6.0))
+    max_seq_len = int(args.get("max_seq_len", 16))
+    assert max_prompt + max_new <= max_seq_len
+    # fixed decode-tick cadence: on a real chip the batched decode tick
+    # is bandwidth-bound and ~constant per replica; on this CPU bench
+    # the tiny model's compute fits far inside it, so each fleet-loop
+    # pass sleeps out the remainder of --tick_ms. Capacity is then
+    # slots x ticks — it SCALES with fleet size (the thing the bench
+    # measures) instead of being capped by the one host CPU — while
+    # every TTFT/TPOT stays honest wall time
+    tick_s = float(args.get("tick_ms", 25.0)) / 1e3
+    out_path = args.get("out", "BENCH_autoscale.json")
+
+    model = GPT(GPTConfig(
+        block_size=int(args.get("block_size", 64)), vocab_size=256,
+        n_layer=int(args.get("n_layer", 1)), n_head=2,
+        n_embd=int(args.get("n_embd", 32)),
+        dropout=0.0, bias=True, attn_impl="xla"), rngs=nnx.Rngs(seed))
+
+    mix = np.random.default_rng(seed)
+    arrivals, load_cfg = gen_arrivals("diurnal", mix, n_requests, rate,
+                                      period_s=period_s, amp=amp)
+    prompts = [
+        [int(t) for t in mix.integers(
+            0, 256, int(mix.integers(2, max_prompt + 1)))]
+        for _ in range(n_requests)
+    ]
+
+    def run_cell(n_static=None, autoscale=False):
+        reg = MetricsRegistry()
+        tracer = Tracer(registry=reg) if autoscale else None
+        router = Router(model, n_replicas=(n_static or auto_start),
+                        n_slots=n_slots, max_seq_len=max_seq_len,
+                        registry=reg, seed=seed, tracer=tracer,
+                        engine_kwargs={"prewarm": True})
+        scaler = None
+        if autoscale:
+            slo = SLOEngine(slo_ttft_ms=slo_ttft_ms,
+                            slo_tpot_ms=slo_tpot_ms,
+                            target_attainment=min_att,
+                            window_s=slo_window_s, registry=reg)
+            scaler = Autoscaler(
+                router, slo, min_replicas=1,
+                max_replicas=autoscale_max,
+                up_queue_wait_ms=float(args.get("up_queue_wait_ms",
+                                                slo_ttft_ms * 0.35)),
+                up_stable_s=float(args.get("up_stable_s", 0.5)),
+                down_stable_s=float(args.get("down_stable_s", 2.0)),
+                cooldown_s=float(args.get("cooldown_s", 1.25)),
+                down_util=float(args.get("down_util", 0.7)),
+                spawn_async=True)
+        t0 = time.perf_counter()
+        submitted = 0
+        done = []
+        while len(done) < n_requests:
+            now = time.perf_counter() - t0
+            while submitted < n_requests and arrivals[submitted] <= now:
+                router.submit(prompts[submitted],
+                              max_new_tokens=max_new,
+                              temperature=1.0, top_k=None)
+                submitted += 1
+            if router.open_requests or router._pending:
+                t_step = time.perf_counter()
+                fins = router.step()
+                done.extend(fins)
+                if scaler is not None:
+                    scaler.observe(fins)
+                lag = tick_s - (time.perf_counter() - t_step)
+                if lag > 0:
+                    time.sleep(lag)  # the paced tick cadence
+            elif submitted < n_requests:
+                time.sleep(min(tick_s,
+                               max(0.0, arrivals[submitted] - now)))
+            if scaler is not None:
+                scaler.poll()
+        wall = time.perf_counter() - t0
+        if scaler is not None:
+            scaler.poll()
+        if scaler is not None:
+            scaler.close()  # reap any still-warming background spawn
+        att = slo_attainment(done, slo_ttft_ms=slo_ttft_ms,
+                             slo_tpot_ms=slo_tpot_ms)
+        ttfts = [f.ttft_ms for f in done if f.ttft_ms is not None]
+        counters = reg.snapshot()["counters"]
+        cell = {
+            "attainment": att, "wall_s": round(wall, 3),
+            "ttft_p50_ms": _pct(ttfts, 0.50),
+            "ttft_p99_ms": _pct(ttfts, 0.99),
+        }
+        if autoscale:
+            cell["replica_seconds"] = counters.get(
+                "fleet_replica_seconds", 0.0)
+            cell["scale_up"] = counters.get("scale_up", 0.0)
+            cell["scale_down"] = counters.get("scale_down", 0.0)
+            cell["prewarm_ticks"] = counters.get("prewarm_ticks", 0.0)
+            cell["decisions"] = [
+                {"t_s": round(d.t - t0, 3), "action": d.action,
+                 "reason": d.reason, "from_size": d.from_size,
+                 "to_size": d.to_size, "evidence": d.evidence}
+                for d in scaler.decisions
+            ]
+        else:
+            # a static fleet holds n chips for the whole serving window
+            cell["replica_seconds"] = n_static * wall
+        router.close()
+        name = "auto" if autoscale else f"static{n_static}"
+        print(f"[autoscale_bench:{name}] attainment "
+              f"{(att if att is not None else float('nan')):6.1%}  "
+              f"replica-seconds {cell['replica_seconds']:7.1f}  "
+              f"ttft p99 {cell['ttft_p99_ms']:7.0f} ms")
+        return cell
+
+    cells = {}
+    for nrep in range(1, max_static + 1):
+        cells[f"static_{nrep}"] = run_cell(n_static=nrep)
+    cells["autoscale"] = run_cell(autoscale=True)
+
+    ok_static = sorted(
+        (int(k.split("_")[1]), c) for k, c in cells.items()
+        if k.startswith("static_") and c["attainment"] is not None
+        and c["attainment"] >= min_att)
+    auto = cells["autoscale"]
+    smallest = ok_static[0] if ok_static else None
+    savings = None
+    if smallest is not None and smallest[1]["replica_seconds"] > 0:
+        savings = 1.0 - (auto["replica_seconds"]
+                         / smallest[1]["replica_seconds"])
+    ok = (auto["attainment"] is not None
+          and auto["attainment"] >= min_att
+          and savings is not None and savings >= 0.25)
+    bench = {
+        "kind": "autoscale_bench",
+        "config": {
+            "seed": seed, "n_requests": n_requests,
+            **load_cfg,
+            "n_slots": n_slots, "max_new_tokens": max_new,
+            "max_prompt": max_prompt, "slo_ttft_ms": slo_ttft_ms,
+            "slo_tpot_ms": slo_tpot_ms, "min_attainment": min_att,
+            "slo_window_s": slo_window_s, "max_static": max_static,
+            "autoscale_max": autoscale_max, "auto_start": auto_start,
+            "max_seq_len": max_seq_len,
+            "tick_ms": tick_s * 1e3,
+            "tick_note": (
+                "every fleet-loop pass is paced to tick_ms (the "
+                "bandwidth-bound decode tick of a real replica; the "
+                "tiny CPU model's compute fits inside it, the "
+                "remainder is slept) so capacity scales with slots x "
+                "replicas instead of the one host CPU; latencies are "
+                "real wall time"),
+            "replica_seconds_note": (
+                "static cells bill n_replicas x wall; the autoscale "
+                "cell bills the fleet_replica_seconds counter "
+                "(per-poll dt x non-dead replicas, draining retirees "
+                "included) — same clock, same serving window"),
+        },
+        "cells": cells,
+        "smallest_static_meeting_slo": (smallest[0] if smallest
+                                        else None),
+        "autoscale_attainment": auto["attainment"],
+        "replica_second_savings": savings,
+        "ok": ok,
+    }
+    with open(out_path, "w") as f:
+        _json.dump(bench, f, indent=1)
+    print(f"[autoscale_bench] smallest static meeting SLO: "
+          f"{smallest[0] if smallest else 'none'}  "
+          f"autoscale attainment "
+          f"{(auto['attainment'] or float('nan')):.1%}  "
+          f"replica-second savings "
+          f"{(savings if savings is not None else float('nan')):.1%}"
+          f"  -> {out_path} (ok={ok})")
+    return 0 if ok else 1
+
+
 def main():
     args = {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
             for a in sys.argv[1:]}
     if "sweep" in args:
         sys.exit(sweep(args))
+    if "autoscale_bench" in args:
+        sys.exit(autoscale_bench(args))
     n_requests = int(args.get("n_requests", 32))
     rate = float(args.get("rate", 16.0))  # mean arrivals per second
     n_slots = int(args.get("n_slots", 4))
@@ -455,7 +743,12 @@ def main():
                                                     10.0)))
 
     load_rng = np.random.default_rng(seed)
-    arrivals = np.cumsum(load_rng.exponential(1.0 / rate, n_requests))
+    # --load_shape (ISSUE 12 satellite): seeded non-homogeneous
+    # arrival generators; the full shape config rides run_meta so the
+    # bench replays bit-identically
+    load_shape, load_kw = _load_cfg_from_args(args)
+    arrivals, load_cfg = gen_arrivals(load_shape, load_rng, n_requests,
+                                      rate, **load_kw)
     prompts = [
         [int(t) for t in load_rng.integers(0, cfg.vocab_size,
                                            int(load_rng.integers(2, max_prompt + 1)))]
@@ -464,10 +757,42 @@ def main():
     priorities = ["batch" if load_rng.random() < batch_frac
                   else "interactive" for _ in range(n_requests)]
 
+    # --autoscale=<max_replicas> (ISSUE 12 tentpole): arm the elastic
+    # control plane — the fleet starts at --n_replicas and the
+    # autoscaler grows/retires it against the SLO targets; decisions
+    # land as `scale` trace events (arm --trace for the full audit
+    # trail + fleet_report)
+    scaler = None
+    if args.get("autoscale"):
+        from avenir_tpu.serve.autoscale import Autoscaler, SLOEngine
+
+        slo = SLOEngine(
+            slo_ttft_ms=slo_ttft_ms, slo_tpot_ms=slo_tpot_ms,
+            target_attainment=float(args.get("min_attainment", 0.9)),
+            window_s=float(args.get("slo_window_s", 10.0)),
+            registry=reg)
+        scaler = Autoscaler(
+            router, slo,
+            min_replicas=int(args.get("min_replicas", 1)),
+            max_replicas=int(args.get("autoscale")),
+            up_stable_s=float(args.get("up_stable_s", 1.0)),
+            down_stable_s=float(args.get("down_stable_s", 6.0)),
+            cooldown_s=float(args.get("cooldown_s", 3.0)),
+            scale_to_zero=args.get("scale_to_zero") not in (None, "0",
+                                                            "false"),
+            prewarm=args.get("prewarm", "1") not in ("0", "false"),
+            # a real-time serving loop must not freeze while a spawn
+            # compiles: newcomers warm on a background thread
+            spawn_async=True)
+
     sink.write({"kind": "run_meta", "t": time.time(), "model_type":
                 type(model).__name__.lower(), "n_slots": n_slots,
                 "n_replicas": n_replicas, "rate": rate,
-                "n_requests": n_requests, "seed": seed})
+                "n_requests": n_requests, "seed": seed,
+                **load_cfg,
+                **({"autoscale_max": scaler.max_replicas,
+                    "autoscale_min": scaler.min_replicas}
+                   if scaler is not None else {})})
     # kill schedule: evenly spaced completion milestones (the fleet is
     # warm and loaded when the axe falls, so MTTR measures failover,
     # not compile)
@@ -494,8 +819,12 @@ def main():
             alive = [r for r in router.replicas if r.state != "dead"]
             # a meaningful MTTR needs a victim HOLDING work (an idle
             # kill has nothing to fail over) and a survivor to fail
-            # over TO; otherwise defer to a later step
-            busy = [r for r in alive if r.busy]
+            # over TO; otherwise defer to a later step. A retiring
+            # replica is not a victim — the autoscaler is already
+            # removing it, and the inproc revive below would race the
+            # reaper for an id that no longer exists
+            busy = [r for r in alive if r.busy
+                    and r.replica_id not in router._retiring]
             if len(alive) >= 2 and busy:
                 victim = kill_rng.choice(busy)
                 if backend == "process":
@@ -511,13 +840,25 @@ def main():
                       f"({backend}) after {len(done)} completions")
         for rid_, due in list(revive_due.items()):
             if step_n >= due:
-                router.revive_replica(rid_)
                 revive_due.pop(rid_)
+                try:
+                    router.revive_replica(rid_)
+                except KeyError:
+                    # the autoscaler reaped the corpse (dead retirees
+                    # are removed, not revived) — nothing to bring back
+                    pass
         if router.open_requests or router._pending:
-            done.extend(router.step())
+            fins = router.step()
+            done.extend(fins)
             step_n += 1
+            if scaler is not None:
+                scaler.observe(fins)
         elif submitted < n_requests:
             time.sleep(min(0.005, arrivals[submitted] - now))
+        if scaler is not None:
+            # poll every loop pass — idle passes included, so troughs
+            # retire replicas and a scaled-to-zero fleet can wake
+            scaler.poll()
     wall = time.perf_counter() - t0
     if tracer is not None:
         import json as _json
@@ -592,6 +933,13 @@ def main():
               f"{', '.join(shown)} ms over {len(kill_wall)} kill(s)  "
               f"[failovers {counters.get('serve_failovers', 0.0):.0f}, "
               f"respawns {counters.get('replica_respawns', 0.0):.0f}]")
+    if scaler is not None:
+        rs = counters.get("fleet_replica_seconds", 0.0)
+        print(f"autoscale: +{counters.get('scale_up', 0.0):.0f}"
+              f"/-{counters.get('scale_down', 0.0):.0f} decisions  "
+              f"fleet {router.fleet_size} at end  "
+              f"replica-seconds {rs:.1f} "
+              f"(mean fleet {rs / wall:.2f} over {wall:.1f}s)")
     if backend == "inproc":
         n_prefills = sum(len(r.engine.traces["prefill"])
                          for r in router.replicas)
@@ -602,6 +950,8 @@ def main():
     if metrics_log:
         print(f"metrics: {metrics_log} "
               f"(summarize: python tools/obs_report.py {metrics_log})")
+    if scaler is not None:
+        scaler.close()  # a still-warming spawn must not outlive the run
     router.close()
 
 

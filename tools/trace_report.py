@@ -64,6 +64,13 @@ def summarize_traces(events):
             "chunks": sum(1 for e in evs if e["ev"] == "prefill_chunk"),
             "prefix_hit": any(e["ev"] == "prefix_hit" for e in evs),
             "cows": sum(1 for e in evs if e["ev"] == "cow"),
+            # spec decoding samples the first token INSIDE admission
+            # prefill (ISSUE 12 satellite): the event says so, and the
+            # partition stays exact — prefill ends at the sample, not
+            # at the verify tick that harvests it
+            "admission_first": any(e["ev"] == "first_token"
+                                   and e.get("admission")
+                                   for e in evs),
             "attribution": att,
             "segments": request_segments(evs),
         })
@@ -80,6 +87,7 @@ def summarize_traces(events):
         "n_requests": len(reqs),
         "n_with_token": len(with_ttft),
         "n_failover": sum(1 for r in reqs if r["failovers"]),
+        "n_admission_first": sum(1 for r in reqs if r["admission_first"]),
         "reasons": _count(r["reason"] for r in reqs),
         "ttft_p50_ms": percentile(ttfts, 0.50),
         "ttft_p99_ms": percentile(ttfts, 0.99),
@@ -104,6 +112,11 @@ def format_trace_report(s, *, detail_failovers=8):
         f"requests traced: {s['n_requests']}  "
         f"(with >=1 token: {s['n_with_token']}, "
         f"survived a failover: {s['n_failover']})")
+    if s.get("n_admission_first"):
+        lines.append(
+            f"spec decode: {s['n_admission_first']} first token(s) "
+            "sampled inside admission prefill (TTFT anchors at the "
+            "sample, not the verify tick that harvests it)")
     if s["reasons"]:
         lines.append("finish reasons: " + "  ".join(
             f"{k}={v}" for k, v in sorted(s["reasons"].items(),
