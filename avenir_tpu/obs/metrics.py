@@ -279,6 +279,28 @@ METRIC_SCHEMA = {
         "chunked-prefill dispatches by the paged engine (each computes "
         "at most prefill_chunk prompt tokens, so long prompts never "
         "stall a decode tick)"),
+    # -- disaggregated prefill/decode (ISSUE 13) --
+    "kv_pages_exported": (
+        "counter", "1",
+        "finished KV pages exported by prefill-class engines (each a "
+        "page_size-token block fully covered by prompt tokens, "
+        "streamed the moment its chunk completes)"),
+    "kv_pages_imported": (
+        "counter", "1",
+        "transferred KV pages WRITTEN into a decode-class engine's "
+        "pool (chain nodes already present dedupe and are not "
+        "counted — their bytes were never sent twice either)"),
+    "kv_transfers": (
+        "counter", "1",
+        "completed prefill->decode handoffs (router kv_transfer "
+        "events with handoff=true; the decode replica's admission "
+        "prefix-attaches the imported chain and computes only the "
+        "sub-page tail)"),
+    "kv_transfer_bytes": (
+        "counter", "bytes",
+        "tensor bytes shipped over PT_KVPAGES frames between replica "
+        "classes (page K/V data + per-head int8 scale sidecars when "
+        "kv_dtype='int8')"),
     # -- decode raw speed (ISSUE 11: spec decoding + int8 KV) --
     "spec_proposed": (
         "counter", "tok",

@@ -67,6 +67,11 @@ TRACE_EVENTS = {
     "spec_verify",   # sampled speculative verify tick: proposed/
                      # accepted draft counts ride as attrs (rid=None)
     "evict",         # deadline eviction from a held slot
+    "kv_transfer",   # KV pages shipped between disagg replica classes
+                     # (ISSUE 13): attrs pages/bytes/src/dst; the
+                     # handoff=True marker opens the `transfer` TTFT
+                     # segment (streamed mid-prefill ships are instants
+                     # — their latency hid behind prefill compute)
     "failover",      # the replica holding this request died
     "requeue",       # re-queued (front of class) for a fresh dispatch
     "finish",        # THE terminal event: reason in attrs, one per rid
@@ -315,6 +320,10 @@ def request_segments(events):
 
         queue     submitted/requeued, waiting for a dispatch
         prefill   dispatched, working toward its first token
+        transfer  the non-overlapped tail of a disagg page handoff
+                  (kv_transfer handoff=True -> the decode dispatch);
+                  pages streamed mid-prefill hid behind prefill compute
+                  and never open this segment (ISSUE 13)
         failover  time sunk into an attempt whose replica died (the
                   work was discarded — re-prefill starts from scratch)
         decode    first token -> finish
@@ -322,10 +331,14 @@ def request_segments(events):
     The segments PARTITION [submit, finish] by construction (each event
     closes the previous segment at its own timestamp), which is what
     lets trace_report attribute a TTFT exactly: queue + prefill +
-    failover sums to first_token - submit with no residue. A failover
-    retroactively relabels its whole attempt (dispatch onward — prefill
-    AND any decoded tokens) as failover loss: the work was discarded,
-    whatever it was called while it ran."""
+    transfer + failover sums to first_token - submit with no residue.
+    A failover retroactively relabels its whole attempt (dispatch
+    onward — prefill, transfer AND any decoded tokens) as failover
+    loss: the work was discarded, whatever it was called while it ran.
+    A handoff dispatch (one that closes a `transfer` segment) CONTINUES
+    the attempt rather than starting a new one — the prefill happened
+    on another replica, but it is the same work product, and a death
+    after handoff discards all of it."""
     evs = sorted((e for e in events if e.get("ev") != "span"),
                  key=lambda e: e["t"])  # stable: ties keep append order
     segs = []
@@ -343,21 +356,34 @@ def request_segments(events):
         if ev == "submit":
             state, t0 = "queue", t
         elif ev == "dispatch":
+            handoff = state == "transfer"
             if state is not None:
                 close(state, t)
             state = "prefill"
-            attempt_at = len(segs)
+            if not handoff:
+                attempt_at = len(segs)
+        elif ev == "kv_transfer" and e.get("handoff"):
+            if state is not None:
+                close(state, t)
+            state = "transfer"
         elif ev in ("failover", "requeue"):
             if state is not None:
                 close(state, t)
-                # the dead attempt's time — prefill underway, tokens
-                # already decoded — died with the replica: relabel it
-                # failover loss. Queue wait is untouched (nothing was
-                # lost there; the wait just grew).
-                for i in range(attempt_at, len(segs)):
-                    k, a, b = segs[i]
-                    if k in ("prefill", "decode"):
-                        segs[i] = ("failover", a, b)
+                # the dead attempt's time — prefill underway, pages
+                # transferred, tokens already decoded — died with the
+                # replica: relabel it failover loss. Queue wait is
+                # untouched (nothing was lost there; the wait grew).
+                # EXCEPT a handoff-retry requeue (no healthy decode
+                # target at handoff time, ISSUE 13): no replica died
+                # and the work product is RETAINED — the retry
+                # prefix-hits the warm chain — so relabeling it
+                # failover would put failover_s in a report whose
+                # failover count is 0.
+                if not (ev == "requeue" and e.get("handoff_retry")):
+                    for i in range(attempt_at, len(segs)):
+                        k, a, b = segs[i]
+                        if k in ("prefill", "transfer", "decode"):
+                            segs[i] = ("failover", a, b)
             state = "queue"
         elif ev == "first_token":
             if state is not None:
@@ -371,19 +397,22 @@ def request_segments(events):
 
 
 def ttft_attribution(events):
-    """{"ttft_s", "queue_s", "prefill_s", "failover_s"} for one
-    request's events, or None when it never produced a token. The three
-    components sum to ttft_s exactly (request_segments partitions)."""
+    """{"ttft_s", "queue_s", "prefill_s", "transfer_s", "failover_s"}
+    for one request's events, or None when it never produced a token.
+    The four components sum to ttft_s exactly (request_segments
+    partitions) — `transfer` is the disagg handoff's non-overlapped
+    remainder (ISSUE 13)."""
     firsts = [e["t"] for e in events if e.get("ev") == "first_token"]
     submits = [e["t"] for e in events if e.get("ev") == "submit"]
     if not firsts or not submits:
         return None
     t_first = max(firsts)  # the attempt that survived (failover
     #                        discards earlier attempts' tokens)
-    out = {"ttft_s": t_first - submits[0],
-           "queue_s": 0.0, "prefill_s": 0.0, "failover_s": 0.0}
+    out = {"ttft_s": t_first - submits[0], "queue_s": 0.0,
+           "prefill_s": 0.0, "transfer_s": 0.0, "failover_s": 0.0}
     for kind, a, b in request_segments(events):
-        if b <= t_first and kind in ("queue", "prefill", "failover"):
+        if b <= t_first and kind in ("queue", "prefill", "transfer",
+                                     "failover"):
             out[kind + "_s"] += b - a
     return out
 

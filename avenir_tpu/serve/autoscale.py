@@ -117,7 +117,15 @@ class SLOEngine:
             ok = request_met_slo(f, slo_ttft_ms=self.slo_ttft_ms,
                                  slo_tpot_ms=self.slo_tpot_ms)
             cls = getattr(f, "priority", "interactive")
-            self._obs.append((now, cls, bool(ok)))
+            served = f.finish_reason in SERVED
+            # per-COMPONENT verdicts (ISSUE 13): TTFT misses point at
+            # the prefill class, TPOT misses at the decode class — the
+            # signals a disaggregated fleet scales its classes on
+            ttft_ok = (served and f.ttft_ms is not None
+                       and f.ttft_ms <= self.slo_ttft_ms)
+            tpot_ok = (served
+                       and (f.n_out <= 1 or f.tpot_ms <= self.slo_tpot_ms))
+            self._obs.append((now, cls, bool(ok), ttft_ok, tpot_ok))
             self.n_observed += 1
         self._evict(now)
 
@@ -130,11 +138,25 @@ class SLOEngine:
         """Fraction of windowed observations meeting the SLO (None with
         no samples). `priority=None` pools every class."""
         self._evict(self.clock())
-        obs = [ok for _, c, ok in self._obs
-               if priority is None or c == priority]
+        obs = [o[2] for o in self._obs
+               if priority is None or o[1] == priority]
         if not obs:
             return None
         return sum(obs) / len(obs)
+
+    def component_attainments(self):
+        """Windowed attainment per SLO COMPONENT, pooled over priority
+        classes: 'ttft' (queue + prefill latency — the prefill class's
+        resource under disaggregation) and 'tpot' (decode bandwidth —
+        the decode class's). None per key with no windowed samples.
+        These are what let the autoscaler grow the RIGHT replica class
+        (ISSUE 13 satellite)."""
+        self._evict(self.clock())
+        out = {}
+        for key, idx in (("ttft", 3), ("tpot", 4)):
+            vals = [o[idx] for o in self._obs]
+            out[key] = sum(vals) / len(vals) if vals else None
+        return out
 
     def attainments(self):
         """Per-class windowed attainment ({cls: fraction or None}).
@@ -578,11 +600,82 @@ class Autoscaler:
                 occupied += getattr(rep.engine, "_prefilling", 0)
         return occupied / total if total else 0.0
 
+    # -- disaggregated class choice (ISSUE 13 satellite) --
+
+    def _disagg(self):
+        """Is the router's fleet split into prefill/decode classes?"""
+        return (hasattr(self.router, "fleet_size_by_class")
+                and any(v == "prefill"
+                        for v in getattr(self.router, "_role",
+                                         {}).values()))
+
+    def _queued_long_frac(self):
+        """Fraction of router-queued requests that would route to the
+        prefill class (prompt >= disagg_min_prompt); None with nothing
+        queued. This is what distinguishes 'TTFT burns because prefill
+        is short' from 'TTFT burns because the decode class has no free
+        slots' — both show as queue wait + TTFT misses, but only the
+        queued work's composition names the starved class."""
+        thr = getattr(self.router, "disagg_min_prompt", 0)
+        n = n_long = 0
+        for q in getattr(self.router, "_queues", {}).values():
+            for req in q:
+                n += 1
+                n_long += len(req.prompt) >= thr
+        return (n_long / n) if n else None
+
+    def _pick_up_class(self, reason):
+        """Which replica class a scale-up should grow. Queue wait and
+        TTFT-dominated burn follow the QUEUED WORK's composition
+        (_queued_long_frac): a long-dominated queue is waiting on
+        prefill-class capacity, a short-dominated one on decode slots —
+        growing prefill under a short-prompt flood would spend the
+        fleet budget on replicas that can never serve the backlog. A
+        TPOT-dominated burn is decode bandwidth. Wake / replace_dead
+        restore a decode-class replica first — it serves the full
+        lifecycle standalone, so the fleet is never alive yet unable
+        to finish anything."""
+        if reason in ("wake", "replace_dead"):
+            return "both"
+        if reason == "queue_wait":
+            lf = self._queued_long_frac()
+            return "prefill" if lf is None or lf >= 0.5 else "both"
+        comp = self.slo.component_attainments()
+        budget = 1.0 - self.slo.target_attainment
+        burn_ttft = (None if comp["ttft"] is None
+                     else (1.0 - comp["ttft"]) / budget)
+        burn_tpot = (None if comp["tpot"] is None
+                     else (1.0 - comp["tpot"]) / budget)
+        if (burn_ttft or 0.0) > (burn_tpot or 0.0):
+            lf = self._queued_long_frac()
+            # an empty queue + TTFT burn = prefill latency itself
+            return "both" if lf is not None and lf < 0.5 else "prefill"
+        return "both"
+
+    def _class_evidence(self, evidence):
+        """Per-class sizes + component burn, folded into the decision's
+        audit evidence when the fleet is disaggregated."""
+        if not self._disagg():
+            return evidence
+        comp = self.slo.component_attainments()
+        by = self.router.fleet_size_by_class()
+        return {**evidence,
+                "prefill_replicas": by["prefill"],
+                "decode_replicas": by["decode"],
+                "attainment_ttft": (None if comp["ttft"] is None
+                                    else round(comp["ttft"], 4)),
+                "attainment_tpot": (None if comp["tpot"] is None
+                                    else round(comp["tpot"], 4))}
+
     # -- actuation + audit trail --
 
     def _scale_up(self, now, reason, evidence):
         before = self.router.fleet_size
         action = reason if reason in ("wake", "replace_dead") else "up"
+        role = self._pick_up_class(reason) if self._disagg() else "both"
+        evidence = self._class_evidence(evidence)
+        if role != "both":
+            evidence = {**evidence, "class": role}
         if self.spawn_async:
             # STEP SIZE follows the measured need: a queue wait at N x
             # the trigger threshold asks for ~N replicas' worth of
@@ -598,7 +691,7 @@ class Autoscaler:
                     self.max_replicas - before)
             for _ in range(k):
                 self._spawns.append(self.router.begin_add_replica(
-                    prewarm=self.prewarm))
+                    prewarm=self.prewarm, role=role))
             return self._decide(
                 now, action, reason, before, before + k,
                 {**evidence,
@@ -606,7 +699,8 @@ class Autoscaler:
                  "n_spawn": k, "spawn_async": True})
         t0 = self._clock()
         try:
-            rep = self.router.add_replica(prewarm=self.prewarm)
+            rep = self.router.add_replica(prewarm=self.prewarm,
+                                          role=role)
         except Exception as e:  # noqa: BLE001 — same policy as the
             # async join: a spawn failure is an event, not a reason to
             # crash a loop that is still serving on the healthy fleet.
@@ -646,16 +740,43 @@ class Autoscaler:
         victim = self._pick_victim()
         if victim is None:
             return None
+        evidence = self._class_evidence(evidence)
         self.router.retire_replica(victim.replica_id)
         return self._decide(now, "down", reason, before, before - 1,
                             {**evidence, "replica": victim.replica_id})
 
     def _pick_victim(self):
         """Retire the least-loaded healthy replica; ties retire the
-        newest (LIFO keeps the longest-warmed caches serving)."""
+        newest (LIFO keeps the longest-warmed caches serving).
+
+        Disagg (ISSUE 13): the victim comes from the class with the
+        LOWER component burn (surplus lives where the SLO is safest),
+        and neither class is ever retired to zero while the other
+        serves — a fleet with prefill replicas but no decode class
+        could prefill forever and finish nothing."""
         cands = [rep for rep in self.router.replicas
                  if rep.state == HEALTHY
                  and rep.replica_id not in self.router._retiring]
+        if self._disagg() and cands:
+            role_of = self.router._role
+            by = {"prefill": [r for r in cands
+                              if role_of.get(r.replica_id) == "prefill"],
+                  "decode": [r for r in cands
+                             if role_of.get(r.replica_id) != "prefill"]}
+            comp = self.slo.component_attainments()
+            # shrink the class whose SLO component is SAFEST; a class
+            # down to its last healthy replica is off the table
+            order = ["decode", "prefill"]
+            if (comp["ttft"] is not None and comp["tpot"] is not None
+                    and comp["ttft"] > comp["tpot"]):
+                order = ["prefill", "decode"]
+            for cls in order:
+                if len(by[cls]) > 1 or not by["prefill" if cls ==
+                                              "decode" else "decode"]:
+                    cands = by[cls]
+                    break
+            else:
+                return None
         if not cands:
             return None
         return min(cands, key=lambda rep: (len(rep.engine._live),

@@ -158,7 +158,8 @@ class Engine:
                  page_size=16, n_pages=None, max_pages_per_seq=None,
                  prefill_chunk=None, prefix_sharing=True,
                  paged_attn_impl="auto", tracer=None, kv_dtype="bf16",
-                 spec_decode="off", spec_k=4, draft_model=None):
+                 spec_decode="off", spec_k=4, draft_model=None,
+                 role="both"):
         """`kv_impl` (ISSUE 9, the attn_impl/loss_impl pattern):
         'slab' keeps the fixed per-slot KV columns (serve/slots.py);
         'paged' stores KV in a pool of `n_pages` blocks of `page_size`
@@ -190,6 +191,22 @@ class Engine:
         The draft's own KV rides a dense slab (`serve/slots.DraftPool`)
         whatever this engine's kv_impl/kv_dtype.
 
+        `role` (ISSUE 13, disaggregated prefill/decode): 'both' (the
+        default) serves the full request lifecycle; 'prefill' turns
+        this engine into a prefill-class worker — it chunk-prefills
+        prompts, EXPORTS each KV page the moment prompt tokens fully
+        cover it (`take_page_exports`, shipped over serve/frames.py
+        PT_KVPAGES frames by the router), and finishes the request
+        with finish_reason='prefilled' instead of ever decoding; its
+        page reservations cover the prompt only. Requires kv_impl=
+        'paged' (pages ARE the transfer unit) and spec_decode='off'.
+        Any paged engine can IMPORT pages (`import_kv_pages`): the
+        chain splices into the local allocator as cached prefix nodes,
+        so the handoff submit prefix-hits them and only computes the
+        sub-page tail — bit-identical to a full local prefill because
+        attached shared pages already are (the ISSUE 9 exactness
+        argument, now crossing a process boundary).
+
         `tracer` (ISSUE 10): an obs/trace.py TraceBuffer (or Tracer)
         receiving per-request lifecycle events — engine_admit, prefill
         chunks, prefix hits, COW, first token, sampled decode ticks,
@@ -214,6 +231,22 @@ class Engine:
         assert spec_decode in ("off", "draft"), (
             f"unknown spec_decode {spec_decode!r}")
         self.spec_decode = spec_decode
+        assert role in ("both", "prefill"), f"unknown role {role!r}"
+        if role == "prefill":
+            # fail LOUD at construction — in a process worker this is
+            # the hello (the spec-decode fail-loud policy): a prefill
+            # worker without pages has no transferable unit, and spec
+            # decoding's draft state cannot ride a page transfer
+            if kv_impl != "paged":
+                raise ValueError(
+                    "role='prefill' requires kv_impl='paged' — KV pages "
+                    "are the unit a prefill-class replica ships")
+            if spec_decode != "off":
+                raise ValueError(
+                    "role='prefill' is incompatible with spec_decode: a "
+                    "prefill-class replica never decodes, and the draft "
+                    "slab cannot ride a page transfer")
+        self.role = role
         self.spec_k = int(spec_k)
         assert self.spec_k >= 1
         self.draft_model = draft_model
@@ -251,7 +284,12 @@ class Engine:
         self._tick_n = 0    # decode ticks ever, for trace sampling
         self._next_id = 0
         self._base_rng = jax.random.key(seed)
-        self.traces = {"prefill": [], "step": [], "cow": []}
+        self.traces = {"prefill": [], "step": [], "cow": [], "import": []}
+        # finished-page export queue (role='prefill'): records the
+        # router drains each step and streams to the decode class —
+        # already-materialized numpy, so a SIGKILL mid-transfer loses
+        # nothing the failover re-prefill cannot recompute
+        self._page_exports = []
 
         n_kv = getattr(cfg, "n_kv_head", cfg.n_head)
         head_dim = cfg.n_embd // cfg.n_head
@@ -301,7 +339,8 @@ class Engine:
                 max_pages_per_seq=self.max_pages_per_seq,
                 prefill_chunk=self.prefill_chunk,
                 prefix_sharing=prefix_sharing,
-                spec_pad=self._spec_pad)
+                spec_pad=self._spec_pad,
+                prefill_only=(role == "prefill"))
             self.pool = init_paged_pool(
                 n_layer=cfg.n_layer, n_slots=self.n_slots,
                 n_pages=self.n_pages, page_size=self.page_size,
@@ -706,6 +745,28 @@ class Engine:
 
         self._cow_fn = _cow
 
+        # page import (ISSUE 13): scatter transferred page KV into the
+        # pool at the physical pages import_chain allocated. `phys` is
+        # padded to a ladder width with n_pages, which jax's
+        # out-of-bounds scatter DROPS — the same masking mechanism as
+        # chunk padding — so import width never retraces beyond the
+        # ladder (asserted like every other compile budget).
+        from avenir_tpu.infer.decode import bucket_ladder as _bl
+
+        self._import_ladder = _bl(self.max_pages_per_seq, floor=1)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _import(pool, phys, k_in, v_in):
+            traces["import"].append(jax.tree.leaves(k_in)[0].shape)
+
+            def scat(c, d):
+                return c.at[:, phys].set(d.astype(c.dtype), mode="drop")
+
+            return pool._replace(k=jax.tree.map(scat, pool.k, k_in),
+                                 v=jax.tree.map(scat, pool.v, v_in))
+
+        self._import_fn = _import
+
     # ---- API ----
 
     @property
@@ -789,7 +850,7 @@ class Engine:
 
     def submit(self, prompt, *, max_new_tokens, temperature=1.0,
                top_k=None, stop_tokens=(), rng=None, deadline_ms=None,
-               submit_t=None):
+               submit_t=None, front=False):
         """Enqueue a request; returns its id. `rng` defaults to
         fold_in(engine seed, id) — pass an explicit key to reproduce a
         one-shot `generate_cached` run. `deadline_ms` (None = none): a
@@ -799,6 +860,9 @@ class Engine:
         `submit_t` (engine-clock seconds) backdates the request — the
         router's failover path uses it so TTFT and the deadline keep
         counting from the ORIGINAL submission, not the resubmission.
+        `front=True` enqueues at the head (the disaggregated handoff:
+        the request already served its fleet-wide FCFS wait on the
+        prefill class; scheduler.enqueue_front).
 
         A prompt+budget that cannot fit the engine's limit is NOT an
         engine crash (ISSUE 6 satellite): it finishes immediately with
@@ -842,7 +906,10 @@ class Engine:
             submit_t=self._clock() if submit_t is None else float(submit_t),
             deadline_ms=None if deadline_ms is None else float(deadline_ms),
         )
-        self.sched.enqueue(req)
+        if front:
+            self.sched.enqueue_front(req)
+        else:
+            self.sched.enqueue(req)
         self._reg.gauge("queue_depth").set(self.sched.queue_depth)
         return rid
 
@@ -1011,6 +1078,15 @@ class Engine:
             st.next = start + n_real
             budget -= n_real
             pg.register_progress(slot)
+            if self.role == "prefill":
+                # export every page the chunk just finished covering —
+                # AS it finishes, not at the end, so the router streams
+                # pages to the decode class WHILE later chunks compute
+                # (handoff latency hides behind the remaining prefill)
+                self._collect_exports(slot)
+                if st.next >= st.n_prompt:
+                    finished.append(self._finish_prefilled(slot))
+                continue
             if st.next >= st.n_prompt:
                 # prefill done — the slot joins THIS tick's decode (the
                 # slab engine's admission->decode-same-tick semantics)
@@ -1079,7 +1155,138 @@ class Engine:
             "tables must ride as traced arguments)"
         )
         assert len(self.traces["cow"]) <= 1, "the COW copy retraced"
+        assert len(self.traces["import"]) <= len(
+            getattr(self, "_import_ladder", ())), (
+            "page-import compiles escaped the import ladder")
         return finished
+
+    # ---- disaggregated prefill/decode (ISSUE 13) ----
+
+    def _collect_exports(self, slot):
+        """Queue export records for every page slot of `slot`'s request
+        newly covered END-TO-END by prompt tokens. The gather reads the
+        CURRENT table — a partially attached page that was COWed reads
+        the COWed copy, a locally prefix-hit page reads the shared page
+        (same bytes this prompt's KV would be) — and materializes to
+        numpy immediately, so later page churn cannot corrupt a queued
+        export."""
+        pg = self._paged
+        st = pg.prefill[slot]
+        ps = self.page_size
+        covered = min(st.next, st.n_prompt)
+        last_excl = covered // ps          # page slots fully covered
+        if last_excl <= st.exported_upto:
+            return
+        rid = st.req.req_id
+        table = pg.alloc.table(rid)
+        idxs = list(range(st.exported_upto, last_excl))
+        phys = np.asarray([table[i].page for i in idxs], np.int32)
+        # tokens carry the FULL chain from ROOT; `n_prefix` marks where
+        # this segment's NEW pages (the shipped arrays) start. KV pages
+        # are only meaningful under the exact prefix that produced them
+        # (position + context dependence), so the importer anchors each
+        # segment on the already-imported chain instead of registering
+        # it at the root — an unanchored segment could falsely match a
+        # DIFFERENT prompt's prefix (import_chain docstring)
+        n_prefix = st.exported_upto
+        tokens = [list(st.req.prompt[i * ps:(i + 1) * ps])
+                  for i in range(last_excl)]
+        if self.kv_dtype == "int8":
+            arrays = [np.asarray(self.pool.k.data[:, phys]),
+                      np.asarray(self.pool.k.scale[:, phys]),
+                      np.asarray(self.pool.v.data[:, phys]),
+                      np.asarray(self.pool.v.scale[:, phys])]
+        else:
+            arrays = [np.asarray(self.pool.k[:, phys]),
+                      np.asarray(self.pool.v[:, phys])]
+        st.exported_upto = last_excl
+        pg.alloc.pages_exported += len(idxs)
+        self._reg.counter("kv_pages_exported").add(len(idxs))
+        self._page_exports.append({
+            "eng_rid": int(rid), "tokens": tokens, "n_prefix": n_prefix,
+            "kv_dtype": self.kv_dtype, "arrays": arrays,
+        })
+
+    def take_page_exports(self):
+        """Drain queued page-export records (role='prefill'). Each is
+        {eng_rid, tokens: [page-token lists, FULL chain from ROOT],
+        n_prefix: how many of those are anchor-only (already shipped),
+        kv_dtype, arrays: [k, v] or [k_data, k_scale, v_data, v_scale]
+        covering tokens[n_prefix:]} — the exact (meta, arrays) shape
+        serve/frames.encode_kv_pages ships."""
+        out, self._page_exports = self._page_exports, []
+        return out
+
+    def import_kv_pages(self, tokens, arrays, kv_dtype="bf16",
+                        n_prefix=0):
+        """Splice transferred KV pages into this engine's pool +
+        allocator (decode-class side of the handoff). `tokens` is the
+        chain identity (full-page token lists from ROOT — the first
+        `n_prefix` are anchors whose KV already landed in an earlier
+        segment), `arrays` the page KV for tokens[n_prefix:]. Already-
+        known chain nodes are deduped (their KV is bit-identical by the
+        exact-token key — nothing to write); new nodes get physical
+        pages from the allocator and ONE padded scatter writes their
+        KV. Returns the number of pages actually written. A partial
+        import (pool pressure, or a missing anchor) is fine: the
+        handoff submit's plan() attaches whatever prefix landed and
+        recomputes the rest — exactness never depends on the import."""
+        assert self._paged is not None, "page import needs kv_impl='paged'"
+        assert kv_dtype == self.kv_dtype, (
+            f"kv transfer dtype {kv_dtype!r} != engine kv_dtype "
+            f"{self.kv_dtype!r} — a disaggregated fleet must serve one "
+            "KV dtype (fail-loud, the handshake policy)")
+        pairs = self._paged.alloc.import_chain(tokens, n_prefix=n_prefix)
+        new = [(i, p) for i, (p, is_new) in enumerate(pairs) if is_new]
+        if not new:
+            return 0
+        from avenir_tpu.infer.decode import prompt_bucket
+        self._reg.counter("kv_pages_imported").add(len(new))
+        width = prompt_bucket(len(new), self.max_pages_per_seq, floor=1)
+        phys = np.full((width,), self.n_pages, np.int32)
+        phys[:len(new)] = [p for _, p in new]
+        sel = [i - n_prefix for i, _ in new]
+
+        def pad(a):
+            out = np.zeros((a.shape[0], width) + a.shape[2:], a.dtype)
+            out[:, :len(new)] = a[:, sel]
+            return out
+
+        if self.kv_dtype == "int8":
+            from avenir_tpu.ops.kv_quant import QuantKV
+
+            kd, ks, vd, vs = arrays
+            k_in = QuantKV(jnp.asarray(pad(kd)), jnp.asarray(pad(ks)))
+            v_in = QuantKV(jnp.asarray(pad(vd)), jnp.asarray(pad(vs)))
+        else:
+            k, v = arrays
+            k_in, v_in = jnp.asarray(pad(k)), jnp.asarray(pad(v))
+        self.pool = self._import_fn(self.pool, jnp.asarray(phys),
+                                    k_in, v_in)
+        return len(new)
+
+    def _finish_prefilled(self, slot):
+        """Prefill-class completion: the prompt's KV is computed and
+        every full page exported — finish with reason='prefilled'
+        (n_out=0; the ROUTER owns the handoff and the request's real
+        terminal record comes from the decode-class replica, so no
+        serve_requests bump and no terminal trace event here — exactly
+        one `finish` per fleet request is the trace lint's contract)."""
+        pg = self._paged
+        st = pg.prefill[slot]
+        req = st.req
+        pg.release(slot)      # pops prefill state, frees/caches pages
+        self.sched.release(slot)
+        # NO sink record either: kind='request' JSONL rows are
+        # one-per-terminal-request (obs_report counts them), and the
+        # terminal row comes from the decode-class replica
+        return FinishedRequest(
+            req_id=req.req_id, tokens=list(req.prompt),
+            n_prompt=len(req.prompt), n_out=0,
+            finish_reason="prefilled",
+            text="" if self.detokenize is not None else None,
+            ttft_ms=None, tpot_ms=0.0,
+        )
 
     def _stamp_admission_first_token(self, live, slot):
         """Spec decoding samples the request's FIRST token INSIDE the
@@ -1257,6 +1464,8 @@ class Engine:
         stay masked until overwritten (the slot-hygiene invariant)."""
         self._live.clear()
         self._pending = []
+        self._page_exports = []   # a revived replica's old exports are
+        #                           for requests that already failed over
         self.sched = FCFSScheduler(self.n_slots, self.T_max)
         if self._paged is not None:
             self._paged.reset()

@@ -27,10 +27,19 @@ and every failure mode is a DISTINCT, loud exception:
     FrameTimeout        no (complete) frame within the caller's per-op
                         budget: a silently wedged peer
 
-Payloads are JSON (`PT_JSON`, the control plane) or pickle
+Payloads are JSON (`PT_JSON`, the control plane), pickle
 (`PT_PICKLE`, the model-state handshake: config dataclass + numpy
 weight arrays — parent and worker run the same trusted codebase, and
-the handshake is the only pickle frame either side ever sends).
+the handshake is the only pickle frame either side ever sends), or the
+KV-page tensor form (`PT_KVPAGES`, ISSUE 13): a JSON meta header —
+op/seq plus per-record token-chain ids and per-array dtype/shape —
+followed by the raw page bytes (K/V page data, and the per-head int8
+scale sidecars when the fleet serves kv_dtype='int8'). This is the
+wire format disaggregated prefill ships finished KV pages over: the
+token chain IS the page identity (serve/pages.py's exact-prefix
+registration), so the receiving allocator can splice the pages into
+its own prefix chain and shared-prefix COW keeps working across the
+transfer boundary.
 
 Deliberately stdlib-only: the codec imports no jax, so the protocol
 unit tests (tests/test_serve_proc.py, tier-1) cost nothing, and a
@@ -52,6 +61,15 @@ MAGIC = b"AVFR"
 PROTO_VERSION = 1
 PT_JSON = 0
 PT_PICKLE = 1
+PT_KVPAGES = 2   # KV-page tensor payload (disaggregated prefill, ISSUE 13)
+
+# arrays per record on the PT_KVPAGES wire: bf16 ships (k, v); int8
+# ships (k_data, k_scale, v_data, v_scale). Every marshalling site —
+# worker, proxy, in-process replica — slices record arrays by this
+# count; one table so a new kv dtype cannot silently desync them.
+ARRAYS_PER_DTYPE = {"bf16": 2, "int8": 4}
+
+_KVMETA = struct.Struct(">I")  # meta-JSON byte length prefix
 
 _HEADER = struct.Struct(">4sBBII")  # magic, version, ptype, len, crc
 HEADER_SIZE = _HEADER.size
@@ -82,15 +100,88 @@ class FrameTimeout(FrameError):
     """No complete frame within the caller's per-op budget."""
 
 
+def _np_dtype(name):
+    """numpy dtype from its string name. bf16 lives in ml_dtypes (the
+    jax numpy extension); imported lazily so this module stays
+    stdlib-only for every frame that carries no tensors."""
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_kv_pages(meta, arrays):
+    """Serialize one KV-page payload: `meta` (a JSON-able dict — op,
+    seq, per-record token chains) + `arrays` (numpy arrays: page K/V
+    data and, for int8 KV, the per-head scale sidecars). Layout:
+
+        u32 meta length | meta JSON (with per-array dtype/shape
+        appended under "_arrays") | raw array bytes, C-order, in order
+
+    The token-chain ids ride in `meta` — they ARE the pages' identity
+    (the exact-prefix chain key), which is what lets the importing
+    allocator register the pages for shared-prefix reuse + COW."""
+    import numpy as np
+
+    meta = dict(meta)
+    meta["_arrays"] = [{"dtype": str(a.dtype), "shape": list(a.shape)}
+                       for a in arrays]
+    head = json.dumps(meta, separators=(",", ":")).encode("utf-8")
+    parts = [_KVMETA.pack(len(head)), head]
+    parts += [np.ascontiguousarray(a).tobytes() for a in arrays]
+    return b"".join(parts)
+
+
+def decode_kv_pages(payload):
+    """Inverse of encode_kv_pages: returns the meta dict with the
+    reconstructed numpy arrays under "arrays" (the "_arrays" shape
+    manifest is consumed)."""
+    import numpy as np
+
+    (head_len,) = _KVMETA.unpack_from(payload, 0)
+    off = _KVMETA.size
+    meta = json.loads(payload[off:off + head_len].decode("utf-8"))
+    off += head_len
+    arrays = []
+    for spec in meta.pop("_arrays"):
+        dt = _np_dtype(spec["dtype"])
+        shape = tuple(spec["shape"])
+        n = dt.itemsize * int(np.prod(shape)) if shape else dt.itemsize
+        if off + n > len(payload):
+            # a SHORT tear must land in the frame-error taxonomy too —
+            # a bare numpy ValueError would escape callers' FrameError
+            # classification
+            raise FrameProtocolError(
+                f"kv-page payload length mismatch: manifest wants "
+                f"{off + n} of {len(payload)} bytes — torn or desynced "
+                "tensor frame")
+        arrays.append(np.frombuffer(payload[off:off + n],
+                                    dtype=dt).reshape(shape))
+        off += n
+    if off != len(payload):
+        raise FrameProtocolError(
+            f"kv-page payload length mismatch: manifest consumed {off} "
+            f"of {len(payload)} bytes — torn or desynced tensor frame")
+    meta["arrays"] = arrays
+    return meta
+
+
 def encode_frame(obj, ptype=PT_JSON):
     """One wire-ready frame. The CRC covers the payload as SERIALIZED;
     the `frame_corrupt` fault site flips a payload byte AFTER the CRC
     is computed, so an armed injector produces exactly the torn frame
-    the reader's CRC check exists to catch."""
+    the reader's CRC check exists to catch. For PT_KVPAGES, `obj` is a
+    (meta, arrays) pair."""
     if ptype == PT_JSON:
         payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
     elif ptype == PT_PICKLE:
         payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    elif ptype == PT_KVPAGES:
+        payload = encode_kv_pages(*obj)
     else:
         raise ValueError(f"unknown payload type {ptype!r}")
     crc = zlib.crc32(payload) & 0xFFFFFFFF
@@ -131,6 +222,8 @@ def decode_payload(ptype, payload, crc):
         return json.loads(payload.decode("utf-8"))
     if ptype == PT_PICKLE:
         return pickle.loads(payload)
+    if ptype == PT_KVPAGES:
+        return decode_kv_pages(payload)
     raise FrameProtocolError(f"unknown payload type {ptype}")
 
 
@@ -139,9 +232,11 @@ class FrameStream:
 
     Reads are select()-driven with a wall-clock deadline shared across
     the header and payload of one frame — a peer that trickles half a
-    frame and wedges still trips FrameTimeout. After any FrameError the
-    stream's buffer can hold a partial frame; callers treat the peer as
-    dead (the fleet's policy) rather than resynchronize.
+    frame and wedges still trips FrameTimeout. A FrameTimeout is
+    RECOVERABLE: the buffer still holds a clean frame prefix (nothing
+    is consumed until a whole frame arrived), so a later read resumes
+    correctly. After a CRC/protocol error the stream is dead by policy;
+    callers never resynchronize.
     """
 
     def __init__(self, read_fd, write_fd):
@@ -161,15 +256,31 @@ class FrameStream:
 
     def read(self, timeout_s=None):
         """Read one frame; returns the decoded object. `timeout_s` is
-        the whole-frame budget (None = block forever)."""
+        the whole-frame budget (None = block forever).
+
+        Atomic over the buffer (ISSUE 13 satellite): nothing is CONSUMED
+        until header AND payload are both complete, so a FrameTimeout
+        mid-frame — the deadline landing between a partial header (or a
+        parsed header and a partial payload) — leaves `self._buf`
+        holding a clean frame PREFIX. A caller whose op layer retries
+        (the idempotent ping) then resumes at the right offset, instead
+        of the old behavior where the consumed header was gone and the
+        leftover payload bytes were parsed as a new header — a short
+        read surfacing as FrameProtocolError (garbage magic) or, on an
+        unlucky byte pattern, FrameCRCError: both UNRECOVERABLE where
+        the truth (a slow peer) was the recoverable FrameTimeout."""
         deadline = None if timeout_s is None \
             else time.monotonic() + timeout_s
-        header = self._read_exact(HEADER_SIZE, deadline)
-        ptype, length, crc = decode_header(header)
-        payload = self._read_exact(length, deadline)
+        self._fill(HEADER_SIZE, deadline)
+        ptype, length, crc = decode_header(
+            bytes(self._buf[:HEADER_SIZE]))  # peek — not yet consumed
+        self._fill(HEADER_SIZE + length, deadline)
+        payload = bytes(self._buf[HEADER_SIZE:HEADER_SIZE + length])
+        del self._buf[:HEADER_SIZE + length]  # whole frame, atomically
         return decode_payload(ptype, payload, crc)
 
-    def _read_exact(self, n, deadline):
+    def _fill(self, n, deadline):
+        """Grow the buffer to >= n bytes without consuming any."""
         while len(self._buf) < n:
             if deadline is not None:
                 wait = deadline - time.monotonic()
@@ -186,6 +297,3 @@ class FrameStream:
             if not chunk:
                 raise FrameEOF("peer closed the pipe")
             self._buf.extend(chunk)
-        out = bytes(self._buf[:n])
-        del self._buf[:n]
-        return out

@@ -132,6 +132,14 @@ class PageAllocator:
         self._chain = {}          # rid -> current chain node (registration)
         self.cow_copies = 0
         self.prefix_hits = 0      # requests that attached >= 1 page
+        # disaggregated transfer accounting (ISSUE 13 satellite):
+        # `_imported` tracks pages spliced in from ANOTHER allocator
+        # (a prefill-class replica's) while they stay registered, so
+        # COW activity on transferred chains is attributable
+        self._imported = set()
+        self.pages_exported = 0    # bumped by the engine's export path
+        self.pages_imported = 0
+        self.imported_cow_copies = 0
 
     # -- capacity --
 
@@ -151,6 +159,14 @@ class PageAllocator:
             "util": live / self.n_pages,
             "reserved": sum(self._reserved.values()),
             "cow_copies": self.cow_copies,
+            # transfer-oriented stats (ISSUE 13 satellite): page flow
+            # across the disaggregation boundary, plus how much COW
+            # activity landed on chains another allocator computed
+            "pages_exported": self.pages_exported,
+            "pages_imported": self.pages_imported,
+            "imported_live": sum(1 for p in self._imported
+                                 if self._ref.get(p, 0) > 0),
+            "imported_cow_copies": self.imported_cow_copies,
         }
 
     # -- prefix matching --
@@ -272,7 +288,84 @@ class PageAllocator:
         self._tables[rid][slot_idx] = PageRef(dst, owned=True)
         self._decref(src)
         self.cow_copies += 1
+        if src in self._imported:
+            # COW against a chain another allocator computed — the
+            # transfer boundary is invisible to the sharing machinery,
+            # which is the point; this counter proves it happened
+            self.imported_cow_copies += 1
         return (src, dst)
+
+    def import_chain(self, token_pages, n_prefix=0):
+        """Splice a chain of FULL prompt pages from ANOTHER allocator
+        (a prefill-class replica shipped them over frames, ISSUE 13)
+        into this allocator's prefix chain as CACHED (ref-0, registered,
+        LRU-evictable) nodes. `token_pages` is the chain identity —
+        page_size-token tuples in chain order FROM ROOT; exact-token
+        keying means a transferred page and a locally computed page of
+        the same tokens are literally the same chain node, so prefix
+        attach + COW work across the transfer boundary unchanged.
+
+        `n_prefix`: the first `n_prefix` entries are ANCHOR nodes — a
+        streamed transfer ships its chain in segments, and a segment's
+        pages are only meaningful UNDER the exact prefix that produced
+        them (KV content is position- and context-dependent). Anchors
+        must already exist in this chain; a missing anchor (the earlier
+        segment was evicted, or never landed) STOPS the import — an
+        unanchored segment registered at the wrong depth could falsely
+        match a different prompt's prefix, which would be a correctness
+        bug, not a cache miss.
+
+        Returns [(page, is_new), ...] — `is_new` False for anchors and
+        deduped nodes (a previous transfer, or local computation:
+        nothing to write). Pages come from the free list first, then
+        LRU eviction of cached nodes; when neither can yield a page
+        (everything live/reserved) the import STOPS and returns the
+        prefix it managed — a partial chain is still a valid prefix,
+        and the decode-side plan() just recomputes the missing tail
+        (exactness never depends on the import landing).
+
+        State accounting: free -> cached keeps `available()` unchanged
+        (cached pages are reclaimable), so outstanding reservations are
+        never endangered by an import."""
+        out = []
+        parent = ROOT
+        for i, toks in enumerate(token_pages):
+            toks = tuple(int(t) for t in toks)
+            assert len(toks) == self.page_size, (
+                f"import_chain page of {len(toks)} tokens != page_size "
+                f"{self.page_size} — only FULL pages have chain identity")
+            kids = self._children.setdefault(parent, {})
+            page = kids.get(toks)
+            if page is not None:
+                out.append((page, False))
+                parent = page
+                continue
+            if i < n_prefix:
+                return out  # anchor missing: segment unanchorable
+            if self._free:
+                page = self._free.pop(0)
+            elif self._evictable:
+                # reclaim the LRU cached node, then take the freed page
+                self._evict(next(iter(self._evictable)))
+                if (not self._free
+                        or (parent != ROOT and parent not in self._node)):
+                    # eviction freed nothing usable — or it reclaimed an
+                    # ancestor of the very chain being imported (a tiny
+                    # pool), deregistering our parent: registering under
+                    # a stale node could resurrect as a wrong-prefix
+                    # match once the id is reused. Stop (partial chain).
+                    break
+                page = self._free.pop(0)
+            else:
+                break  # pool fully live/reserved: partial chain stands
+            self._node[page] = (parent, toks)
+            kids[toks] = page
+            self._evictable[page] = None   # cached: ref 0, registered
+            self._imported.add(page)
+            self.pages_imported += 1
+            out.append((page, True))
+            parent = page
+        return out
 
     def register(self, rid, slot_idx, tokens):
         """Register table entry `slot_idx` — a page now fully covered
@@ -350,6 +443,7 @@ class PageAllocator:
         registration and free normally later)."""
         self._evictable.pop(page)
         parent, toks = self._node.pop(page)
+        self._imported.discard(page)   # no longer a transferred chain node
         self._children.get(parent, {}).pop(toks, None)
         for child in list(self._children.pop(page, {}).values()):
             self._deregister_subtree(child)
@@ -357,6 +451,7 @@ class PageAllocator:
 
     def _deregister_subtree(self, page):
         self._node.pop(page)
+        self._imported.discard(page)
         for child in list(self._children.pop(page, {}).values()):
             self._deregister_subtree(child)
         if page in self._evictable:
@@ -389,6 +484,18 @@ class PageAllocator:
         for page, (parent, toks) in self._node.items():
             assert self._children[parent][toks] == page, (
                 "prefix chain linkage broken")
+        # cross-allocator splice validity (ISSUE 13 satellite): every
+        # still-tracked imported page must be a REGISTERED chain node
+        # (cached or live via attach) — an imported page on the free
+        # list would mean the import path leaked identity, and a later
+        # reuse of that id could alias a wrong prefix
+        for page in self._imported:
+            assert page in self._node, (
+                f"imported page {page} lost its chain registration "
+                "without leaving the imported set")
+            assert page not in free, (
+                f"imported page {page} is simultaneously registered and "
+                "free — splice accounting broken")
         assert sum(self._reserved.values()) <= len(free) + len(cached), (
             "outstanding reservations exceed reclaimable pages")
         return self.stats()
@@ -567,6 +674,12 @@ class _PrefillState:
         self.n_prompt = len(req.prompt)
         self.next = plan.shared_len
         self.reg_upto = len(plan.shared_pages)
+        # disaggregated export progress (role='prefill' engines): next
+        # page slot to SHIP once fully covered by prompt tokens. Starts
+        # at 0, not shared_len — locally prefix-hit pages still ship
+        # (their content is exactly this prompt's KV, whoever computed
+        # it), so a prefill replica's warm cache accelerates transfers
+        self.exported_upto = 0
 
 
 class PagedHost:
@@ -577,9 +690,15 @@ class PagedHost:
     """
 
     def __init__(self, *, n_pages, page_size, n_slots, max_pages_per_seq,
-                 prefill_chunk, prefix_sharing=True, spec_pad=0):
+                 prefill_chunk, prefix_sharing=True, spec_pad=0,
+                 prefill_only=False):
         self.alloc = PageAllocator(n_pages, page_size,
                                    prefix_sharing=prefix_sharing)
+        # role='prefill' engines (ISSUE 13): admission reserves pages
+        # for the PROMPT only — the request never decodes here (its
+        # pages ship to a decode-class replica and free at handoff), so
+        # charging max_new would idle most of the prefill pool
+        self.prefill_only = bool(prefill_only)
         self.page_size = int(page_size)
         self.n_slots = int(n_slots)
         self.max_pages_per_seq = int(max_pages_per_seq)
@@ -604,8 +723,9 @@ class PagedHost:
         """The scheduler's token-budget admission check (FCFS: a False
         return blocks the queue head). True COMMITS allocator state —
         the scheduler hands the request a slot in the same call."""
-        plan = self.alloc.admit(req.req_id, req.prompt,
-                                req.max_new_tokens + self.spec_pad)
+        max_new = 0 if self.prefill_only \
+            else req.max_new_tokens + self.spec_pad
+        plan = self.alloc.admit(req.req_id, req.prompt, max_new)
         if plan is None:
             return False
         self._plans[req.req_id] = plan

@@ -95,6 +95,10 @@ OP_TIMEOUT_S = {
     "ping": 10.0,
     "arm_fault": 10.0,
     "shutdown": 10.0,
+    # disagg page transfer (ISSUE 13): tensor frames move page KV —
+    # megabytes, not a control message — so they get the submit budget
+    "fetch_pages": 60.0,
+    "import_pages": 60.0,
 }
 IDEMPOTENT_OPS = frozenset({"ping"})
 
@@ -144,6 +148,7 @@ class _EngineProxy:
         self.max_total_tokens = None   # effective submit limit (ISSUE 9)
         self.limit_name = "max_seq_len"
         self.kv_impl = "slab"
+        self.role = "both"         # disagg replica class (ISSUE 13)
         self.n_slots = 0
         self.sched = _SchedView()
         self._live = {}            # engine rid -> tokens emitted so far
@@ -215,6 +220,7 @@ class ProcReplica(ReplicaHealth):
             self._ekw["trace"] = int(trace)
         self._trace_pending = []   # restamped, engine-rid keyed
         self._trace_dropped = 0
+        self._export_pending = []  # fetched page-export records (disagg)
         self._reg = registry if registry is not None else get_registry()
         self.sink = sink if sink is not None else NullSink()
         self.rpc_slack_secs = float(rpc_slack_secs)
@@ -296,6 +302,7 @@ class ProcReplica(ReplicaHealth):
             reply.get("limit_tokens", reply["t_max"]))
         self.engine.limit_name = reply.get("limit_name", "max_seq_len")
         self.engine.kv_impl = reply.get("kv_impl", "slab")
+        self.engine.role = reply.get("role", "both")
         self.engine.n_slots = int(reply["n_slots"])
         self.engine.sched.free_slots = int(reply["n_slots"])
         # compile pre-warm (ISSUE 12): when the hello's engine kwargs
@@ -348,6 +355,8 @@ class ProcReplica(ReplicaHealth):
         self._submit_t = {}
         self._t_first = {}
         self._deadline = {}
+        self._export_pending = []  # the corpse's in-flight transfers
+        #                            fail over with their requests
 
     def close(self):
         """Graceful shutdown (drained replica, end of run)."""
@@ -390,18 +399,25 @@ class ProcReplica(ReplicaHealth):
 
     # -- RPC --
 
-    def _rpc(self, msg, *, timeout_s, ptype=0):
+    def _rpc(self, msg, *, timeout_s, ptype=0, arrays=None):
         """One request/reply exchange. Every request carries a sequence
         number the worker echoes; `_read_reply` discards stale replies
         (the late answer to an op a retry already gave up on — without
         this, one retried ping would shift request/reply alignment for
         every RPC after it). Heartbeat bookkeeping rides every reply;
-        callers map FrameError/WorkerOpError to death."""
+        callers map FrameError/WorkerOpError to death. `arrays` (numpy
+        list) turns the request into a PT_KVPAGES tensor frame — the
+        page-transfer wire form (ISSUE 13)."""
         if self._stream is None:
             raise ReplicaGone(f"replica {self.replica_id} has no worker")
         self._seq += 1
         msg["seq"] = self._seq
-        self._stream.write(msg, ptype=ptype)
+        if arrays is not None:
+            from avenir_tpu.serve.frames import PT_KVPAGES
+
+            self._stream.write((msg, arrays), ptype=PT_KVPAGES)
+        else:
+            self._stream.write(msg, ptype=ptype)
         reply = self._read_reply(timeout_s=timeout_s)
         if not reply.get("ok"):
             raise WorkerOpError(reply.get("error", "worker error"))
@@ -430,6 +446,78 @@ class ProcReplica(ReplicaHealth):
         out, self._trace_pending = self._trace_pending, []
         dropped, self._trace_dropped = self._trace_dropped, 0
         return out, dropped
+
+    # -- disaggregated page transfer (ISSUE 13) --
+
+    @property
+    def role(self):
+        return self.engine.role
+
+    def take_page_exports(self):
+        """Drain export records fetched from the worker (step() pulls a
+        PT_KVPAGES frame whenever a step reply advertises exports)."""
+        out, self._export_pending = self._export_pending, []
+        return out
+
+    def _fetch_exports(self):
+        """Pull the worker's queued page exports as one tensor frame
+        and stage them for the router's transfer pump. Failure here is
+        replica death like any other RPC failure — the requests whose
+        pages were in flight fail over and re-prefill elsewhere."""
+        from avenir_tpu.serve.frames import ARRAYS_PER_DTYPE
+
+        try:
+            reply = self._rpc({"op": "fetch_pages"},
+                              timeout_s=OP_TIMEOUT_S["fetch_pages"])
+        except FrameTimeout as e:
+            self._die(e, counter="rpc_timeouts")
+            return
+        except FrameCRCError as e:
+            self._die(e, counter="frame_crc_errors")
+            return
+        except (FrameError, WorkerOpError, OSError, ValueError) as e:
+            self._die(e)
+            return
+        arrays = reply.get("arrays") or []
+        off = 0
+        for rec in reply.get("records", ()):
+            n = ARRAYS_PER_DTYPE[rec["kv_dtype"]]
+            self._export_pending.append({
+                "eng_rid": int(rec["eng_rid"]),
+                "tokens": rec["tokens"],
+                "n_prefix": int(rec.get("n_prefix", 0)),
+                "kv_dtype": rec["kv_dtype"],
+                "arrays": arrays[off:off + n],
+            })
+            off += n
+
+    def import_pages(self, records):
+        """Ship exported page records INTO this worker over one
+        PT_KVPAGES frame. Returns (pages written, payload bytes).
+        Non-idempotent is fine here (a re-import dedupes on the chain
+        key), but a failed transfer means a dead pipe — same death
+        mapping as submit, and the router re-targets the handoff."""
+        meta = {"op": "import_pages",
+                "records": [{"eng_rid": r["eng_rid"],
+                             "tokens": r["tokens"],
+                             "n_prefix": r.get("n_prefix", 0),
+                             "kv_dtype": r["kv_dtype"]}
+                            for r in records]}
+        flat = [a for r in records for a in r["arrays"]]
+        nbytes = sum(a.nbytes for a in flat)   # tensor bytes on the wire
+        try:
+            reply = self._rpc(meta, arrays=flat,
+                              timeout_s=OP_TIMEOUT_S["import_pages"])
+        except FrameTimeout as e:
+            self._die(e, counter="rpc_timeouts")
+            raise ReplicaGone(str(e)) from e
+        except FrameCRCError as e:
+            self._die(e, counter="frame_crc_errors")
+            raise ReplicaGone(str(e)) from e
+        except (FrameError, WorkerOpError, OSError, ValueError) as e:
+            self._die(e)
+            raise ReplicaGone(str(e)) from e
+        return int(reply.get("written", 0)), nbytes
 
     def _read_reply(self, *, timeout_s):
         """Read until the reply matching the current seq (bounded):
@@ -463,7 +551,7 @@ class ProcReplica(ReplicaHealth):
 
     def _submit_rpc(self, prompt, *, max_new_tokens, temperature=1.0,
                     top_k=None, stop_tokens=(), rng=None,
-                    deadline_ms=None, submit_t=None):
+                    deadline_ms=None, submit_t=None, front=False):
         """The proxy's Engine.submit: ships the request (rng as raw key
         data, submit_t as an AGE — worker clocks are unrelated). The
         deadline is NOT shipped: deadline semantics belong to the
@@ -486,6 +574,7 @@ class ProcReplica(ReplicaHealth):
             "rng": None if rng is None else
                    np.asarray(jax.random.key_data(rng)).tolist(),
             "age_ms": max(0.0, (now - st) * 1e3),
+            "front": bool(front),
         }
         try:
             reply = self._rpc(msg, timeout_s=OP_TIMEOUT_S["submit"])
@@ -592,6 +681,13 @@ class ProcReplica(ReplicaHealth):
             self._n_busy_steps += 1
             if self._grace_steps > 0:
                 self._grace_steps -= 1
+        if reply.get("n_exports"):
+            # pull the advertised page exports NOW (one tensor frame),
+            # so the router's transfer pump sees them this very step —
+            # the stream-while-prefilling overlap (ISSUE 13)
+            self._fetch_exports()
+            if self.state == DEAD:
+                return []
         for rid in reply.get("first", ()):
             self._t_first[int(rid)] = now
         return [self._harvest_finished(d, now)
@@ -626,6 +722,11 @@ class ProcReplica(ReplicaHealth):
                      if f.n_out > 1 and t_first is not None else 0.0)
         if f.n_out > 1:
             self._reg.hist("tpot_ms").observe(f.tpot_ms)
+        if f.finish_reason == "prefilled":
+            # internal handoff marker, NOT a terminal (ISSUE 13): the
+            # decode replica writes the one kind='request' row — same
+            # policy as Engine._finish_prefilled on the inproc backend
+            return f
         record = {
             "kind": "request", "t": time.time(), "id": rid,
             "n_prompt": f.n_prompt, "n_out": f.n_out,
@@ -663,6 +764,7 @@ class ProcReplica(ReplicaHealth):
             self._submit_t = {}
             self._t_first = {}
             self._deadline = {}
+            self._export_pending = []
             self._durs = []
             self._n_busy_steps = 0
             self._seen_buckets = set()  # a fresh process compiles anew

@@ -187,6 +187,47 @@ class Replica(ReplicaHealth):
         dropped, self._trace_buf.dropped = self._trace_buf.dropped, 0
         return self._trace_buf.drain(), dropped
 
+    # -- disaggregated page transfer (ISSUE 13) --
+
+    @property
+    def role(self):
+        return getattr(self.engine, "role", "both")
+
+    def take_page_exports(self):
+        """Drain finished-page export records (role='prefill')."""
+        if self.state == DEAD:
+            return []
+        return self.engine.take_page_exports()
+
+    def import_pages(self, records):
+        """Splice exported page records into this replica's engine.
+        In-process transfers still ROUND-TRIP the PT_KVPAGES frame
+        codec — the wire format is the contract both backends share, so
+        the inproc fleet (and its benches) exercises — and pays for —
+        exactly the serialization the process fleet ships, not a
+        zero-cost shortcut. Returns (pages written, payload bytes)."""
+        from avenir_tpu.serve.frames import ARRAYS_PER_DTYPE, \
+            decode_kv_pages, encode_kv_pages
+
+        meta = {"records": [{"eng_rid": r["eng_rid"],
+                             "tokens": r["tokens"],
+                             "n_prefix": r.get("n_prefix", 0),
+                             "kv_dtype": r["kv_dtype"]}
+                            for r in records]}
+        flat = [a for r in records for a in r["arrays"]]
+        payload = encode_kv_pages(meta, flat)
+        decoded = decode_kv_pages(payload)
+        written = 0
+        off = 0
+        for rec in decoded["records"]:
+            n = ARRAYS_PER_DTYPE[rec["kv_dtype"]]
+            written += self.engine.import_kv_pages(
+                rec["tokens"], decoded["arrays"][off:off + n],
+                kv_dtype=rec["kv_dtype"],
+                n_prefix=int(rec.get("n_prefix", 0)))
+            off += n
+        return written, len(payload)
+
     # -- capacity surface the router routes on --
 
     @property

@@ -123,7 +123,8 @@ class Router:
                  stall_floor_secs=10.0, stall_factor=10.0,
                  backend="inproc", model_spec=None, supervise=False,
                  respawn_policy=None, max_respawns=5, proc_kwargs=None,
-                 engine_kwargs=None, tracer=None, draft_model=None):
+                 engine_kwargs=None, tracer=None, draft_model=None,
+                 n_prefill=0, disagg_min_prompt=None):
         """`weights`: dispatch shares per priority class (default
         interactive 4 : batch 1). `queue_limits`: max queued per class
         before shedding (default 16/64 x fleet slots). `clock` is shared
@@ -156,6 +157,25 @@ class Router:
         instead) — the router itself needs ZERO semantic changes for
         spec decoding, engines just finish more tokens per step.
 
+        `n_prefill` (ISSUE 13, disaggregated prefill/decode): the first
+        `n_prefill` of `n_replicas` become PREFILL-CLASS replicas
+        (Engine role='prefill'), the rest decode-class. Long prompts
+        (>= `disagg_min_prompt`, default the engine's prefill_chunk)
+        dispatch to the prefill class, which chunk-prefills and streams
+        each finished KV page over PT_KVPAGES frames to a pinned
+        least-loaded decode replica WHILE the remaining chunks compute;
+        at 'prefilled' the router hands the request off — the decode
+        replica's admission prefix-attaches the imported pages and only
+        computes the sub-page tail, so one long prompt never steals a
+        decode tick fleet-wide and the two classes scale independently
+        (prefill is compute-bound, decode bandwidth-bound). Short
+        prompts skip the handoff and dispatch straight to the decode
+        class. Failover stays bit-exact: a request whose prefill OR
+        decode replica dies mid-transfer falls back to the ordinary
+        requeue + re-prefill-from-prompt+rng path, and the per-request
+        parity oracle (generate_cached equality) covers every path.
+        Requires engine_kwargs kv_impl='paged' with prefix sharing on.
+
         `tracer` (ISSUE 10): an obs/trace.py Tracer — the fleet flight
         recorder. The router emits the fleet-level lifecycle events
         (submit/admit/dispatch/failover/requeue/terminal refusals) and
@@ -186,6 +206,28 @@ class Router:
         self._pk = {}
         self._retiring = set()   # replica_ids draining toward removal
         self._next_replica_id = n_replicas
+        # disaggregated prefill/decode (ISSUE 13)
+        self.n_prefill = int(n_prefill)
+        self._role = {}          # replica_id -> 'prefill' | 'both'
+        self._transfer = {}      # rid -> in-flight page-transfer state
+        if self.n_prefill:
+            assert 0 < self.n_prefill < n_replicas, (
+                "disaggregation needs at least one replica of EACH "
+                f"class (n_prefill={n_prefill} of {n_replicas})")
+            assert self._engine_kwargs.get("kv_impl") == "paged", (
+                "disaggregation ships KV PAGES between replica classes "
+                "— pass engine_kwargs={'kv_impl': 'paged', ...}")
+            assert self._engine_kwargs.get("prefix_sharing", True), (
+                "disaggregation splices transferred pages through the "
+                "prefix chain — prefix_sharing must stay on")
+            assert self._engine_kwargs.get("spec_decode", "off") in (
+                None, "off"), (
+                "spec_decode is incompatible with disaggregation (the "
+                "draft slab cannot ride a page transfer)")
+        self.disagg_min_prompt = (
+            int(disagg_min_prompt) if disagg_min_prompt is not None
+            else int(self._engine_kwargs.get("prefill_chunk")
+                     or 4 * int(self._engine_kwargs.get("page_size", 16))))
         if backend == "process":
             from avenir_tpu.serve.proc import (
                 RespawnSupervisor,
@@ -198,7 +240,9 @@ class Router:
             if draft_model is not None and "draft_spec" not in self._pk:
                 self._pk["draft_spec"] = model_spec_from_model(draft_model)
             self.replicas = [
-                self._make_replica(i, defer_handshake=True)
+                self._make_replica(
+                    i, role=("prefill" if i < self.n_prefill else "both"),
+                    defer_handshake=True)
                 for i in range(n_replicas)
             ]
             for r in self.replicas:  # workers warmed up concurrently
@@ -213,8 +257,10 @@ class Router:
                 "supervised respawn is the process backend's restart "
                 "story; in-process replicas are revived explicitly "
                 "(revive_replica)")
-            self.replicas = [self._make_replica(i)
-                             for i in range(n_replicas)]
+            self.replicas = [
+                self._make_replica(
+                    i, role=("prefill" if i < self.n_prefill else "both"))
+                for i in range(n_replicas)]
         eng0 = self.replicas[0].engine
         self.T_max = eng0.T_max
         # budget-aware admission limit (ISSUE 9): under paged KV the
@@ -253,13 +299,20 @@ class Router:
 
     # ---- replica construction (ctor + autoscaler growth) ----
 
-    def _make_replica(self, i, *, prewarm=False, defer_handshake=False):
+    def _make_replica(self, i, *, role="both", prewarm=False,
+                      defer_handshake=False):
         """Build one replica from the retained recipe. `prewarm` rides
         the engine kwargs: the engine (worker hello, for the process
         backend) runs one synthetic prefill + decode tick per bucket
         BEFORE the replica is dispatchable, so a fresh replica never
-        serves its first compile to a user (Engine.prewarm)."""
+        serves its first compile to a user (Engine.prewarm). `role`
+        (ISSUE 13): 'prefill' builds a prefill-class replica — the knob
+        rides the engine kwargs like every other per-engine choice, so
+        the process backend's hello carries it unchanged."""
         ekw = dict(self._engine_kwargs)
+        self._role[i] = role
+        if role == "prefill":
+            ekw["role"] = "prefill"
         if prewarm:
             ekw["prewarm"] = True
         trace = (self.tracer.decode_sample
@@ -287,14 +340,29 @@ class Router:
         return sum(r.state != DEAD and r.replica_id not in self._retiring
                    for r in self.replicas)
 
-    def add_replica(self, *, prewarm=False):
+    def fleet_size_by_class(self):
+        """Serving replicas per disagg class — the per-class autoscaler
+        surface (ISSUE 13 satellite). Homogeneous fleets report
+        everything under 'decode'."""
+        out = {"prefill": 0, "decode": 0}
+        for r in self.replicas:
+            if r.state == DEAD or r.replica_id in self._retiring:
+                continue
+            cls = ("prefill"
+                   if self._role.get(r.replica_id) == "prefill"
+                   else "decode")
+            out[cls] += 1
+        return out
+
+    def add_replica(self, *, prewarm=False, role="both"):
         """Grow the fleet by one replica (blocking: a process-backend
         spawn pays its jax import, handshake, and — with `prewarm` —
-        its compile pre-warm before returning). Returns the replica."""
+        its compile pre-warm before returning). Returns the replica.
+        `role='prefill'` grows the prefill class (disagg fleets)."""
         return self.finish_add_replica(
-            self.begin_add_replica(prewarm=prewarm))
+            self.begin_add_replica(prewarm=prewarm, role=role))
 
-    def begin_add_replica(self, *, prewarm=False):
+    def begin_add_replica(self, *, prewarm=False, role="both"):
         """Start building the next replica on a BACKGROUND thread and
         return a handle: the fleet keeps serving while the newcomer
         pays its spawn + compile pre-warm (seconds), and
@@ -307,10 +375,14 @@ class Router:
         i = self._next_replica_id
         self._next_replica_id += 1
         h = _SpawnHandle(i)
+        # record the role NOW (main thread): dispatch/placement must
+        # never observe a joined replica with an unknown class
+        self._role[i] = role
 
         def build():
             try:
-                h.result = self._make_replica(i, prewarm=prewarm)
+                h.result = self._make_replica(i, role=role,
+                                              prewarm=prewarm)
             except BaseException as e:  # noqa: BLE001 — surfaced at join
                 h.error = e
 
@@ -327,6 +399,11 @@ class Router:
         but no fleet state changed."""
         handle.thread.join()
         if handle.error is not None:
+            # a failed spawn must not leave a phantom class entry —
+            # a stale 'prefill' value would keep disagg routing (and
+            # the autoscaler's _disagg()) alive on a fleet that has no
+            # prefill replica
+            self._role.pop(handle.replica_id, None)
             raise handle.error
         rep = handle.result
         self.replicas.append(rep)
@@ -361,6 +438,7 @@ class Router:
                     "retiring an idle replica left mapped work behind")
                 self._retiring.discard(rep.replica_id)
                 self._by_replica.pop(rep.replica_id)
+                self._role.pop(rep.replica_id, None)
                 self.replicas.remove(rep)
                 if hasattr(rep, "close"):
                     rep.close()
@@ -474,8 +552,16 @@ class Router:
                     self.tracer.absorb(
                         evs, rid_map=self._by_replica[rep.replica_id],
                         replica=rep.replica_id, dropped=dropped)
+            if self._is_prefill(rep) and rep.state != DEAD:
+                # stream finished pages to the decode class NOW, while
+                # the prefill replica's remaining chunks still compute
+                # — the overlap that hides handoff latency (ISSUE 13)
+                self._pump_exports(rep)
             for f in fins:
-                finished.append(self._harvest(rep, f))
+                if f.finish_reason == "prefilled":
+                    self._handoff(rep, f, finished)
+                else:
+                    finished.append(self._harvest(rep, f))
             dt = self._clock() - t_before
             # credit every OTHER live replica the ANOMALOUS part of the
             # time this step consumed: the fleet loop is single-threaded,
@@ -748,14 +834,39 @@ class Router:
         self._wrr[pick] -= sum(self.weights[c] for c in live)
         return pick
 
+    def _is_prefill(self, rep):
+        return self._role.get(rep.replica_id) == "prefill"
+
+    def _healthy_class(self, prefill):
+        return [r for r in self.replicas
+                if r.state == HEALTHY
+                and r.replica_id not in self._retiring
+                and self._is_prefill(r) == prefill]
+
     def _pick_replica(self, req, now):
         """SLO-aware placement: free-slot fraction, minus any engine
         queue backlog, minus — for deadline-carrying requests — the
         replica's step time scaled by the inverse of the remaining
         slack (a tight deadline prefers the fastest replica; an
         unhurried one just fills the emptiest). Deterministic tiebreak
-        on replica id."""
+        on replica id.
+
+        Disagg (ISSUE 13): prompt length routes the CLASS — a long
+        prompt (>= disagg_min_prompt, i.e. more than one chunk of
+        prefill) goes to the prefill class when one is healthy AND a
+        decode replica exists to hand off to; everything else (short
+        prompts, a degraded prefill class) dispatches to the decode
+        class, whose replicas serve the full lifecycle. Queue depth
+        then picks WITHIN the class via the dispatchable-fraction
+        score, same as ever."""
         cands = [r for r in self.replicas if r.dispatchable_slots > 0]
+        if self.n_prefill or any(v == "prefill"
+                                 for v in self._role.values()):
+            long = len(req.prompt) >= self.disagg_min_prompt
+            use_prefill = (long and self._healthy_class(True)
+                           and self._healthy_class(False))
+            cands = [r for r in cands
+                     if self._is_prefill(r) == bool(use_prefill)]
         if not cands:
             return None
         slack_s = None
@@ -781,6 +892,14 @@ class Router:
                 return
             req = self._queues[c].popleft()
             rep = self._pick_replica(req, now)
+            if rep is None:
+                # free slots exist only on the wrong disagg class this
+                # tick (e.g. decode slots open while the head wants the
+                # prefill class) — FCFS holds: put the head back and
+                # stop, same documented policy as the engine's
+                # too-long-head admission block
+                self._queues[c].appendleft(req)
+                return
             try:
                 eng_rid = rep.engine.submit(
                     req.prompt, max_new_tokens=req.max_new_tokens,
@@ -813,11 +932,165 @@ class Router:
                                  eng_rid=eng_rid,
                                  failovers=req.failovers)
 
+    # ---- disaggregated page transfer + handoff (ISSUE 13) ----
+
+    def _pick_decode_target(self):
+        """Least-loaded healthy decode replica — the handoff target.
+        Dispatchable fraction first (it nets out the engine backlog),
+        then live count, then id (deterministic)."""
+        cands = self._healthy_class(False)
+        if not cands:
+            return None
+        return max(cands, key=lambda r: (
+            r.dispatchable_slots / max(r.n_slots, 1),
+            -len(r.engine._live), -r.replica_id))
+
+    def _pump_exports(self, rep):
+        """Drain a prefill replica's finished-page exports and stream
+        each to the request's pinned decode target (pinned at first
+        export so the whole chain accumulates on one replica). Records
+        are RETAINED until handoff completes: if the pinned target dies
+        mid-transfer, the next export (or the handoff itself) re-pins
+        and re-ships the full accumulation — the pages are host-side
+        numpy, so a dead importer costs a re-send, never a recompute."""
+        for rec in rep.take_page_exports():
+            rid = self._by_replica[rep.replica_id].get(rec["eng_rid"])
+            if rid is None or rid not in self._open:
+                continue  # already failed over/expired: transfer moot
+            tr = self._transfer.setdefault(
+                rid, {"recs": [], "target": None, "shipped": 0,
+                      "bytes": 0, "src": rep.replica_id})
+            tr["src"] = rep.replica_id
+            tr["recs"].append(rec)
+            self._ship(rid, tr)
+
+    def _ship(self, rid, tr):
+        """Ship `tr`'s unshipped records to its (re)pinned target.
+        Returns the target replica, or None when no healthy decode
+        replica exists right now (the handoff will retry)."""
+        tgt = None
+        if tr["target"] is not None:
+            for r in self._healthy_class(False):
+                if r.replica_id == tr["target"]:
+                    tgt = r
+                    break
+        if tgt is None:
+            tgt = self._pick_decode_target()
+            if tgt is None:
+                tr["target"] = None
+                tr["shipped"] = 0
+                return None
+            if tr["target"] is not None \
+                    and tr["target"] != tgt.replica_id:
+                tr["shipped"] = 0  # new importer: re-ship the chain
+            tr["target"] = tgt.replica_id
+        recs = tr["recs"][tr["shipped"]:]
+        if not recs:
+            return tgt
+        try:
+            written, nbytes = tgt.import_pages(recs)
+        except ReplicaGone:
+            self._failover(tgt)
+            tr["target"] = None
+            tr["shipped"] = 0
+            return None
+        tr["shipped"] = len(tr["recs"])
+        tr["bytes"] += nbytes
+        self._reg.counter("kv_transfer_bytes").add(nbytes)
+        if self.tracer is not None:
+            self.tracer.emit(
+                rid, "kv_transfer", t=self._clock(),
+                pages=sum(len(r["tokens"]) - r.get("n_prefix", 0)
+                          for r in recs),
+                written=written, bytes=nbytes, src=tr["src"],
+                dst=tgt.replica_id)
+        return tgt
+
+    def _handoff(self, rep, f, finished):
+        """A prefill-class replica finished a prompt: ship any last
+        pages, then submit the request — original prompt, rng, submit_t
+        and deadline — to the decode target, front-of-engine-queue (it
+        served its fleet FCFS wait already). The decode admission
+        prefix-attaches the imported chain and computes only the tail,
+        so the output is bit-identical to a full local prefill. With no
+        healthy decode replica the request requeues at the front of its
+        class and retries the whole path later (correct, just slower —
+        its next prefill prefix-hits the prefill replica's warm chain).
+        """
+        rid = self._by_replica[rep.replica_id].pop(f.req_id, None)
+        if rid is None:
+            return
+        req = self._open.get(rid)
+        if req is None:
+            self._transfer.pop(rid, None)
+            return
+        self._where.pop(rid, None)
+        now = self._clock()
+        tr = self._transfer.pop(rid, {"recs": [], "target": None,
+                                      "shipped": 0, "bytes": 0,
+                                      "src": rep.replica_id})
+        if req.expired(now):
+            # the deadline died during prefill+transfer: account it,
+            # free the accumulated pages, never burn a decode slot
+            finished.append(self._finish_router_timeout(req))
+            return
+        if self.tracer is not None:
+            # the handoff marker OPENS the `transfer` TTFT segment: the
+            # non-overlapped remainder of the transfer (final ship +
+            # handoff submit) runs between this stamp and the decode
+            # dispatch stamp below — streamed pages already hid behind
+            # prefill compute and cost the request nothing here
+            self.tracer.emit(
+                rid, "kv_transfer", t=now, handoff=True,
+                pages=sum(len(r["tokens"]) - r.get("n_prefix", 0)
+                          for r in tr["recs"]),
+                bytes=tr["bytes"], src=rep.replica_id,
+                dst=tr["target"])
+        tgt = self._ship(rid, tr)
+        if tgt is None:
+            req.dispatch_t = None
+            self._queues[req.priority].appendleft(req)
+            if self.tracer is not None:
+                self.tracer.emit(rid, "requeue", t=now,
+                                 failovers=req.failovers,
+                                 handoff_retry=True)
+            return
+        self._reg.counter("kv_transfers").add(1)
+        try:
+            eng_rid = tgt.engine.submit(
+                req.prompt, max_new_tokens=req.max_new_tokens,
+                temperature=req.temperature, top_k=req.top_k,
+                stop_tokens=req.stop_tokens, rng=req.rng,
+                deadline_ms=req.deadline_ms, submit_t=req.submit_t,
+                front=True,
+            )
+        except ReplicaGone:
+            self._failover(tgt)
+            req.dispatch_t = None
+            self._queues[req.priority].appendleft(req)
+            if self.tracer is not None:
+                self.tracer.emit(rid, "requeue", t=now,
+                                 failovers=req.failovers,
+                                 handoff_retry=True)
+            return
+        req.dispatch_t = self._clock()
+        self._where[rid] = tgt.replica_id
+        self._by_replica[tgt.replica_id][eng_rid] = rid
+        if self.tracer is not None:
+            self.tracer.emit(req.rid, "dispatch", t=req.dispatch_t,
+                             replica=tgt.replica_id, eng_rid=eng_rid,
+                             failovers=req.failovers, handoff=True)
+
     def _harvest(self, rep, f):
         """Map an engine FinishedRequest back to its router identity."""
         rid = self._by_replica[rep.replica_id].pop(f.req_id)
         req = self._open.pop(rid)
         self._where.pop(rid, None)
+        # a terminal WITHOUT a handoff (e.g. deadline eviction on the
+        # prefill class after pages exported) must still free the
+        # retained transfer records — only _handoff/_failover otherwise
+        # touch them, and they hold host-side page tensors
+        self._transfer.pop(rid, None)
         if req.dispatch_t is not None:
             self._holds.append(self._clock() - req.dispatch_t)
             if len(self._holds) > 64:
@@ -849,11 +1122,25 @@ class Router:
             # recorder exists for: dump the ring (no-op without an
             # out_dir), whether or not the corpse held work
             self.tracer.flight_dump(f"replica{rep.replica_id}-death")
+        # disagg (ISSUE 13): transfers PINNED to this corpse lose their
+        # imported pages with it — unpin so the next ship re-targets
+        # and re-sends the retained records (host-side numpy, no
+        # recompute); transfers FROM this corpse die with their
+        # requests' failed-over attempts just below
+        for tr in self._transfer.values():
+            if tr.get("target") == rep.replica_id:
+                tr["target"] = None
+                tr["shipped"] = 0
         assigned = self._by_replica[rep.replica_id]
         if not assigned:
             return
         reqs = sorted((self._open[rid] for rid in assigned.values()),
                       key=lambda r: (r.submit_t, r.rid))
+        for rid in assigned.values():
+            # a dead PREFILL replica's accumulated exports are the dead
+            # attempt's work product: discard — the requeued request
+            # re-prefills from prompt+rng and re-exports, bit-identical
+            self._transfer.pop(rid, None)
         assigned.clear()
         now = self._clock()
         for req in reversed(reqs):

@@ -72,6 +72,15 @@ class FCFSScheduler:
     def enqueue(self, req: Request):
         self._queue.append(req)
 
+    def enqueue_front(self, req: Request):
+        """Head-of-queue enqueue (ISSUE 13): a request handed off from a
+        prefill-class replica already waited its FCFS turn fleet-wide —
+        its KV pages are imported and it only needs the tail chunk, so
+        admitting it behind freshly dispatched work would re-impose a
+        queue it already served. Fleet arrival order is preserved, just
+        measured at the front door instead of per engine."""
+        self._queue.appendleft(req)
+
     @property
     def queue_depth(self):
         return len(self._queue)

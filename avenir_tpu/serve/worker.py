@@ -125,7 +125,7 @@ def _serve(stream):
     kv_kw = {k: ekw[k] for k in
              ("kv_impl", "page_size", "n_pages", "max_pages_per_seq",
               "prefill_chunk", "prefix_sharing", "paged_attn_impl",
-              "kv_dtype", "spec_decode", "spec_k")
+              "kv_dtype", "spec_decode", "spec_k", "role")
              if ekw.get(k) is not None}
     # request tracing (ISSUE 10): the parent's hello flips this flag;
     # the engine collects lifecycle events in a bounded buffer and every
@@ -190,6 +190,7 @@ def _serve(stream):
                   "kv_impl": engine.kv_impl,
                   "kv_dtype": engine.kv_dtype,
                   "spec_decode": engine.spec_decode,
+                  "role": engine.role,
                   "prewarm_ticks": prewarm_ticks,
                   "pid": os.getpid()})
 
@@ -239,6 +240,10 @@ def _serve(stream):
                     "first": first,
                     "hb": hb(),
                     "counters": reg.counters(),
+                    # disagg (ISSUE 13): queued page exports stay here
+                    # (tensors never ride a JSON reply) — the parent
+                    # sees the count and fetches a PT_KVPAGES frame
+                    "n_exports": len(engine._page_exports),
                     **drain_trace(),
                 })
             elif op == "submit":
@@ -258,9 +263,42 @@ def _serve(stream):
                     rng=rng,
                     deadline_ms=req.get("deadline_ms"),
                     submit_t=submit_t,
+                    front=bool(req.get("front")),
                 )
                 send({"ok": True, "rid": int(rid), "hb": hb(),
                       "counters": reg.counters(), **drain_trace()})
+            elif op == "fetch_pages":
+                # drain queued exports into ONE PT_KVPAGES tensor frame
+                # (ISSUE 13): meta carries the token-chain ids per
+                # record, arrays carry the raw page KV (+ int8 scales)
+                from avenir_tpu.serve.frames import PT_KVPAGES
+
+                recs = engine.take_page_exports()
+                meta = {"ok": True, "seq": seq,
+                        "records": [{"eng_rid": r["eng_rid"],
+                                     "tokens": r["tokens"],
+                                     "n_prefix": r.get("n_prefix", 0),
+                                     "kv_dtype": r["kv_dtype"]}
+                                    for r in recs]}
+                flat = [a for r in recs for a in r["arrays"]]
+                stream.write((meta, flat), ptype=PT_KVPAGES)
+            elif op == "import_pages":
+                # inbound PT_KVPAGES frame: splice the chains into the
+                # local allocator + pool (decode-class side)
+                from avenir_tpu.serve.frames import ARRAYS_PER_DTYPE
+
+                arrays = req["arrays"]
+                written = 0
+                off = 0
+                for rec in req.get("records", ()):
+                    n = ARRAYS_PER_DTYPE[rec["kv_dtype"]]
+                    written += engine.import_kv_pages(
+                        rec["tokens"], arrays[off:off + n],
+                        kv_dtype=rec["kv_dtype"],
+                        n_prefix=int(rec.get("n_prefix", 0)))
+                    off += n
+                send({"ok": True, "written": int(written), "hb": hb(),
+                      "counters": reg.counters()})
             elif op == "ping":
                 send({"ok": True, "hb": hb(), "pid": os.getpid()})
             elif op == "arm_fault":

@@ -1,0 +1,674 @@
+"""Disaggregated prefill/decode fleet tests (ISSUE 13): the PT_KVPAGES
+tensor-frame codec, PageAllocator.import_chain (the cross-allocator
+splice) with its transfer stats + audit, prefill-role engines exporting
+finished pages, decode engines importing them, the router's class-aware
+placement + streamed handoff, mid-transfer death bit-parity, the
+`transfer` TTFT segment (queue + prefill + transfer + failover must
+partition measured TTFT exactly), per-class autoscaling hooks, and the
+serve_bench --disagg --smoke CI path.
+
+Budget notes (the test_serve_router discipline): one module-scoped tiny
+GPT + one-shot references; short prompts share one bucket, long prompts
+share a chunk ladder; router tests use page_size=8 / prefill_chunk=16
+so a "long" prompt is only ~2 chunks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import nnx
+
+from avenir_tpu.infer.decode import generate_cached
+from avenir_tpu.models.gpt import GPT, GPTConfig
+from avenir_tpu.obs import MetricsRegistry
+from avenir_tpu.obs.trace import Tracer, request_segments, \
+    ttft_attribution
+from avenir_tpu.serve import Engine, Router
+from avenir_tpu.serve.frames import FrameProtocolError, \
+    decode_kv_pages, encode_kv_pages
+from avenir_tpu.serve.pages import PageAllocator
+
+GPT_TINY = GPTConfig(block_size=128, vocab_size=64, n_layer=1, n_head=2,
+                     n_embd=32, dropout=0.0, bias=True, attn_impl="xla")
+MAX_NEW = 4
+PAGE = 8
+CHUNK = 16
+EKW = {"kv_impl": "paged", "page_size": PAGE, "prefill_chunk": CHUNK}
+
+
+def _mk_requests(model, rng, n, long_every=2):
+    """n requests — every `long_every`-th gets a LONG prompt (>= CHUNK,
+    multiple chunks, several exportable pages), the rest short (one
+    bucket) — with their one-shot reference streams."""
+    reqs = []
+    for i in range(n):
+        t0 = (int(rng.integers(34, 42)) if i % long_every == 0
+              else int(rng.integers(3, 9)))
+        prompt = [int(t) for t in rng.integers(0, 64, (t0,))]
+        key = jax.random.key(7000 + i)
+        y = np.asarray(generate_cached(
+            model, key, jnp.asarray(prompt, jnp.int32)[None], MAX_NEW,
+            temperature=1.0, top_k=8))[0]
+        reqs.append((dict(prompt=prompt, max_new_tokens=MAX_NEW,
+                          temperature=1.0, top_k=8, rng=key),
+                     [int(t) for t in y]))
+    return reqs
+
+
+@pytest.fixture(scope="module")
+def fix():
+    model = GPT(GPT_TINY, rngs=nnx.Rngs(0))
+    return model, _mk_requests(model, np.random.default_rng(5), 6)
+
+
+def _submit_all(router, reqs):
+    return {router.submit(**kw): ref for kw, ref in reqs}
+
+
+def _assert_parity(done, refs):
+    for f in done:
+        assert f.tokens == refs[f.req_id], (
+            f"request {f.req_id} diverged:\n ref {refs[f.req_id]}\n "
+            f"got {f.tokens}")
+        assert f.finish_reason == "length"
+
+
+# ---------------------------------------------------------------------------
+# PT_KVPAGES codec
+# ---------------------------------------------------------------------------
+
+
+def test_kvpages_codec_roundtrip():
+    rng = np.random.default_rng(0)
+    arrays = [rng.standard_normal((2, 3, 8, 2, 4)).astype(np.float32),
+              rng.integers(-128, 128, (2, 3, 8, 2, 4)).astype(np.int8)]
+    meta = {"op": "import_pages", "records": [
+        {"eng_rid": 7, "tokens": [[1, 2, 3, 4]], "kv_dtype": "int8"}]}
+    out = decode_kv_pages(encode_kv_pages(meta, arrays))
+    assert out["op"] == "import_pages"
+    assert out["records"][0]["tokens"] == [[1, 2, 3, 4]]
+    assert len(out["arrays"]) == 2
+    for a, b in zip(arrays, out["arrays"]):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert np.array_equal(a, b)
+
+
+def test_kvpages_codec_bf16_bit_exact():
+    """bf16 page data (the serving compute dtype) must round-trip the
+    wire bit-for-bit — the transfer parity oracle rests on it."""
+    import ml_dtypes
+
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((1, 2, 8, 2, 4)).astype(ml_dtypes.bfloat16)
+    out = decode_kv_pages(encode_kv_pages({"x": 1}, [a]))
+    b = out["arrays"][0]
+    assert b.dtype == a.dtype
+    assert np.array_equal(a.view(np.uint16), b.view(np.uint16))
+
+
+def test_kvpages_codec_torn_payload_fails_loud():
+    payload = encode_kv_pages({"x": 1}, [np.zeros((4,), np.float32)])
+    with pytest.raises(FrameProtocolError, match="length mismatch"):
+        decode_kv_pages(payload[:-2] + b"....")  # longer than manifest
+    # the SHORT tear direction must land in the frame-error taxonomy
+    # too (not a bare numpy ValueError escaping FrameError handlers)
+    with pytest.raises(FrameProtocolError, match="length mismatch"):
+        decode_kv_pages(payload[:-3])            # shorter than manifest
+
+
+# ---------------------------------------------------------------------------
+# allocator: import_chain + transfer stats + audit
+# ---------------------------------------------------------------------------
+
+
+def test_import_chain_registers_cached_and_dedupes():
+    al = PageAllocator(n_pages=8, page_size=4)
+    chain = [(1, 2, 3, 4), (5, 6, 7, 8), (9, 10, 11, 12)]
+    pairs = al.import_chain(chain)
+    assert [new for _, new in pairs] == [True, True, True]
+    assert al.stats()["pages_imported"] == 3
+    assert al.stats()["cached"] == 3 and al.stats()["free"] == 5
+    # re-import (a retargeted transfer resend): pure dedup, no new pages
+    again = al.import_chain(chain)
+    assert [new for _, new in again] == [False, False, False]
+    assert [p for p, _ in again] == [p for p, _ in pairs]
+    assert al.stats()["pages_imported"] == 3
+    al.audit()   # the splice left a consistent free/cached/live world
+    # available() unchanged by imports: cached pages stay reclaimable
+    assert al.available() == 8
+
+
+def test_import_chain_partial_under_pressure():
+    """A pool with no free or evictable pages stops the import early —
+    the partial chain is still a valid prefix, never a wrong one."""
+    al = PageAllocator(n_pages=2, page_size=4)
+    assert al.admit(0, tuple(range(6)), 2) is not None   # 2 pages live
+    for _ in range(2):
+        al.alloc(0)
+    pairs = al.import_chain([(9, 9, 9, 1), (9, 9, 9, 2)])
+    assert pairs == []   # everything live: nothing importable
+    al.audit()
+    al.free_seq(0)
+    pairs = al.import_chain([(9, 9, 9, 1), (9, 9, 9, 2), (9, 9, 9, 3)])
+    assert len(pairs) == 2   # 2 reclaimable pages -> 2-node prefix
+    al.audit()
+
+
+def test_import_chain_anchoring_blocks_unanchored_segment():
+    """A streamed segment's pages are only valid UNDER the prefix that
+    produced them: with its anchor present it splices at the right
+    depth; with the anchor missing it must be REFUSED — registering it
+    at the root would let a different prompt falsely match KV computed
+    at other positions (a correctness bug, not a cache miss)."""
+    al = PageAllocator(n_pages=8, page_size=4)
+    al.import_chain([(1, 2, 3, 4)])
+    pairs = al.import_chain([(1, 2, 3, 4), (5, 6, 7, 8)], n_prefix=1)
+    assert [n for _, n in pairs] == [False, True]
+    assert al.plan((1, 2, 3, 4, 5, 6, 7, 8, 9), 1).shared_len == 8
+    # fresh allocator = the anchor segment never landed (evicted, or a
+    # retargeted transfer): the unanchored segment imports NOTHING
+    al2 = PageAllocator(n_pages=8, page_size=4)
+    assert al2.import_chain([(1, 2, 3, 4), (5, 6, 7, 8)],
+                            n_prefix=1) == []
+    assert al2.plan((5, 6, 7, 8, 9), 1).shared_len == 0
+    al2.audit()
+
+
+def test_imported_chain_attach_and_cow_stats():
+    """A prompt equal to an imported chain attaches it (full pages +
+    the partial tail) and the first divergent write COWs — counted as
+    an imported-chain COW, and audit() stays green through the splice,
+    attach, COW and release."""
+    al = PageAllocator(n_pages=8, page_size=4)
+    prompt = tuple(range(12))
+    chain = [prompt[0:4], prompt[4:8], prompt[8:12]]
+    al.import_chain(chain)
+    plan = al.admit(1, prompt, 4)
+    assert plan is not None
+    assert plan.shared_len == 11          # capped at len(prompt) - 1
+    assert len(plan.shared_pages) == 2 and plan.partial is not None
+    al.audit()
+    assert al.stats()["imported_live"] == 3
+    # the tail write lands INSIDE the partially attached imported page
+    cow = al.ensure_writable(1, 2)
+    assert cow is not None
+    assert al.stats()["imported_cow_copies"] == 1
+    al.audit()
+    al.free_seq(1)
+    al.audit()
+
+
+# ---------------------------------------------------------------------------
+# engine: prefill role exports, decode engine imports
+# ---------------------------------------------------------------------------
+
+
+def test_prefill_role_engine_exports_and_finishes(fix):
+    model, reqs = fix
+    eng = Engine(model, n_slots=2, max_seq_len=64, role="prefill",
+                 registry=MetricsRegistry(), **EKW)
+    kw, _ = next(r for r in reqs if len(r[0]["prompt"]) >= 32)
+    rid = eng.submit(**kw)
+    done = eng.drain()
+    assert [f.finish_reason for f in done] == ["prefilled"]
+    assert done[0].req_id == rid and done[0].n_out == 0
+    recs = eng.take_page_exports()
+    n_full = len(kw["prompt"]) // PAGE
+    # each record's tokens are the FULL chain; arrays cover the new
+    # pages past its n_prefix anchor count — together they tile the
+    # prompt's full pages exactly once
+    assert sum(len(r["tokens"]) - r["n_prefix"] for r in recs) == n_full
+    flat = [t for r in recs
+            for pg in r["tokens"][r["n_prefix"]:] for t in pg]
+    assert flat == list(kw["prompt"][:n_full * PAGE])
+    for r in recs:
+        assert r["tokens"][:r["n_prefix"]] == [
+            list(kw["prompt"][i * PAGE:(i + 1) * PAGE])
+            for i in range(r["n_prefix"])]
+    arr = recs[0]["arrays"][0]
+    assert arr.shape[2] == PAGE        # (L, n, page_size, H_kv, D)
+    eng._paged.audit(expect_empty=True)  # handoff released everything
+
+
+def test_prefill_role_requires_paged():
+    model = GPT(GPT_TINY, rngs=nnx.Rngs(0))
+    with pytest.raises(ValueError, match="kv_impl='paged'"):
+        Engine(model, n_slots=1, role="prefill",
+               registry=MetricsRegistry())
+
+
+def test_import_then_serve_is_bit_identical_and_skips_prefill(fix):
+    """THE transfer exactness oracle at engine level: pages computed by
+    a prefill-role engine, shipped through the codec, imported into a
+    fresh decode engine — the handoff submit prefix-attaches them,
+    computes only the sub-page tail, and the output is bit-identical
+    to one-shot generation."""
+    model, reqs = fix
+    kw, ref = next(r for r in reqs if len(r[0]["prompt"]) >= 32)
+    pre = Engine(model, n_slots=2, max_seq_len=64, role="prefill",
+                 registry=MetricsRegistry(), **EKW)
+    pre.submit(**kw)
+    pre.drain()
+    recs = pre.take_page_exports()
+
+    from avenir_tpu.serve.frames import ARRAYS_PER_DTYPE
+
+    dec = Engine(model, n_slots=2, max_seq_len=64,
+                 registry=MetricsRegistry(), **EKW)
+    for r in recs:
+        n = ARRAYS_PER_DTYPE[r["kv_dtype"]]
+        wrote = dec.import_kv_pages(r["tokens"], r["arrays"][:n],
+                                    kv_dtype=r["kv_dtype"],
+                                    n_prefix=r["n_prefix"])
+        assert wrote == len(r["tokens"]) - r["n_prefix"]
+    rid = dec.submit(**kw)
+    done = {f.req_id: f for f in dec.drain()}
+    assert done[rid].tokens == ref
+    # the shared region was ATTACHED, not recomputed
+    assert dec._paged.alloc.prefix_hits == 1
+    n_full = len(kw["prompt"]) // PAGE
+    assert dec._paged.shared_tokens >= n_full * PAGE - 1
+    dec._paged.audit()
+
+
+def test_import_dtype_mismatch_fails_loud(fix):
+    model, _ = fix
+    dec = Engine(model, n_slots=1, max_seq_len=64,
+                 registry=MetricsRegistry(), **EKW)
+    with pytest.raises(AssertionError, match="kv_dtype"):
+        dec.import_kv_pages([[0] * PAGE], [None] * 4, kv_dtype="int8")
+
+
+# ---------------------------------------------------------------------------
+# router: class placement, handoff, failover
+# ---------------------------------------------------------------------------
+
+
+def test_router_disagg_parity_and_placement(fix):
+    """Long prompts prefill on the prefill class and decode on the
+    decode class; short prompts skip the handoff entirely; every
+    stream is bit-identical to one-shot generation."""
+    model, reqs = fix
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=3, n_slots=2, max_seq_len=64,
+                    registry=reg, seed=0, n_prefill=1,
+                    engine_kwargs=EKW)
+    refs = _submit_all(router, reqs)
+    done = router.drain()
+    assert len(done) == len(reqs)
+    _assert_parity(done, refs)
+    # every terminal record comes from a DECODE replica (0 is prefill)
+    assert all(f.replica != 0 for f in done)
+    counters = reg.snapshot()["counters"]
+    n_long = sum(1 for kw, _ in reqs if len(kw["prompt"]) >= CHUNK)
+    assert counters["kv_transfers"] == n_long
+    assert counters["kv_pages_exported"] >= n_long * (32 // PAGE)
+    assert counters["kv_pages_imported"] == counters["kv_pages_exported"]
+    assert counters["serve_requests"] == len(reqs)
+    # the prefill replica's pool drained clean after its handoffs
+    router.replicas[0].engine._paged.audit(expect_empty=True)
+
+
+def test_router_disagg_mid_transfer_prefill_death_bit_parity(fix):
+    """SIGKILL-shape oracle (inproc twin of the process chaos test): a
+    prefill replica dies AFTER k of n pages shipped — the requests
+    requeue, re-prefill from prompt+rng (on the decode class, the
+    degraded-mode fallback), and every output is bit-identical."""
+    model, reqs = fix
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=3, n_slots=2, max_seq_len=64,
+                    registry=reg, seed=0, n_prefill=1,
+                    engine_kwargs=EKW)
+    refs = _submit_all(router, reqs)
+    for _ in range(2):
+        router.step()
+    exported = reg.snapshot()["counters"].get("kv_pages_exported", 0)
+    assert exported > 0, "the kill must land MID-transfer"
+    router.kill_replica(0)
+    done = router.drain()
+    assert len(done) == len(reqs)
+    _assert_parity(done, refs)
+    assert reg.snapshot()["counters"]["serve_failovers"] >= 1
+    assert not router._transfer, "transfer state leaked past failover"
+
+
+def test_router_disagg_decode_target_death_retargets(fix):
+    """The pinned decode target dies mid-stream: the retained export
+    records re-ship to a fresh target at handoff — no recompute, no
+    loss, bit-identical output."""
+    model, reqs = fix
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=3, n_slots=2, max_seq_len=64,
+                    registry=reg, seed=0, n_prefill=1,
+                    engine_kwargs=EKW)
+    longs = [r for r in reqs if len(r[0]["prompt"]) >= CHUNK]
+    refs = _submit_all(router, longs[:1])
+    router.step()   # first chunk computed, first pages pinned+shipped
+    tr = next(iter(router._transfer.values()), None)
+    assert tr is not None and tr["target"] is not None, (
+        "no transfer pinned after the first step")
+    router.kill_replica(tr["target"])
+    done = router.drain()
+    _assert_parity(done, refs)
+    assert len(done) == 1
+
+
+def test_router_disagg_falls_back_when_prefill_class_dead(fix):
+    """No healthy prefill replica -> long prompts dispatch straight to
+    the decode class (full local serving), nothing waits forever."""
+    model, reqs = fix
+    router = Router(model, n_replicas=2, n_slots=2, max_seq_len=64,
+                    registry=MetricsRegistry(), seed=0, n_prefill=1,
+                    engine_kwargs=EKW)
+    router.kill_replica(0)   # the prefill class, before any work
+    refs = _submit_all(router, reqs[:3])
+    done = router.drain()
+    _assert_parity(done, refs)
+    assert all(f.replica == 1 for f in done)
+
+
+# ---------------------------------------------------------------------------
+# trace: the `transfer` segment partitions TTFT
+# ---------------------------------------------------------------------------
+
+
+def test_segments_transfer_and_relabel_on_death():
+    evs = [
+        {"rid": 1, "ev": "submit", "t": 0.0},
+        {"rid": 1, "ev": "dispatch", "t": 1.0},        # prefill class
+        {"rid": 1, "ev": "kv_transfer", "t": 2.0, "handoff": True},
+        {"rid": 1, "ev": "dispatch", "t": 2.5},        # decode class
+        {"rid": 1, "ev": "first_token", "t": 3.0},
+        {"rid": 1, "ev": "finish", "t": 4.0, "reason": "length"},
+    ]
+    assert request_segments(evs) == [
+        ("queue", 0.0, 1.0), ("prefill", 1.0, 2.0),
+        ("transfer", 2.0, 2.5), ("prefill", 2.5, 3.0),
+        ("decode", 3.0, 4.0)]
+    a = ttft_attribution(evs)
+    assert a == {"ttft_s": 3.0, "queue_s": 1.0, "prefill_s": 1.5,
+                 "transfer_s": 0.5, "failover_s": 0.0}
+    # a death AFTER handoff discards the WHOLE chain: prefill AND
+    # transfer AND the post-handoff tail relabel as failover loss
+    evs2 = evs[:5] + [
+        {"rid": 1, "ev": "failover", "t": 3.5},
+        {"rid": 1, "ev": "requeue", "t": 3.5},
+        {"rid": 1, "ev": "dispatch", "t": 4.0},
+        {"rid": 1, "ev": "first_token", "t": 5.0},
+        {"rid": 1, "ev": "finish", "t": 6.0, "reason": "length"},
+    ]
+    a2 = ttft_attribution(evs2)
+    assert a2["ttft_s"] == pytest.approx(5.0)
+    assert a2["failover_s"] == pytest.approx(2.5)  # 1.0 -> 3.5 lost
+    assert a2["transfer_s"] == 0.0                 # relabeled with it
+    assert (a2["queue_s"] + a2["prefill_s"] + a2["transfer_s"]
+            + a2["failover_s"]) == pytest.approx(a2["ttft_s"])
+
+
+def test_segments_handoff_retry_is_not_failover():
+    """A handoff-retry requeue (no healthy decode target at handoff
+    time) kills no replica and DISCARDS no work — the retained chain
+    prefix-hits on retry — so the attempt must NOT relabel as failover
+    loss: failover_s in a report whose failover count is 0 would send
+    an operator hunting for deaths that never happened. The partition
+    still sums exactly."""
+    evs = [
+        {"rid": 1, "ev": "submit", "t": 0.0},
+        {"rid": 1, "ev": "dispatch", "t": 1.0},
+        {"rid": 1, "ev": "kv_transfer", "t": 2.0, "handoff": True},
+        {"rid": 1, "ev": "requeue", "t": 2.5, "handoff_retry": True},
+        {"rid": 1, "ev": "dispatch", "t": 3.0},
+        {"rid": 1, "ev": "first_token", "t": 3.5},
+        {"rid": 1, "ev": "finish", "t": 4.0, "reason": "length"},
+    ]
+    assert request_segments(evs) == [
+        ("queue", 0.0, 1.0), ("prefill", 1.0, 2.0),
+        ("transfer", 2.0, 2.5), ("queue", 2.5, 3.0),
+        ("prefill", 3.0, 3.5), ("decode", 3.5, 4.0)]
+    a = ttft_attribution(evs)
+    assert a["failover_s"] == 0.0
+    assert (a["queue_s"] + a["prefill_s"] + a["transfer_s"]
+            + a["failover_s"]) == pytest.approx(a["ttft_s"])
+
+
+def test_live_disagg_trace_partition_matches_measured_ttft(fix):
+    """Property (ISSUE 13 satellite): on a traced disagg run, queue +
+    prefill + transfer + failover == measured TTFT for EVERY request,
+    and handed-off requests carry a kv_transfer handoff marker."""
+    model, reqs = fix
+    reg = MetricsRegistry()
+    tr = Tracer(registry=reg)
+    router = Router(model, n_replicas=3, n_slots=2, max_seq_len=64,
+                    registry=reg, seed=0, n_prefill=1, tracer=tr,
+                    engine_kwargs=EKW)
+    refs = _submit_all(router, reqs)
+    done = router.drain()
+    _assert_parity(done, refs)
+    n_handoff = 0
+    for f in done:
+        evs = tr.events_for(f.req_id)
+        a = ttft_attribution(evs)
+        assert a is not None
+        assert (a["queue_s"] + a["prefill_s"] + a["transfer_s"]
+                + a["failover_s"]) == pytest.approx(a["ttft_s"],
+                                                    abs=1e-9)
+        assert a["ttft_s"] * 1e3 == pytest.approx(f.ttft_ms, abs=1.0)
+        if any(e["ev"] == "kv_transfer" and e.get("handoff")
+               for e in evs):
+            n_handoff += 1
+    assert n_handoff == sum(1 for kw, _ in reqs
+                            if len(kw["prompt"]) >= CHUNK)
+    # trace_report surfaces the component + the handoff count
+    from tools.trace_report import summarize_traces
+
+    s = summarize_traces([e for e in tr.events()
+                          if e.get("rid") is not None])
+    assert s["n_handoff"] == n_handoff
+    assert "transfer" in s["components_ms"]
+
+
+# ---------------------------------------------------------------------------
+# autoscaler: per-class scaling (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _fin(ttft_ms, *, tpot_ms=1.0, reason="length", n_out=4):
+    from avenir_tpu.serve.engine import FinishedRequest
+
+    f = FinishedRequest(req_id=0, tokens=[1], n_prompt=1, n_out=n_out,
+                        finish_reason=reason, text=None,
+                        ttft_ms=ttft_ms, tpot_ms=tpot_ms)
+    f.priority = "interactive"
+    return f
+
+
+def _mk_disagg_scaler(model, clk, reg, **kw):
+    from avenir_tpu.serve.autoscale import Autoscaler, SLOEngine
+
+    router = Router(model, n_replicas=3, n_slots=2, max_seq_len=64,
+                    registry=reg, seed=0, clock=clk, n_prefill=1,
+                    engine_kwargs=EKW)
+    slo = SLOEngine(slo_ttft_ms=100.0, slo_tpot_ms=50.0,
+                    target_attainment=0.9, window_s=10.0, clock=clk,
+                    registry=reg)
+    kw.setdefault("min_replicas", 2)
+    kw.setdefault("max_replicas", 5)
+    kw.setdefault("up_stable_s", 2.0)
+    kw.setdefault("down_stable_s", 5.0)
+    kw.setdefault("cooldown_s", 4.0)
+    kw.setdefault("prewarm", False)
+    scaler = Autoscaler(router, slo, registry=reg, clock=clk,
+                        echo=lambda *a: None, **kw)
+    return router, scaler
+
+
+def test_slo_engine_component_attainments():
+    """TTFT misses point at the prefill class, TPOT misses at the
+    decode class — the per-component verdicts a disagg fleet scales
+    on. Sheds/timeouts miss BOTH components (an under-provisioned
+    fleet, whichever class is short)."""
+    from avenir_tpu.serve.autoscale import SLOEngine
+
+    slo = SLOEngine(slo_ttft_ms=100.0, slo_tpot_ms=50.0, clock=_Clock(),
+                    registry=MetricsRegistry())
+    slo.observe([_fin(10.0), _fin(500.0),               # 1 ttft miss
+                 _fin(10.0, tpot_ms=80.0),              # 1 tpot miss
+                 _fin(None, reason="shed")])            # misses both
+    comp = slo.component_attainments()
+    assert comp["ttft"] == pytest.approx(2 / 4)
+    assert comp["tpot"] == pytest.approx(2 / 4)
+    empty = SLOEngine(slo_ttft_ms=100.0, slo_tpot_ms=50.0,
+                      clock=_Clock(), registry=MetricsRegistry())
+    assert empty.component_attainments() == {"ttft": None, "tpot": None}
+
+
+def test_autoscaler_disagg_no_flapping_steady_load(fix):
+    """The ISSUE 13 no-flapping pin, disagg form: steady in-SLO load on
+    a split fleet whose utilization justifies its size -> ZERO scale
+    decisions for EITHER class after warm-up."""
+    model, _ = fix
+    clk = _Clock()
+    reg = MetricsRegistry()
+    router, scaler = _mk_disagg_scaler(model, clk, reg, down_util=0.6)
+    # three short (decode-class) requests keep 3 of the decode class's
+    # 4 slots live: a one-replica-smaller fleet would sit at 0.75 >
+    # down_util -> down blocked; burn 0 -> up never triggers
+    rng = np.random.default_rng(21)
+    for _ in range(3):
+        router.submit([int(t) for t in rng.integers(0, 64, (5,))],
+                      max_new_tokens=8)
+    router.step()
+    assert sum(len(r.engine._live) for r in router.replicas) == 3
+    for _ in range(60):
+        clk.t += 1.0
+        scaler.observe([_fin(10.0)])
+        scaler.poll()
+    assert scaler.decisions == []
+    assert router.fleet_size_by_class() == {"prefill": 1, "decode": 2}
+    counters = reg.snapshot()["counters"]
+    assert counters.get("scale_up", 0) == 0
+    assert counters.get("scale_down", 0) == 0
+
+
+def test_autoscaler_disagg_ttft_burn_grows_prefill_class(fix):
+    """Sustained TTFT misses (queue+prefill latency) grow the PREFILL
+    class; the decision's audit evidence carries the per-class sizes +
+    component attainments that justified the choice."""
+    model, _ = fix
+    clk = _Clock()
+    reg = MetricsRegistry()
+    router, scaler = _mk_disagg_scaler(model, clk, reg)
+    before = router.fleet_size_by_class()
+    for _ in range(8):
+        clk.t += 1.0
+        scaler.observe([_fin(500.0)])        # TTFT miss, TPOT fine
+        if scaler.poll():
+            break
+    after = router.fleet_size_by_class()
+    assert after["prefill"] == before["prefill"] + 1
+    assert after["decode"] == before["decode"]
+    d = scaler.decisions[-1]
+    assert d.action == "up" and d.evidence["class"] == "prefill"
+    assert d.evidence["prefill_replicas"] == before["prefill"]
+    assert d.evidence["attainment_ttft"] == pytest.approx(0.0)
+    assert d.evidence["attainment_tpot"] == pytest.approx(1.0)
+
+
+def test_autoscaler_disagg_tpot_burn_grows_decode_class(fix):
+    """Sustained TPOT misses (decode bandwidth) grow the DECODE class —
+    a full-lifecycle replica, so the fleet can always finish work."""
+    model, _ = fix
+    clk = _Clock()
+    reg = MetricsRegistry()
+    router, scaler = _mk_disagg_scaler(model, clk, reg)
+    before = router.fleet_size_by_class()
+    for _ in range(8):
+        clk.t += 1.0
+        scaler.observe([_fin(10.0, tpot_ms=80.0)])  # TPOT miss only
+        if scaler.poll():
+            break
+    after = router.fleet_size_by_class()
+    assert after["decode"] == before["decode"] + 1
+    assert after["prefill"] == before["prefill"]
+    d = scaler.decisions[-1]
+    assert d.action == "up" and "class" not in d.evidence
+
+
+def test_autoscaler_disagg_up_class_follows_queue_composition(fix):
+    """A queue-wait (or TTFT-burn) scale-up must grow the class the
+    QUEUED WORK is starved for: a short-prompt flood queues for decode
+    slots — growing the prefill class would spend the budget on
+    replicas that can never serve the backlog."""
+    model, _ = fix
+    clk = _Clock()
+    reg = MetricsRegistry()
+    router, scaler = _mk_disagg_scaler(model, clk, reg)
+    rng = np.random.default_rng(31)
+    # short-prompt flood: queued work is decode-class
+    for _ in range(8):
+        router.submit([int(t) for t in rng.integers(0, 64, (5,))],
+                      max_new_tokens=4)
+    assert scaler._queued_long_frac() == 0.0
+    assert scaler._pick_up_class("queue_wait") == "both"
+    scaler.observe([_fin(500.0)])            # TTFT burning, TPOT fine
+    assert scaler._pick_up_class("burn_rate") == "both"
+    router.drain()
+    # long-prompt flood: queued work wants prefill-class capacity
+    for _ in range(8):
+        router.submit([int(t) for t in rng.integers(0, 64, (40,))],
+                      max_new_tokens=4)
+    assert scaler._queued_long_frac() == 1.0
+    assert scaler._pick_up_class("queue_wait") == "prefill"
+    assert scaler._pick_up_class("burn_rate") == "prefill"
+    router.drain()
+    # empty queue: queue_wait keeps its prefill default (time-to-first-
+    # dispatch is a prefill-class resource when nothing names otherwise)
+    assert scaler._queued_long_frac() is None
+    assert scaler._pick_up_class("queue_wait") == "prefill"
+
+
+def test_autoscaler_disagg_never_retires_a_class_to_zero(fix):
+    """Scale-down on a surplus split fleet retires from the class with
+    the safer SLO component and STOPS before either class empties — a
+    fleet with prefill replicas but no decode class could prefill
+    forever and finish nothing."""
+    model, _ = fix
+    clk = _Clock()
+    reg = MetricsRegistry()
+    router, scaler = _mk_disagg_scaler(model, clk, reg, min_replicas=1)
+    for _ in range(40):
+        clk.t += 1.0
+        scaler.observe([_fin(10.0)])         # in SLO, fleet idle
+        scaler.poll()
+        router.step()                        # reap drained retirees
+    by = router.fleet_size_by_class()
+    assert by["prefill"] >= 1 and by["decode"] >= 1, (
+        f"a class was retired to zero: {by}")
+
+
+# ---------------------------------------------------------------------------
+# serve_bench --disagg --smoke (the tier-1 CI path)
+# ---------------------------------------------------------------------------
+
+
+def test_disagg_bench_smoke_runs_in_ci():
+    from tools.serve_bench import disagg_bench
+
+    rc = disagg_bench({
+        "smoke": "1", "smoke_splits": "1", "n_replicas": "2",
+        "n_slots": "2", "block_size": "128", "max_seq_len": "96",
+        "page_size": "8", "prefill_chunk": "16",
+        "kv_budget_tokens": "512", "long_lo": "32", "long_hi": "40",
+        "short_lo": "3", "short_hi": "8", "max_new_tokens": "3",
+        "bench_requests": "6", "max_concurrency": "2", "n_layer": "1",
+        "n_embd": "32", "vocab_size": "64",
+    })
+    assert rc == 0

@@ -151,6 +151,59 @@ def test_frame_timeout_and_eof():
         _close_all(fds)
 
 
+def test_frame_split_across_timed_writes_is_recoverable():
+    """ISSUE 13 satellite: a frame split across timed writes — the
+    deadline landing mid-HEADER or mid-payload — must surface as the
+    RECOVERABLE FrameTimeout with the buffer intact, so a later read
+    resumes at the right offset and decodes the frame. (The old reader
+    consumed the header before the payload arrived; a retry then
+    parsed leftover payload bytes as a new header — a slow peer
+    surfacing as the unrecoverable FrameProtocolError/FrameCRCError.)"""
+    a, b, fds = _pipe_pair()
+    try:
+        full = encode_frame({"op": "ping", "n": 7})
+        # 1) deadline mid-HEADER: only 3 of 14 header bytes arrive
+        os.write(fds[3], full[:3])
+        with pytest.raises(FrameTimeout):
+            b.read(timeout_s=0.05)
+        # 2) the rest of the header + half the payload, another timeout
+        os.write(fds[3], full[3:HEADER_SIZE + 4])
+        with pytest.raises(FrameTimeout):
+            b.read(timeout_s=0.05)
+        # 3) the tail lands: the SAME stream decodes the frame whole
+        os.write(fds[3], full[HEADER_SIZE + 4:])
+        assert b.read(timeout_s=1.0) == {"op": "ping", "n": 7}
+        # and the stream is still aligned for the next frame
+        os.write(fds[3], encode_frame({"op": "step"}))
+        assert b.read(timeout_s=1.0) == {"op": "step"}
+    finally:
+        _close_all(fds)
+
+
+def test_frame_kvpages_roundtrip_over_pipe():
+    """The PT_KVPAGES tensor frame (ISSUE 13) rides the same pipe
+    protocol: meta + raw page bytes round-trip exactly, and the CRC
+    still covers the whole payload."""
+    import numpy as np
+
+    from avenir_tpu.serve.frames import PT_KVPAGES
+
+    a, b, fds = _pipe_pair()
+    try:
+        arrays = [np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+                  np.arange(6, dtype=np.int8)]
+        a.write(({"op": "import_pages", "seq": 3,
+                  "records": [{"tokens": [[1, 2]], "n_prefix": 0,
+                               "kv_dtype": "bf16"}]}, arrays),
+                ptype=PT_KVPAGES)
+        out = b.read(timeout_s=2.0)
+        assert out["op"] == "import_pages" and out["seq"] == 3
+        assert np.array_equal(out["arrays"][0], arrays[0])
+        assert np.array_equal(out["arrays"][1], arrays[1])
+    finally:
+        _close_all(fds)
+
+
 # ---------------------------------------------------------------------
 # respawn supervisor schedule (fast: fake replicas, fake clock)
 # ---------------------------------------------------------------------
@@ -357,6 +410,52 @@ def test_process_frame_corruption_is_death_not_retry(pfix, _close_routers):
     assert victim.state == "dead"
     assert "CRC" in str(victim.last_error)
     assert reg.snapshot()["counters"]["frame_crc_errors"] == 1
+
+
+@pytest.mark.slow
+def test_process_disagg_prefill_sigkill_mid_transfer_bit_parity(
+        _close_routers):
+    """ISSUE 13 satellite: a REAL SIGKILL to the prefill-class worker
+    after k of n KV pages shipped over PT_KVPAGES frames. The parent
+    sees pipe EOF, the corpse's in-flight transfers are discarded with
+    its attempts, the requests requeue and re-prefill from prompt+rng
+    on the decode class — 0 requests lost, every completed stream
+    bit-identical to one-shot generate_cached."""
+    import numpy as np
+    from flax import nnx
+
+    import tests.test_disagg as td
+    from avenir_tpu.models.gpt import GPT
+    from avenir_tpu.obs import MetricsRegistry
+
+    model = GPT(td.GPT_TINY, rngs=nnx.Rngs(0))
+    reqs = td._mk_requests(model, np.random.default_rng(11), 4)
+    reg = MetricsRegistry()
+    router = _mk_router(_close_routers, model, n_replicas=3, n_slots=2,
+                        max_seq_len=64, registry=reg, seed=0,
+                        n_prefill=1, engine_kwargs=dict(td.EKW))
+    victim = router.replicas[0]
+    assert victim.role == "prefill"
+    refs = td._submit_all(router, reqs)
+    # step until pages have crossed the class boundary (k of n shipped:
+    # long prompts span several chunks, so the first import lands while
+    # later chunks are still computing) — THEN the kill
+    for _ in range(60):
+        router.step()
+        if reg.snapshot()["counters"].get("kv_pages_imported", 0):
+            break
+    assert reg.snapshot()["counters"].get("kv_pages_imported", 0) > 0, (
+        "the kill must land MID-transfer, after some pages shipped")
+    os.kill(victim.pid, signal.SIGKILL)
+    done = router.drain()
+    assert len(done) == len(reqs)           # 0 requests lost
+    td._assert_parity(done, refs)
+    assert victim.state == "dead" and victim.deaths == 1
+    assert not router._transfer, "transfer state leaked past failover"
+    snap = reg.snapshot()["counters"]
+    assert snap["serve_failovers"] >= 1
+    # survivors (decode class) finished everything
+    assert all(f.replica != victim.replica_id for f in done)
 
 
 @pytest.mark.slow

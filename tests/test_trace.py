@@ -158,7 +158,7 @@ def test_segments_partition_ttft_across_failover():
     assert a["queue_s"] + a["prefill_s"] + a["failover_s"] == \
         pytest.approx(a["ttft_s"])
     assert a == {"ttft_s": 6.0, "queue_s": 2.0, "prefill_s": 2.0,
-                 "failover_s": 2.0}
+                 "transfer_s": 0.0, "failover_s": 2.0}
 
 
 def test_attribution_counts_dead_decode_attempt_as_failover():

@@ -2,8 +2,9 @@
 
 Answers the question the aggregate metrics can't: for the requests this
 run served, where did time-to-first-token actually go — queue wait,
-prefill work, or attempts lost to replica deaths (failover)? The three
-components PARTITION each request's TTFT by construction
+prefill work, the non-overlapped tail of a disaggregated page handoff
+(transfer, ISSUE 13), or attempts lost to replica deaths (failover)?
+The four components PARTITION each request's TTFT by construction
 (obs/trace.request_segments), so the attribution sums to the measured
 latency with no residue.
 
@@ -71,6 +72,12 @@ def summarize_traces(events):
             "admission_first": any(e["ev"] == "first_token"
                                    and e.get("admission")
                                    for e in evs),
+            # disagg handoffs (ISSUE 13): how many times this request's
+            # KV pages crossed the class boundary, and the bytes moved
+            "handoffs": sum(1 for e in evs if e["ev"] == "kv_transfer"
+                            and e.get("handoff")),
+            "transfer_bytes": sum(int(e.get("bytes", 0)) for e in evs
+                                  if e["ev"] == "kv_transfer"),
             "attribution": att,
             "segments": request_segments(evs),
         })
@@ -81,12 +88,14 @@ def summarize_traces(events):
         return [r["attribution"][key] * 1e3 for r in with_ttft]
 
     comps = {k: comp_ms(k + "_s")
-             for k in ("queue", "prefill", "failover")}
+             for k in ("queue", "prefill", "transfer", "failover")}
     total_ttft = sum(ttfts)
     return {
         "n_requests": len(reqs),
         "n_with_token": len(with_ttft),
         "n_failover": sum(1 for r in reqs if r["failovers"]),
+        "n_handoff": sum(1 for r in reqs if r["handoffs"]),
+        "transfer_bytes": sum(r["transfer_bytes"] for r in reqs),
         "n_admission_first": sum(1 for r in reqs if r["admission_first"]),
         "reasons": _count(r["reason"] for r in reqs),
         "ttft_p50_ms": percentile(ttfts, 0.50),
@@ -117,6 +126,12 @@ def format_trace_report(s, *, detail_failovers=8):
             f"spec decode: {s['n_admission_first']} first token(s) "
             "sampled inside admission prefill (TTFT anchors at the "
             "sample, not the verify tick that harvests it)")
+    if s.get("n_handoff"):
+        lines.append(
+            f"disagg: {s['n_handoff']} request(s) handed prefill->"
+            f"decode ({s['transfer_bytes'] / 1e6:.2f} MB of KV pages "
+            "over frames; streamed ships hide behind prefill — only "
+            "the `transfer` component below was user-visible)")
     if s["reasons"]:
         lines.append("finish reasons: " + "  ".join(
             f"{k}={v}" for k, v in sorted(s["reasons"].items(),
@@ -128,7 +143,7 @@ def format_trace_report(s, *, detail_failovers=8):
         lines.append("-- where TTFT went (sums over every first token; "
                      "the components partition each request's TTFT) --")
         total = s["ttft_total_ms"] or 1.0
-        for k in ("queue", "prefill", "failover"):
+        for k in ("queue", "prefill", "transfer", "failover"):
             ms = s["components_ms"][k]
             p99 = s["components_p99_ms"][k]
             lines.append(
@@ -148,6 +163,7 @@ def format_trace_report(s, *, detail_failovers=8):
                 f"  rid {r['rid']:>4}  ttft {a['ttft_s'] * 1e3:8.1f} ms"
                 f" = queue {a['queue_s'] * 1e3:7.1f}"
                 f" + prefill {a['prefill_s'] * 1e3:7.1f}"
+                f" + transfer {a.get('transfer_s', 0.0) * 1e3:6.1f}"
                 f" + failover {a['failover_s'] * 1e3:7.1f} ms"
                 f"  ({r['failovers']} failover(s), {r['chunks']} "
                 f"chunk(s), finish={r['reason']})")
