@@ -9,6 +9,13 @@ Dependency-free (stdlib; jax only for trace annotations, optional):
 - watchdog.py: stall watchdog for silently hung pod collectives
 - trace.py:   per-request trace events + ring-buffer flight recorder +
               Perfetto (Chrome trace JSON) export (ISSUE 10)
+- series.py:  mergeable streaming percentile sketches + windowed
+              time-series + the shared stall-threshold and percentile
+              rules (ISSUE 14)
+- anomaly.py: schema-pinned detector table over the series — drift /
+              trend / collapse / heartbeat-creep, each firing before
+              the watchdog/SLO tiers, wired to the flight recorder
+              (ISSUE 14)
 - report.py:  metrics.jsonl -> goodput/timing summary (tools/obs_report.py)
 """
 
@@ -32,6 +39,13 @@ from avenir_tpu.obs.trace import (
     set_tracer,
     ttft_attribution,
 )
+from avenir_tpu.obs.anomaly import DETECTOR_SCHEMA, AnomalyEngine
+from avenir_tpu.obs.series import (
+    QuantileSketch,
+    Series,
+    SeriesStore,
+    stall_threshold_secs,
+)
 from avenir_tpu.obs.watchdog import StallWatchdog
 
 __all__ = [
@@ -40,4 +54,6 @@ __all__ = [
     "TRACE_EVENTS", "TraceBuffer", "Tracer", "chrome_trace",
     "get_tracer", "set_tracer", "request_segments", "ttft_attribution",
     "install_crash_hooks", "disarm_crash_hooks",
+    "DETECTOR_SCHEMA", "AnomalyEngine", "QuantileSketch", "Series",
+    "SeriesStore", "stall_threshold_secs",
 ]

@@ -1,0 +1,451 @@
+"""Fleet health anomaly engine (ISSUE 14 tentpole, part 2).
+
+The obs stack could *observe* (PR 1 metrics, PR 9 tracing + flight
+recorder) and *act* (PR 11 SLO engine + autoscaler) — but nothing
+detected GRADUAL degradation: the watchdog fires only on a total stall,
+the SLO engine only after user-visible misses. This module is the tier
+between them: a schema-pinned table of robust detectors over the
+obs/series.py windowed series, each firing BEFORE the watchdog/SLO
+tiers react. An anomaly is simultaneously:
+
+- an `anomaly` counter bump (`anomalies_suppressed` for cooldown-
+  swallowed re-fires — an ongoing incident alerts once per cooldown,
+  never silently zero times and never once per check),
+- an `anomaly` JSONL record carrying the evidence (detector, key,
+  value, threshold, robust z / slope / baseline),
+- an `anomaly` trace event with the same attrs (the PR 11 audit
+  pattern — so Perfetto and fleet_report can line anomalies up against
+  scale decisions), and
+- a **flight-recorder dump** (`flight-anomaly-<detector>-NNN.jsonl`):
+  the PR 9 recorder stops being a post-mortem tool and becomes an
+  early-warning capture of the minutes BEFORE a death.
+
+Detector statistics are ROBUST by construction — median + MAD z-scores
+(a single outlier window cannot drag the baseline the way a mean/stddev
+would), least-squares trend with a relative-growth floor, and
+fraction-of-baseline collapse — and every detector carries an absolute
+floor below which it never fires, which is what makes the no-flapping
+pin (a steady in-SLO run produces ZERO anomalies) a property of the
+table, not of tuning luck.
+
+Disabled by default everywhere: the train loop and Router hold
+`ae = self._anomaly; if ae is not None` — the exact `tr is not None`
+shape PR 9 micro-pinned (<1 us/op disabled; tests/test_anomaly.py).
+"""
+
+import math
+import time
+
+from avenir_tpu.obs.metrics import get_registry
+from avenir_tpu.obs.series import SeriesStore, stall_threshold_secs
+
+# ---------------------------------------------------------------------------
+# The detector table — the METRIC_SCHEMA pattern applied to detection:
+# a detector not declared here cannot be built (fail loud), and the
+# docs/OBSERVABILITY.md detector table mirrors this dict (pinned by
+# tests/test_metrics_schema.py::test_doc_detector_table_matches_schema).
+# name -> (series key, method, what it means / which knob to reach for)
+# ---------------------------------------------------------------------------
+
+DETECTOR_SCHEMA = {
+    "step_time_drift": (
+        "step_time_ms", "drift",
+        "train-window / replica-step wall time drifting up (robust "
+        "z over window means vs the median baseline) — a silent "
+        "throughput regression forming; check data-loader backpressure, "
+        "a thermally throttled or straggling host, or a recent config "
+        "change (docs/OPERATIONS.md)"),
+    "ttft_drift": (
+        "ttft_ms", "drift",
+        "TTFT drifting up before the SLO tier misses — queue or "
+        "prefill pressure building; check prefill-class capacity / "
+        "autoscaler max_replicas"),
+    "tpot_drift": (
+        "tpot_ms", "drift",
+        "TPOT drifting up — decode bandwidth pressure; check decode-"
+        "class capacity, co-tenant long prompts (disagg split), or "
+        "kv_dtype"),
+    "queue_wait_trend": (
+        "queue_wait_ms", "trend",
+        "oldest-queued-request age growing with a sustained positive "
+        "slope — a backlog forming; check autoscaler max_replicas / "
+        "admission limits"),
+    "accept_rate_collapse": (
+        "spec_accept_rate", "collapse",
+        "speculative-decode accept rate collapsing below a fraction of "
+        "its baseline — the draft stopped predicting the target; check "
+        "the draft/target pair (a drifted fine-tune, wrong draft "
+        "shipped)"),
+    "heartbeat_creep": (
+        "heartbeat_age_s", "level",
+        "oldest replica heartbeat age creeping past a SMALL multiple "
+        "of the median step — a stall forming, caught strictly before "
+        "the stall tier's max(floor, 10x median) declares death; check "
+        "the flight dump for the wedged replica's last events"),
+    "io_retry_rate": (
+        "io_retries", "level",
+        "transient-IO retries arriving faster than the floor rate — "
+        "storage degrading before it fails; check the retry records' "
+        "sites and the storage backend"),
+}
+
+# per-series gauge refresh: series key -> the schema gauge that carries
+# its live sketch p99 (literal keys so the schema source-scan lint sees
+# only declared names)
+_P99_GAUGE = {
+    "step_time_ms": "step_time_p99_ms",
+    "ttft_ms": "ttft_p99_ms",
+    "tpot_ms": "tpot_p99_ms",
+    "queue_wait_ms": "queue_wait_p99_ms",
+}
+
+
+def robust_z(baseline, value):
+    """Median/MAD z-score of `value` against `baseline` values: MAD is
+    scaled by 1.4826 (consistent with sigma under normality), floored
+    at 5% of the median so a perfectly flat baseline (injected test
+    clocks, paced ticks) cannot make an epsilon wiggle read as a 100-
+    sigma event. Returns 0.0 with an empty baseline."""
+    if not baseline:
+        return 0.0
+    s = sorted(baseline)
+    med = s[len(s) // 2]
+    mad = sorted(abs(x - med) for x in s)[len(s) // 2]
+    scale = max(1.4826 * mad, 0.05 * abs(med), 1e-9)
+    return (value - med) / scale
+
+
+def ls_slope(points):
+    """Least-squares slope of (t, v) points (value units per second);
+    0.0 below 2 points."""
+    n = len(points)
+    if n < 2:
+        return 0.0
+    mt = sum(t for t, _ in points) / n
+    mv = sum(v for _, v in points) / n
+    var = sum((t - mt) ** 2 for t, _ in points)
+    if var <= 0.0:
+        return 0.0
+    return sum((t - mt) * (v - mv) for t, v in points) / var
+
+
+class Detector:
+    """One detector-table row bound to its knobs. Methods:
+
+      drift     mean of the newest `recent` window means vs a robust
+                (median/MAD) z against the OLDEST-half baseline
+                windows (a gradual ramp cannot chase its own baseline
+                that way) — fires at z >= z_thresh AND a >= min_rel
+                relative rise (noise around a tiny mean must not
+                alert), sustained `sustain` consecutive checks
+      trend     least-squares slope over the window means — fires when
+                the projected growth over `horizon_s` exceeds
+                min_rel x the current level AND the level exceeds
+                `floor`, sustained
+      collapse  newest mean below collapse_frac x the baseline median —
+                fires only when the baseline itself is >= floor (a
+                signal that never established a baseline cannot
+                collapse)
+      level     value above an absolute/derived threshold (the
+                heartbeat-creep and io-retry detectors; heartbeat's
+                threshold is max(floor, factor x median step) with a
+                factor STRICTLY below the stall tier's)
+    """
+
+    def __init__(self, name, *, key=None, method=None, z_thresh=4.0,
+                 min_rel=0.25, sustain=2, min_windows=8, recent=2,
+                 collapse_frac=0.5, floor=0.0, horizon_s=30.0,
+                 factor=3.0, cooldown_s=30.0):
+        assert name in DETECTOR_SCHEMA, (
+            f"unknown detector {name!r} — add it to anomaly."
+            "DETECTOR_SCHEMA and the docs/OBSERVABILITY.md detector "
+            "table (the mirror test pins the two)")
+        skey, smethod, _ = DETECTOR_SCHEMA[name]
+        self.name = name
+        self.key = key or skey
+        self.method = method or smethod
+        self.z_thresh = float(z_thresh)
+        self.min_rel = float(min_rel)
+        self.sustain = int(sustain)
+        self.min_windows = int(min_windows)
+        self.recent = int(recent)
+        self.collapse_frac = float(collapse_frac)
+        self.floor = float(floor)
+        self.horizon_s = float(horizon_s)
+        self.factor = float(factor)
+        self.cooldown_s = float(cooldown_s)
+        self._hits = 0          # consecutive checks the condition held
+
+    def evaluate(self, series, *, context=None):
+        """One check against the bound series. Returns None (quiet) or
+        the evidence dict of a CONDITION HIT; the engine applies the
+        sustain count and cooldown on top."""
+        means = series.window_means()
+        if self.method == "level":
+            return self._eval_level(series, context or {})
+        if len(means) < self.min_windows:
+            return None
+        values = [v for _, v in means]
+        if self.method == "drift":
+            # baseline = the OLDEST half of the ring: a gradual ramp
+            # must not chase its own baseline (median over the full
+            # history follows the drift — the classic slow-drift
+            # evasion). The ring still turns over, so a PERMANENT new
+            # plateau re-baselines in n_windows — an anomaly is a
+            # change, not a level. Noise is estimated from the
+            # baseline's FIRST DIFFERENCES (MAD/sqrt(2)): a drift that
+            # began inside the baseline would inflate a plain value-MAD
+            # and read its own trend as noise, suppressing the very z
+            # it should raise (found live: rel_rise 1.26 at z 2.9).
+            base = values[:max(1, (len(values) - self.recent) // 2)]
+            recent = values[-self.recent:]
+            cur = sum(recent) / len(recent)
+            med = sorted(base)[len(base) // 2]
+            if len(base) >= 3:
+                diffs = sorted(abs(b - a)
+                               for a, b in zip(base, base[1:]))
+                noise = 1.4826 * diffs[len(diffs) // 2] / math.sqrt(2.0)
+            else:
+                noise = 0.0
+            scale = max(noise, 0.05 * abs(med), 1e-9)
+            z = (cur - med) / scale
+            rel = (cur - med) / med if med > 0 else 0.0
+            if z >= self.z_thresh and rel >= self.min_rel \
+                    and cur >= self.floor:
+                return {"value": cur, "baseline": med, "z": round(z, 2),
+                        "rel_rise": round(rel, 4),
+                        "threshold": round(self.z_thresh, 2)}
+            return None
+        if self.method == "trend":
+            slope = ls_slope(means)
+            cur = values[-1]
+            if cur < self.floor:
+                return None
+            growth = slope * self.horizon_s
+            if slope > 0 and growth >= self.min_rel * max(cur, 1e-9):
+                return {"value": cur, "slope_per_s": round(slope, 4),
+                        "projected_rise": round(growth, 2),
+                        "threshold": round(self.min_rel * cur, 2)}
+            return None
+        if self.method == "collapse":
+            base, recent = values[:-self.recent], values[-self.recent:]
+            if not base:
+                return None
+            med = sorted(base)[len(base) // 2]
+            cur = sum(recent) / len(recent)
+            if med >= self.floor and med > 0 \
+                    and cur <= self.collapse_frac * med:
+                return {"value": cur, "baseline": med,
+                        "threshold": round(self.collapse_frac * med, 4),
+                        "collapse_frac": self.collapse_frac}
+            return None
+        raise AssertionError(f"unknown method {self.method!r}")
+
+    def _eval_level(self, series, context):
+        cur = series.last()
+        if cur is None:
+            return None
+        if self.name == "heartbeat_creep":
+            # the shared stall-threshold RULE at a strictly smaller
+            # factor: the stall tier declares death at
+            # max(stall_floor, 10 x median step); this detector warns at
+            # max(floor, 3 x median step) over the SAME median — earlier
+            # by construction, whatever the model scale. The median
+            # comes from the step_time series when one is fed (the
+            # router feeds both), else from context.
+            med_ms = context.get("median_step_ms")
+            if med_ms is None:
+                st = context.get("step_series")
+                med_ms = st.quantile(0.5) if st is not None \
+                    and st.count else None
+            if med_ms is None:
+                return None
+            thr = stall_threshold_secs(self.floor, med_ms / 1e3,
+                                       factor=self.factor)
+            if cur > thr:
+                return {"value": round(cur, 4),
+                        "threshold": round(thr, 4),
+                        "median_step_ms": round(med_ms, 3),
+                        "factor": self.factor}
+            return None
+        # generic level: windowed RATE above floor (io_retry_rate:
+        # retries/sec). The window SUM over window_s — the per-window
+        # MEAN of per-check deltas would divide the true rate by the
+        # caller's check frequency and never fire under a fast loop
+        s_sum = series.last_window_sum()
+        if s_sum is None:
+            return None
+        rate = s_sum / max(series.window_s, 1e-9)
+        if self.floor > 0 and rate >= self.floor:
+            return {"value": round(rate, 4), "threshold": self.floor,
+                    "unit": "per_s"}
+        return None
+
+
+def default_detectors(**overrides):
+    """One Detector per DETECTOR_SCHEMA row, with per-detector knob
+    overrides ({name: {knob: value}}). The defaults encode the shipped
+    policy documented in docs/OBSERVABILITY.md."""
+    base = {
+        # drift floors are RELATIVE rises over a robust baseline: a
+        # z-score alone would fire on tight baselines where a few
+        # percent of jitter is many MADs — min_rel is the no-flapping
+        # floor (the steady-run zero-anomaly pin leans on it)
+        "step_time_drift": dict(z_thresh=4.0, min_rel=0.35, sustain=2),
+        "ttft_drift": dict(z_thresh=4.0, min_rel=0.75, sustain=3),
+        "tpot_drift": dict(z_thresh=4.0, min_rel=0.75, sustain=3),
+        # trend: only a backlog BOTH above the absolute floor (ms) and
+        # projected to double within horizon_s alerts — transient
+        # sawtooth waits under a healthy fleet never do
+        "queue_wait_trend": dict(min_rel=1.0, sustain=3, floor=100.0,
+                                 horizon_s=10.0, min_windows=6),
+        "accept_rate_collapse": dict(collapse_frac=0.5, floor=0.1,
+                                     min_windows=8, sustain=2),
+        "heartbeat_creep": dict(floor=0.25, factor=3.0, sustain=2),
+        "io_retry_rate": dict(floor=1.0, sustain=2),
+    }
+    for name, kw in (overrides or {}).items():
+        base.setdefault(name, {}).update(kw)
+    return [Detector(name, **kw) for name, kw in base.items()]
+
+
+class AnomalyEngine:
+    """The detector table over a SeriesStore, with the four-way audit
+    emission per fire (counter + record + trace event + flight dump).
+
+    Drive it by observing signals (`observe(key, value)`, or the
+    `observe_finished` helper for serve terminal records) and calling
+    `check()` at loop cadence — checks are internally paced to
+    `check_interval_s` so a hot loop pays one clock read per call
+    between checks. Everything is injectable (clock, registry, sink,
+    tracer) so the detection-latency pins run on driven time."""
+
+    def __init__(self, *, registry=None, sink=None, tracer=None,
+                 clock=None, detectors=None, window_s=1.0, n_windows=64,
+                 check_interval_s=None, max_dumps=16, params=None):
+        self.clock = clock if clock is not None else time.perf_counter
+        self._reg = registry if registry is not None else get_registry()
+        self._sink = sink
+        self.tracer = tracer
+        self.store = SeriesStore(clock=self.clock, window_s=window_s,
+                                 n_windows=n_windows)
+        if hasattr(self._reg, "attach_series_store"):
+            # run_end snapshots carry these series' sketches, so a
+            # report reads p50/p99 from the artifact, not re-derived
+            self._reg.attach_series_store(self.store)
+        self.detectors = (detectors if detectors is not None
+                          else default_detectors(**(params or {})))
+        self.check_interval_s = (float(check_interval_s)
+                                 if check_interval_s is not None
+                                 else float(window_s))
+        self.max_dumps = int(max_dumps)
+        self._n_dumps = 0
+        self._last_check = None
+        self._last_fire = {}     # detector name -> t of last emission
+        self._counters_seen = {}  # counter key -> last total (rates)
+        self.fired = []          # every emitted anomaly dict (host log)
+
+    # -- feeding --
+
+    def observe(self, key, value, t=None):
+        self.store.observe(key, value, t=t)
+
+    def observe_finished(self, finished, t=None):
+        """Feed serve terminal records: TTFT/TPOT series (the drift
+        detectors' inputs). Refusals carry no latency and are the SLO
+        tier's business, not a latency drift's."""
+        for f in finished:
+            if getattr(f, "ttft_ms", None) is not None:
+                self.store.observe("ttft_ms", f.ttft_ms, t=t)
+            if getattr(f, "n_out", 0) > 1:
+                self.store.observe("tpot_ms", f.tpot_ms, t=t)
+
+    def observe_counter_rate(self, key, t=None):
+        """Feed a counter's per-check DELTA into its series (io_retries
+        and friends: rates drift, totals only grow)."""
+        total = self._reg.counter(key).total
+        seen = self._counters_seen.get(key, total)
+        self._counters_seen[key] = total
+        if total > seen:
+            self.store.observe(key, total - seen, t=t)
+            return total - seen
+        # an explicit zero sample keeps the window honest (a quiet
+        # stretch must pull the rate down, not freeze it)
+        self.store.observe(key, 0.0, t=t)
+        return 0.0
+
+    # -- checking --
+
+    def check(self, now=None, context=None):
+        """Evaluate every detector whose series has data; returns the
+        list of anomalies EMITTED this check (cooldown-suppressed hits
+        are counted, not returned). Paced: calls inside
+        check_interval_s of the last check return [] after one clock
+        read."""
+        now = self.clock() if now is None else now
+        if self._last_check is not None \
+                and now - self._last_check < self.check_interval_s:
+            return []
+        self._last_check = now
+        ctx = dict(context or {})
+        ctx.setdefault("step_series", self.store.get("step_time_ms"))
+        out = []
+        for det in self.detectors:
+            s = self.store.get(det.key)
+            if s is None or s.count == 0:
+                det._hits = 0
+                continue
+            s.flush(now)
+            hit = det.evaluate(s, context=ctx)
+            if hit is None:
+                det._hits = 0
+                continue
+            det._hits += 1
+            if det._hits < det.sustain:
+                continue
+            last = self._last_fire.get(det.name)
+            if last is not None and now - last < det.cooldown_s:
+                self._reg.counter("anomalies_suppressed").add(1)
+                continue
+            self._last_fire[det.name] = now
+            out.append(self._emit(det, hit, now))
+        self._refresh_gauges()
+        return out
+
+    def _refresh_gauges(self):
+        for key, gkey in _P99_GAUGE.items():
+            s = self.store.get(key)
+            if s is not None and s.count:
+                self._reg.gauge(gkey).set(s.quantile(0.99))
+
+    def _emit(self, det, hit, now):
+        """The four-way audit trail, atomically per anomaly: counter +
+        JSONL record + trace event (-> Perfetto/fleet_report) + flight
+        dump. Mirrors the autoscaler's _decide discipline."""
+        self._reg.counter("anomaly").add(1)
+        rec = {"detector": det.name, "key": det.key,
+               "method": det.method, **hit}
+        if self._sink is not None:
+            self._sink.write({"kind": "anomaly", "t": time.time(),
+                              "ts": now, **rec})
+        tr = self.tracer
+        dump = None
+        if tr is not None:
+            tr.emit(None, "anomaly", t=now, **rec)
+            if self._n_dumps < self.max_dumps:
+                # flight-anomaly-<detector>-NNN.jsonl: the last-N
+                # events BEFORE the degradation became a death — the
+                # early-warning capture (never raises; None without an
+                # out_dir, same policy as the watchdog's dump)
+                dump = tr.flight_dump(f"anomaly-{det.name}")
+                if dump is not None:
+                    self._n_dumps += 1
+        anomaly = {"t": now, **rec, "flight_dump": dump}
+        self.fired.append(anomaly)
+        return anomaly
+
+
+__all__ = [
+    "DETECTOR_SCHEMA", "Detector", "AnomalyEngine", "default_detectors",
+    "robust_z", "ls_slope",
+]

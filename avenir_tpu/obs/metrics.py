@@ -111,6 +111,47 @@ METRIC_SCHEMA = {
     # -- watchdog --
     "watchdog_stalls": (
         "counter", "1", "stall-watchdog warnings fired"),
+    # -- fleet health engine (obs/series.py + obs/anomaly.py, ISSUE 14) --
+    "anomaly": (
+        "counter", "1",
+        "anomalies fired by the detector table (obs/anomaly.py): each "
+        "is simultaneously this counter, an `anomaly` JSONL record, an "
+        "`anomaly` trace event with its evidence attrs, and a flight-"
+        "recorder dump (flight-anomaly-*.jsonl) — the early-warning "
+        "tier BEFORE the watchdog/SLO tiers react"),
+    "anomalies_suppressed": (
+        "counter", "1",
+        "detector firings swallowed by the per-detector cooldown (an "
+        "ongoing incident re-fires once per cooldown_s, not per check "
+        "— O(log) alert volume, never silent: the suppression is "
+        "counted here)"),
+    "step_time_ms": (
+        "hist", "ms",
+        "per-step wall time observed by the fleet health series layer "
+        "(train window dt; serve replica step walls) — the step-time "
+        "drift detector's input signal"),
+    "queue_wait_ms": (
+        "hist", "ms",
+        "age of the OLDEST router-queued request, sampled per fleet "
+        "step when the health engine is armed — the queue-wait trend "
+        "detector's input (a rising series is a backlog forming before "
+        "any SLO miss lands)"),
+    "step_time_p99_ms": (
+        "gauge", "ms",
+        "p99 of the step_time_ms series sketch (obs/series."
+        "QuantileSketch; refreshed at anomaly-check cadence)"),
+    "ttft_p99_ms": (
+        "gauge", "ms",
+        "p99 TTFT from the health engine's streaming sketch — the "
+        "same number obs_report derives, refreshed live at check "
+        "cadence instead of post-hoc"),
+    "tpot_p99_ms": (
+        "gauge", "ms",
+        "p99 TPOT from the health engine's streaming sketch (see "
+        "ttft_p99_ms)"),
+    "queue_wait_p99_ms": (
+        "gauge", "ms",
+        "p99 of the queue_wait_ms series sketch (see queue_wait_ms)"),
     # -- request tracing / flight recorder (obs/trace.py, ISSUE 10) --
     "trace_events_dropped": (
         "counter", "1",
@@ -431,6 +472,8 @@ class MetricsRegistry:
         self._schema = schema
         self._lock = threading.Lock()
         self._metrics = {}
+        self._series_store = None  # lazy (obs/series.SeriesStore)
+        self._extra_series = []    # attached stores (anomaly engine)
 
     def _get(self, key, kind, cls):
         assert key in self._schema, (
@@ -457,6 +500,45 @@ class MetricsRegistry:
 
     def hist(self, key):
         return self._get(key, "hist", Histogram)
+
+    def series(self, key, **kw):
+        """Opt a declared metric into a windowed time-series (ISSUE 14:
+        ring-buffered per-window aggregates + a mergeable streaming
+        percentile sketch, obs/series.py). Any schema key qualifies
+        whatever its kind — a series is a VIEW over the signal, not a
+        second metric — but an undeclared key fails loud exactly like
+        counter()/gauge()/hist(). Lazily built: a run that never calls
+        this pays nothing."""
+        with self._lock:
+            if self._series_store is None:
+                from avenir_tpu.obs.series import SeriesStore
+
+                self._series_store = SeriesStore(schema=self._schema)
+        assert key in self._schema, (
+            f"series key {key!r} is not declared in METRIC_SCHEMA — add "
+            "it there AND to the docs/OBSERVABILITY.md table")
+        return self._series_store.series(key, **kw)
+
+    def attach_series_store(self, store):
+        """Adopt an externally built obs/series.SeriesStore (the
+        anomaly engine's, which needs its own clock/window config) so
+        series_snapshot() — and therefore run_end records — sees its
+        series alongside any opted in via series()."""
+        with self._lock:
+            self._extra_series.append(store)
+
+    def series_snapshot(self):
+        """{key: series snapshot} for every opted-in series (empty when
+        none) — rides run_end records so reports read percentiles from
+        the sketch instead of re-deriving them."""
+        out = {}
+        stores = ([self._series_store] if self._series_store is not None
+                  else [])
+        with self._lock:
+            stores = stores + list(self._extra_series)
+        for st in stores:
+            out.update(st.snapshot())
+        return out
 
     def counters(self):
         """Counters-only view ({key: total}) — the per-iter record's

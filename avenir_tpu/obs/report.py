@@ -19,8 +19,12 @@ CLI wrapper: tools/obs_report.py. Library entry: summarize(records).
 """
 
 import json
-import math
 import statistics
+
+# the ONE quantile rule (ISSUE 14): exact nearest-rank for small lists
+# lives in obs/series.py beside the streaming sketch; re-exported here
+# because every report/bench historically imported it from this module
+from avenir_tpu.obs.series import QuantileSketch, percentile  # noqa: F401
 
 
 def load_records_with_skips(path):
@@ -106,10 +110,26 @@ def summarize(records, *, skipped_lines=()):
     retries = _by_kind(records, "retry")
     restores = _by_kind(records, "restore")
     requests = _by_kind(records, "request")
+    anomalies = _by_kind(records, "anomaly")
+
+    # ISSUE 14: run_end carries the health engine's series sketches —
+    # percentiles come from THE sketch, not re-derived from raw records
+    # (the one quantile rule); raw per-request records stay the fallback
+    def sketch_q(key, q):
+        d = ((end.get("series") or {}).get(key) or {}).get("sketch")
+        if not d:
+            return None
+        sk = QuantileSketch.from_dict(d)
+        return sk.quantile(q) if sk.count else None
+
     serve = None
     if requests:
         ttfts = [r["ttft_ms"] for r in requests if "ttft_ms" in r]
         tpots = [r["tpot_ms"] for r in requests if "tpot_ms" in r]
+        sk_ttft50 = sketch_q("ttft_ms", 0.50)
+        sk_ttft99 = sketch_q("ttft_ms", 0.99)
+        sk_tpot50 = sketch_q("tpot_ms", 0.50)
+        sk_tpot99 = sketch_q("tpot_ms", 0.99)
         # run_end counters when the run exited cleanly; a torn log (the
         # exact case load_records tolerates) still has per-request n_out
         tokens_out = (counters.get("tokens_out")
@@ -135,10 +155,16 @@ def summarize(records, *, skipped_lines=()):
             "tokens_out": tokens_out,
             "goodput_tok_per_sec": (tokens_out / (total_ms / 1e3)
                                     if total_ms else None),
-            "ttft_p50_ms": percentile(ttfts, 0.50),
-            "ttft_p99_ms": percentile(ttfts, 0.99),
-            "tpot_p50_ms": percentile(tpots, 0.50),
-            "tpot_p99_ms": percentile(tpots, 0.99),
+            "ttft_p50_ms": (sk_ttft50 if sk_ttft50 is not None
+                            else percentile(ttfts, 0.50)),
+            "ttft_p99_ms": (sk_ttft99 if sk_ttft99 is not None
+                            else percentile(ttfts, 0.99)),
+            "tpot_p50_ms": (sk_tpot50 if sk_tpot50 is not None
+                            else percentile(tpots, 0.50)),
+            "tpot_p99_ms": (sk_tpot99 if sk_tpot99 is not None
+                            else percentile(tpots, 0.99)),
+            "latency_source": ("sketch" if sk_ttft50 is not None
+                               else "records"),
             # paged KV (ISSUE 9): chunk counter from counters, pool
             # pressure from the run_end record's gauge snapshot (when
             # the bench wrote one — gauges are points, not totals)
@@ -153,9 +179,24 @@ def summarize(records, *, skipped_lines=()):
             "spec_accepted": counters.get("spec_accepted", 0.0),
             "kv_dtype_bits": (end.get("gauges") or {}).get("kv_dtype"),
         }
+    by_detector = {}
+    for r in anomalies:
+        d = r.get("detector", "?")
+        by_detector[d] = by_detector.get(d, 0) + 1
     return {
         "serve": serve,
         "meta": meta,
+        # fleet health engine (ISSUE 14): the early-warning tier's
+        # activity — counter totals when the run ended cleanly, the
+        # per-event records cover killed runs too (the io_retries rule)
+        "anomalies": {
+            "n": max(int(counters.get("anomaly", 0.0)), len(anomalies)),
+            "suppressed": counters.get("anomalies_suppressed", 0.0),
+            "by_detector": by_detector,
+            "first_t": min((r["t"] for r in anomalies), default=None),
+            "last_t": max((r["t"] for r in anomalies), default=None),
+            "t0": t0,
+        },
         "skipped_lines": list(skipped_lines),
         "n_segments": n_segments,
         "total_ms": total_ms,
@@ -186,16 +227,6 @@ def summarize(records, *, skipped_lines=()):
         "n_restores": len(restores),
         "restore_fallbacks": sum(r.get("skipped_bad", 0) for r in restores),
     }
-
-
-def percentile(xs, q):
-    """Exact nearest-rank percentile (index ceil(q*n)-1) of a small
-    list (serve benches run tens-to-thousands of requests — no ring
-    needed here). Returns None on empty input."""
-    if not xs:
-        return None
-    s = sorted(xs)
-    return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
 
 
 def _fmt_ms(ms):
@@ -270,6 +301,17 @@ def format_report(s):
             "fallback(s) to an older generation — check the storage")
     if s["n_stalls"]:
         extras.append(f"WATCHDOG STALL WARNINGS: {s['n_stalls']}")
+    an = s.get("anomalies") or {}
+    if an.get("n"):
+        bits = [f"{k}={v}" for k, v in sorted(an["by_detector"].items())]
+        line = (f"ANOMALIES: {an['n']:.0f}"
+                + (f" ({', '.join(bits)})" if bits else ""))
+        if an.get("first_t") is not None and an.get("t0"):
+            line += (f"  first +{an['first_t'] - an['t0']:.1f}s"
+                     f"  last +{an['last_t'] - an['t0']:.1f}s")
+        if an.get("suppressed"):
+            line += f"  [{an['suppressed']:.0f} suppressed by cooldown]"
+        extras.append(line)
     if extras:
         lines.append("")
         lines += ["  " + e for e in extras]
@@ -302,12 +344,14 @@ def format_report(s):
         fleet_bits = [b for b in fleet_bits if b]
         if fleet_bits:
             lines.append("  fleet: " + "   ".join(fleet_bits))
+        src = (" (run_end sketch)" if sv.get("latency_source") == "sketch"
+               else "")
         if sv["ttft_p50_ms"] is not None:
             lines.append(f"  ttft: p50 {sv['ttft_p50_ms']:.1f} ms  "
-                         f"p99 {sv['ttft_p99_ms']:.1f} ms")
+                         f"p99 {sv['ttft_p99_ms']:.1f} ms{src}")
         if sv["tpot_p50_ms"] is not None:
             lines.append(f"  tpot: p50 {sv['tpot_p50_ms']:.2f} ms  "
-                         f"p99 {sv['tpot_p99_ms']:.2f} ms")
+                         f"p99 {sv['tpot_p99_ms']:.2f} ms{src}")
         if sv.get("prefill_chunks") or sv.get("kv_page_util") is not None:
             bits = [f"chunks {sv['prefill_chunks']:.0f}"]
             if sv.get("kv_page_util") is not None:

@@ -1,0 +1,402 @@
+"""Streaming metric series: mergeable percentile sketches, windowed
+time-series, and the ONE quantile/stall-threshold rule (ISSUE 14
+tentpole, part 1).
+
+The registry (obs/metrics.py) answers "how much, in aggregate"; the
+anomaly engine (obs/anomaly.py) needs "how is this signal MOVING" —
+which takes a bounded history per signal, not a cumulative total. Three
+pieces, all stdlib, all fixed-memory:
+
+- **QuantileSketch** — a DDSketch-style log-bucketed quantile sketch:
+  relative-error-bounded quantiles (|q_est - q_true| <= alpha * q_true
+  for any quantile of positive values), O(max_bins) memory however long
+  the stream, and MERGEABLE — bucket counts add, so process-worker
+  sketches ship in step replies as bucket DELTAS and merge parent-side
+  exactly like the counter deltas serve/proc.py already mirrors
+  (`take_delta()`/`merge_dict()` are that wire form). This replaces the
+  ad-hoc per-tool percentile code paths: serve_bench and obs_report now
+  read p50/p99 from one sketch instead of re-deriving them from raw
+  lists, and the `run_end` record carries sketch snapshots so a report
+  never needs the per-request records at all.
+
+- **Series** — a windowed time-series over one signal: a ring of
+  per-window aggregates (count/sum/min/max/mean over `window_s`-second
+  windows, `n_windows` deep) plus a QuantileSketch over the whole
+  stream. The per-window means are what the anomaly detectors consume
+  (drift and trend live at window granularity, not per-event), and the
+  ring bounds memory the same way the flight recorder's ring does.
+
+- **Shared rules** — `percentile()` (the exact nearest-rank rule every
+  report uses; moved here from obs/report.py, which re-exports it) and
+  `stall_threshold_secs()`: `max(floor, factor x median)` — previously
+  duplicated between obs/watchdog.py and serve/replica.py, now ONE
+  function both import (the ISSUE 14 consolidation satellite, same move
+  as SLOEngine's shared `request_met_slo`).
+"""
+
+import math
+import time
+from collections import deque
+
+# ---------------------------------------------------------------------------
+# The one stall-threshold rule (watchdog + replica health share it)
+# ---------------------------------------------------------------------------
+
+
+def stall_threshold_secs(floor_secs, median_secs, factor=10.0):
+    """THE stall-threshold rule: `max(floor, factor x median completed
+    step/window time)` — scale-free from ms CPU smokes to tens-of-
+    seconds pod windows. obs/watchdog.py (training windows) and
+    serve/replica.py (replica heartbeats) both delegate here; the
+    anomaly engine's heartbeat-creep detector fires at a SMALLER factor
+    of the same median, which is what makes "strictly before the stall
+    tier" a property of the rule rather than of tuning luck."""
+    return max(float(floor_secs), float(factor) * float(median_secs))
+
+
+# ---------------------------------------------------------------------------
+# The one exact small-n quantile rule (reports, benches)
+# ---------------------------------------------------------------------------
+
+
+def percentile(xs, q):
+    """Exact nearest-rank percentile (index ceil(q*n)-1) of a small
+    list. Returns None on empty input. `percentile(xs, 0.5)` equals
+    `median_low` by construction (both return the lower-middle
+    ELEMENT), so benches that switched here from statistics.median_low
+    report bit-identical headlines."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
+
+
+# ---------------------------------------------------------------------------
+# Mergeable streaming quantile sketch
+# ---------------------------------------------------------------------------
+
+
+class QuantileSketch:
+    """DDSketch-style log-bucketed quantile sketch.
+
+    Positive values land in bucket `ceil(log_gamma(v))` with
+    gamma = (1 + alpha) / (1 - alpha); the bucket's representative value
+    `2 * gamma^k / (gamma + 1)` is within relative error `alpha` of
+    every value the bucket holds, so any quantile estimate is within
+    `alpha` relative error of an exact rank statistic — the bound the
+    sketch-vs-numpy agreement tests assert. Zero/negative values (a
+    0.0 ms wait is real) count in a dedicated zero bucket.
+
+    Fixed memory: beyond `max_bins` distinct buckets the LOWEST buckets
+    collapse into one (tail quantiles — the p99s operators alert on —
+    keep their error bound; the collapsed low end degrades first, by
+    design). Mergeable: bucket counts add (`merge`), and
+    `take_delta()` returns the counts since the last take — the wire
+    form a worker ships in its step replies so the parent-side sketch
+    equals one built from the raw stream (tests pin merge-of-deltas ==
+    direct)."""
+
+    def __init__(self, alpha=0.01, max_bins=512):
+        assert 0.0 < alpha < 1.0
+        self.alpha = float(alpha)
+        self.gamma = (1.0 + self.alpha) / (1.0 - self.alpha)
+        self._lg = math.log(self.gamma)
+        self.max_bins = int(max_bins)
+        self.bins = {}        # bucket key -> count
+        self.zero = 0         # values <= 0 (latencies: exactly-0 waits)
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+        self._shipped = None  # last take_delta() snapshot
+
+    # -- write --
+
+    def observe(self, v):
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        if v <= 0.0:
+            self.zero += 1
+            return
+        k = math.ceil(math.log(v) / self._lg)
+        self.bins[k] = self.bins.get(k, 0) + 1
+        if len(self.bins) > self.max_bins:
+            self._collapse()
+
+    def _collapse(self):
+        """Fold the two lowest buckets together until under max_bins —
+        the low tail loses resolution, the operator-facing high tail
+        never does."""
+        keys = sorted(self.bins)
+        while len(self.bins) > self.max_bins:
+            k0, k1 = keys[0], keys[1]
+            self.bins[k1] += self.bins.pop(k0)
+            keys = keys[1:]
+
+    # -- read --
+
+    def _bucket_value(self, k):
+        return 2.0 * (self.gamma ** k) / (self.gamma + 1.0)
+
+    def quantile(self, q):
+        """Value at quantile q in [0, 1]; None when empty. Exact-rank
+        semantics over buckets: the bucket holding the ceil(q*n)-th
+        smallest observation answers, via its representative value."""
+        if self.count == 0:
+            return None
+        rank = min(self.count, max(1, math.ceil(q * self.count)))
+        if rank <= self.zero:
+            return 0.0
+        acc = self.zero
+        for k in sorted(self.bins):
+            acc += self.bins[k]
+            if acc >= rank:
+                return self._bucket_value(k)
+        return self.max  # numeric-slop fallback; unreachable in theory
+
+    def summary(self, qs=(0.50, 0.95, 0.99)):
+        out = {"count": self.count, "sum": self.sum,
+               "min": self.min, "max": self.max}
+        for q in qs:
+            out[f"p{int(q * 100)}"] = self.quantile(q)
+        return out
+
+    # -- merge / wire form --
+
+    def merge(self, other):
+        """Fold another sketch (same alpha) into this one in place."""
+        assert abs(other.gamma - self.gamma) < 1e-12, (
+            "merging sketches with different alpha would silently "
+            "mis-bucket — build both ends with the same resolution")
+        for k, c in other.bins.items():
+            self.bins[k] = self.bins.get(k, 0) + c
+        self.zero += other.zero
+        self.count += other.count
+        self.sum += other.sum
+        for v in (other.min, other.max):
+            if v is not None:
+                self.min = v if self.min is None else min(self.min, v)
+                self.max = v if self.max is None else max(self.max, v)
+        if len(self.bins) > self.max_bins:
+            self._collapse()
+        return self
+
+    def to_dict(self):
+        """JSON-serializable snapshot (the run_end form)."""
+        return {"alpha": self.alpha, "zero": self.zero,
+                "count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max,
+                "bins": {str(k): c for k, c in self.bins.items()}}
+
+    @classmethod
+    def from_dict(cls, d, max_bins=512):
+        sk = cls(alpha=float(d.get("alpha", 0.01)), max_bins=max_bins)
+        sk.zero = int(d.get("zero", 0))
+        sk.count = int(d.get("count", 0))
+        sk.sum = float(d.get("sum", 0.0))
+        sk.min = d.get("min")
+        sk.max = d.get("max")
+        sk.bins = {int(k): int(c) for k, c in (d.get("bins")
+                                               or {}).items()}
+        return sk
+
+    def take_delta(self):
+        """Bucket counts since the last take — the per-step-reply wire
+        form (serve/worker.py ships it, serve/proc.py merge_dict()s it
+        parent-side, exactly like the engine counter deltas). Returns
+        None when nothing new landed."""
+        cur = self.to_dict()
+        prev = self._shipped
+        self._shipped = cur
+        if prev is None:
+            return cur if cur["count"] else None
+        if cur["count"] == prev["count"]:
+            return None
+        d = {"alpha": self.alpha,
+             "zero": cur["zero"] - prev["zero"],
+             "count": cur["count"] - prev["count"],
+             "sum": cur["sum"] - prev["sum"],
+             # min/max of the delta window are unknowable from
+             # snapshots; ship the lifetime ones (merge keeps min/max
+             # correct because they are monotone under observation)
+             "min": cur["min"], "max": cur["max"],
+             "bins": {}}
+        prev_bins = prev["bins"]
+        for k, c in cur["bins"].items():
+            dc = c - prev_bins.get(k, 0)
+            if dc:
+                d["bins"][k] = dc
+        return d
+
+    def merge_dict(self, d):
+        """Fold a to_dict()/take_delta() payload into this sketch (the
+        parent side of the heartbeat shipping)."""
+        if not d:
+            return self
+        return self.merge(QuantileSketch.from_dict(d,
+                                                   max_bins=self.max_bins))
+
+
+# ---------------------------------------------------------------------------
+# Windowed time-series
+# ---------------------------------------------------------------------------
+
+
+class Series:
+    """One signal's bounded history: per-window aggregates (ring) + a
+    lifetime QuantileSketch.
+
+    `observe(v, t=None)` files the value into the current `window_s`
+    window; when t crosses a window boundary the finished window's
+    aggregate enters the ring (oldest evicted past `n_windows`). The
+    detectors read `window_means()` — drift/trend live at window
+    granularity — and the sketch answers p50/p99 for the per-series
+    gauges and the run_end snapshot."""
+
+    __slots__ = ("key", "window_s", "n_windows", "clock", "sketch",
+                 "_win", "_ring")
+
+    def __init__(self, key, *, window_s=1.0, n_windows=64, clock=None,
+                 alpha=0.01):
+        self.key = key
+        self.window_s = float(window_s)
+        self.n_windows = int(n_windows)
+        self.clock = clock if clock is not None else time.perf_counter
+        self.sketch = QuantileSketch(alpha=alpha)
+        self._win = None           # [start, count, sum, min, max]
+        self._ring = deque(maxlen=self.n_windows)
+
+    def observe(self, v, t=None):
+        v = float(v)
+        t = self.clock() if t is None else float(t)
+        self.sketch.observe(v)
+        w = self._win
+        if w is None:
+            self._win = [t, 1, v, v, v]
+            return
+        if t - w[0] >= self.window_s:
+            self._roll(t)
+            self._win = [self._win[0], 1, v, v, v]
+            return
+        w[1] += 1
+        w[2] += v
+        w[3] = min(w[3], v)
+        w[4] = max(w[4], v)
+
+    def _roll(self, t):
+        """Close the current window into the ring and open the one
+        containing `t` (empty windows — between-gap ones AND a
+        just-flushed still-empty current — are dropped, never ringed:
+        a count-0 window's inf/-inf min/max would poison the snapshot
+        JSON, and a gap in the signal should read as a time gap, not
+        phantom zeros)."""
+        w = self._win
+        if w[1] > 0:
+            self._ring.append((w[0], w[1], w[2], w[3], w[4]))
+        n_ahead = math.floor((t - w[0]) / self.window_s)
+        self._win = [w[0] + n_ahead * self.window_s, 0, 0.0,
+                     math.inf, -math.inf]
+
+    def flush(self, now=None):
+        """Force the open window into the ring (detectors run at check
+        cadence, which need not align with window boundaries)."""
+        now = self.clock() if now is None else now
+        if self._win is not None and self._win[1] > 0 \
+                and now - self._win[0] >= self.window_s:
+            self._roll(now)
+
+    # -- read --
+
+    @property
+    def count(self):
+        return self.sketch.count
+
+    def last(self):
+        if self._win is not None and self._win[1] > 0:
+            return self._win[2] / self._win[1]
+        if self._ring:
+            _, n, s, _, _ = self._ring[-1]
+            return s / n if n else None
+        return None
+
+    def window_means(self, include_open=True):
+        """Per-window mean values, oldest first — the detector input."""
+        out = [(t0, s / n) for t0, n, s, _, _ in self._ring if n]
+        if include_open and self._win is not None and self._win[1] > 0:
+            out.append((self._win[0], self._win[2] / self._win[1]))
+        return out
+
+    def last_window_sum(self):
+        """SUM of the newest complete window (falling back to the open
+        one, then None). Rate detectors divide this by window_s — the
+        per-window mean would shrink with the caller's check frequency
+        and silently under-read a real event rate."""
+        if self._ring:
+            return self._ring[-1][2]
+        if self._win is not None and self._win[1] > 0:
+            return self._win[2]
+        return None
+
+    def quantile(self, q):
+        return self.sketch.quantile(q)
+
+    def snapshot(self):
+        return {"key": self.key, "window_s": self.window_s,
+                "sketch": self.sketch.to_dict(),
+                "windows": [[round(t0, 6), n, s, lo, hi]
+                            for t0, n, s, lo, hi in self._ring]}
+
+
+class SeriesStore:
+    """Keyed Series collection — the per-process home the anomaly
+    engine and the engines observe into. `schema` (default
+    METRIC_SCHEMA) gates keys the same way the registry does: a series
+    over an undeclared signal fails in tests, not in production."""
+
+    def __init__(self, *, schema=None, clock=None, window_s=1.0,
+                 n_windows=64, alpha=0.01):
+        if schema is None:
+            from avenir_tpu.obs.metrics import METRIC_SCHEMA
+
+            schema = METRIC_SCHEMA
+        self._schema = schema
+        self.clock = clock if clock is not None else time.perf_counter
+        self.window_s = float(window_s)
+        self.n_windows = int(n_windows)
+        self.alpha = float(alpha)
+        self._series = {}
+
+    def series(self, key, *, window_s=None):
+        s = self._series.get(key)
+        if s is None:
+            assert key in self._schema, (
+                f"series key {key!r} is not declared in METRIC_SCHEMA — "
+                "a series is a view over a declared metric signal (add "
+                "the key there AND to docs/OBSERVABILITY.md)")
+            s = self._series[key] = Series(
+                key, window_s=window_s or self.window_s,
+                n_windows=self.n_windows, clock=self.clock,
+                alpha=self.alpha)
+        return s
+
+    def observe(self, key, v, t=None):
+        self.series(key).observe(v, t=t)
+
+    def get(self, key):
+        return self._series.get(key)
+
+    def keys(self):
+        return list(self._series)
+
+    def snapshot(self):
+        """{key: series snapshot} — JSON-serializable (run_end)."""
+        return {k: s.snapshot() for k, s in self._series.items()}
+
+
+__all__ = [
+    "QuantileSketch", "Series", "SeriesStore", "percentile",
+    "stall_threshold_secs",
+]

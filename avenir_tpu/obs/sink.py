@@ -25,6 +25,9 @@ RECORD_KINDS = {
     "request",    # per finished serve-engine request: ttft/tpot/tokens
     "trace",      # one per-request trace event (obs/trace.py, --trace)
     "retry",      # per transient-IO retry (utils/retry.py): site + delay
+    "anomaly",    # per detector fire (obs/anomaly.py): detector, key,
+                  # value, threshold + the robust-statistic evidence
+                  # (the early-warning tier's durable record)
     "restore",    # per resume source decision: dir, kind, fallback count
     "run_end",    # one per run, at exit: final counter snapshot
 }

@@ -81,6 +81,11 @@ TRACE_EVENTS = {
                      # that triggered it (burn rate, attainment, queue
                      # wait) — the auditable control-plane trail
                      # (serve/autoscale.py, ISSUE 12)
+    "anomaly",       # one health-engine detector fire (rid=None):
+                     # detector/key/value/threshold + robust-statistic
+                     # evidence (obs/anomaly.py, ISSUE 14) — also a
+                     # flight dump trigger, so the recorder captures
+                     # the minutes BEFORE a degradation becomes a death
 }
 
 TERMINAL = "finish"

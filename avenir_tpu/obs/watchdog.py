@@ -106,11 +106,17 @@ class StallWatchdog:
                 self._consecutive = 0
 
     def threshold_secs(self):
+        # the ONE stall-threshold rule (obs/series.py, ISSUE 14): shared
+        # with serve/replica.py's heartbeat health check so the two
+        # stall tiers can never drift apart
+        from avenir_tpu.obs.series import stall_threshold_secs
+
         with self._lock:
             if not self._durations:
                 return self.floor_secs
-            return max(self.floor_secs,
-                       self.factor * statistics.median_low(self._durations))
+            return stall_threshold_secs(
+                self.floor_secs, statistics.median_low(self._durations),
+                factor=self.factor)
 
     def stop(self):
         self._stop.set()

@@ -159,7 +159,7 @@ class Engine:
                  prefill_chunk=None, prefix_sharing=True,
                  paged_attn_impl="auto", tracer=None, kv_dtype="bf16",
                  spec_decode="off", spec_k=4, draft_model=None,
-                 role="both"):
+                 role="both", health_series=False):
         """`kv_impl` (ISSUE 9, the attn_impl/loss_impl pattern):
         'slab' keeps the fixed per-slot KV columns (serve/slots.py);
         'paged' stores KV in a pool of `n_pages` blocks of `page_size`
@@ -212,7 +212,14 @@ class Engine:
         chunks, prefix hits, COW, first token, sampled decode ticks,
         evict, finish. None (the default) disables tracing: every
         emission site is a single `is not None` branch, so the hot
-        decode tick pays nothing measurable (tests/test_trace.py)."""
+        decode tick pays nothing measurable (tests/test_trace.py).
+
+        `health_series` (ISSUE 14): collect this engine's busy-step
+        walls into a mergeable obs/series.QuantileSketch
+        (`take_series_delta()` drains the bucket DELTAS — the wire
+        form a process worker ships in its step replies, merged
+        parent-side like the counter deltas). Off by default: the
+        disabled path is one `is None` branch per step."""
         # one clock for submit timestamps, TTFT/TPOT, and deadline
         # expiry — injectable so the deadline tests drive time instead
         # of sleeping through it
@@ -281,6 +288,11 @@ class Engine:
         self._pending = []  # rejected-at-submit records, flushed by step()
         self._tick_s = []   # recent decode-tick durations (clock secs)
         self._tr = tracer   # None = tracing off (the near-zero path)
+        self._hs = None     # None = health series off (ISSUE 14)
+        if health_series:
+            from avenir_tpu.obs.series import QuantileSketch
+
+            self._hs = QuantileSketch()
         self._tick_n = 0    # decode ticks ever, for trace sampling
         self._next_id = 0
         self._base_rng = jax.random.key(seed)
@@ -917,8 +929,33 @@ class Engine:
         """One scheduler iteration: expire, admit, one batched decode
         dispatch, harvest. Returns the requests that finished this
         iteration (including timeouts)."""
-        if self._paged is not None:
-            return self._step_paged()
+        hs = self._hs
+        if hs is None:  # the disabled-by-default cheap path (ISSUE 14)
+            if self._paged is not None:
+                return self._step_paged()
+            return self._step_slab()
+        had_work = self.open_work
+        t0 = self._clock()
+        out = (self._step_paged() if self._paged is not None
+               else self._step_slab())
+        if had_work:
+            # busy steps only — the _record_beat rule: idle no-ops
+            # would drag the sketch's median toward zero
+            hs.observe((self._clock() - t0) * 1e3)
+        return out
+
+    def take_series_delta(self):
+        """Health-series sketch deltas since the last take (ISSUE 14):
+        {series key: bucket-delta dict}, or None when the series is off
+        or nothing new landed — the per-step-reply wire form
+        (serve/worker.py ships it, serve/proc.py merges it into the
+        fleet registry's series exactly like counter deltas)."""
+        if self._hs is None:
+            return None
+        d = self._hs.take_delta()
+        return {"step_time_ms": d} if d else None
+
+    def _step_slab(self):
         state = self._state
         V = self.pool.logits.shape[-1]
         finished = self._pending

@@ -425,6 +425,13 @@ class ProcReplica(ReplicaHealth):
             self.engine.update(reply["hb"])
         if "counters" in reply:
             self._apply_counter_deltas(reply["counters"])
+        if reply.get("series"):
+            # health-series sketch deltas (ISSUE 14): bucket counts
+            # merge into the fleet registry's series the same way the
+            # counter deltas above mirror totals — the parent-side
+            # sketch equals one built from the worker's raw stream
+            for key, d in reply["series"].items():
+                self._reg.series(key).sketch.merge_dict(d)
         if reply.get("trace"):
             # restamp NOW, at arrival: age_s was measured against the
             # worker clock when the reply was built; parent_now - age is

@@ -98,10 +98,15 @@ class ReplicaHealth:
     # -- health --
 
     def stall_threshold_secs(self):
-        """obs/watchdog.py's threshold rule: max(floor, factor x median
-        completed-step time) — scale-free across model sizes."""
-        return max(self.stall_floor_secs,
-                   self.stall_factor * self.median_step_secs())
+        """The shared stall-threshold rule — max(floor, factor x median
+        completed-step time), scale-free across model sizes. ONE home
+        (obs/series.stall_threshold_secs, ISSUE 14) shared with
+        obs/watchdog.py so the two stall tiers can never drift apart."""
+        from avenir_tpu.obs.series import stall_threshold_secs
+
+        return stall_threshold_secs(self.stall_floor_secs,
+                                    self.median_step_secs(),
+                                    factor=self.stall_factor)
 
     def check_health(self, now):
         """Declare a silent stall: HOLDING WORK with no heartbeat within

@@ -124,7 +124,7 @@ class Router:
                  backend="inproc", model_spec=None, supervise=False,
                  respawn_policy=None, max_respawns=5, proc_kwargs=None,
                  engine_kwargs=None, tracer=None, draft_model=None,
-                 n_prefill=0, disagg_min_prompt=None):
+                 n_prefill=0, disagg_min_prompt=None, anomaly=None):
         """`weights`: dispatch shares per priority class (default
         interactive 4 : batch 1). `queue_limits`: max queued per class
         before shedding (default 16/64 x fleet slots). `clock` is shared
@@ -183,13 +183,23 @@ class Router:
         engine-local rids to fleet rids (process-backend events arrive
         as age deltas and are restamped on the fleet clock). None (the
         default) disables tracing end to end — replicas then build no
-        buffers and workers ship no trace frames."""
+        buffers and workers ship no trace frames.
+
+        `anomaly` (ISSUE 14): an obs/anomaly.py AnomalyEngine — the
+        fleet health tier. Each step the router feeds it replica step
+        walls, heartbeat age, oldest-queued wait, TTFT/TPOT of finished
+        requests, the spec accept rate and io_retries, then runs its
+        check: drifts/trends/collapses fire as `anomaly` counter +
+        record + trace event + flight dump BEFORE the stall/SLO tiers
+        react. None (the default) disables it — every consult is the
+        `tr is not None`-style single-branch guard, micro-pinned."""
         assert n_replicas >= 1
         assert backend in BACKENDS, f"unknown backend {backend!r}"
         self._clock = clock if clock is not None else time.perf_counter
         self._reg = registry if registry is not None else get_registry()
         self.sink = sink if sink is not None else NullSink()
         self.tracer = tracer
+        self._anomaly = anomaly  # None = fleet health engine off
         self.backend = backend
         self._supervisor = None
         # replica build recipe, retained so the autoscaler can grow the
@@ -535,8 +545,10 @@ class Router:
                         rep.last_beat += dt_sup
         self._expire_queued(now, finished)
         self._dispatch_all(now)
+        ae = self._anomaly  # the single-branch disabled guard (ISSUE 14)
         for rep in self.replicas:
             was_dead = rep.state == DEAD
+            was_busy = ae is not None and rep.busy
             t_before = self._clock()
             # median BEFORE the step: a fresh worker's first (compiling)
             # step otherwise becomes its own median, zeroing the slack
@@ -578,6 +590,11 @@ class Router:
                 for other in self.replicas:
                     if other is not rep and other.state != DEAD:
                         other.last_beat += slack
+            if was_busy and rep.state != DEAD:
+                # replica step walls feed the step-time drift detector
+                # (only BUSY steps, the same rule _record_beat applies
+                # to the stall-threshold median)
+                ae.observe("step_time_ms", dt * 1e3, t=self._clock())
             if rep.state == DEAD and not was_dead:
                 # died inside this step (serve_step_fail): nothing it
                 # held finished — requeue all of it right away
@@ -632,7 +649,36 @@ class Router:
                 sum(k[1] for k in kvs) / len(kvs))
             self._reg.gauge("prefix_hit_rate").set(
                 sum(k[2] for k in kvs) / len(kvs))
+        if ae is not None:
+            self._feed_anomaly(ae, finished)
         return finished
+
+    def _feed_anomaly(self, ae, finished):
+        """One fleet-step feed of the health engine (ISSUE 14): latency
+        series from this step's terminal records, the liveness signals
+        (heartbeat age, oldest-queued wait), the decode-quality and IO
+        signals, then the paced detector check. Caller holds the
+        `ae is not None` guard — a fleet without the engine never
+        reaches here."""
+        now = self._clock()
+        ae.observe_finished(finished, t=now)
+        alive = [r for r in self.replicas if r.state != DEAD]
+        if alive:
+            ae.observe("heartbeat_age_s",
+                       max(now - r.last_beat for r in alive), t=now)
+        oldest = None
+        for q in self._queues.values():
+            for req in q:
+                if oldest is None or req.submit_t < oldest:
+                    oldest = req.submit_t
+        ae.observe("queue_wait_ms",
+                   0.0 if oldest is None else (now - oldest) * 1e3,
+                   t=now)
+        rate = self._reg.gauge("spec_accept_rate").value
+        if rate is not None:
+            ae.observe("spec_accept_rate", rate, t=now)
+        ae.observe_counter_rate("io_retries", t=now)
+        ae.check(now)
 
     def drain(self, max_steps=None):
         """Step until every accepted request reached a terminal state.

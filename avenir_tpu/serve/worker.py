@@ -125,7 +125,8 @@ def _serve(stream):
     kv_kw = {k: ekw[k] for k in
              ("kv_impl", "page_size", "n_pages", "max_pages_per_seq",
               "prefill_chunk", "prefix_sharing", "paged_attn_impl",
-              "kv_dtype", "spec_decode", "spec_k", "role")
+              "kv_dtype", "spec_decode", "spec_k", "role",
+              "health_series")
              if ekw.get(k) is not None}
     # request tracing (ISSUE 10): the parent's hello flips this flag;
     # the engine collects lifecycle events in a bounded buffer and every
@@ -234,11 +235,17 @@ def _serve(stream):
                          if n >= 1 and pre.get(rid, 0) == 0]
                 first += [int(f.req_id) for f in finished
                           if f.n_out >= 1 and pre.get(int(f.req_id), 0) == 0]
+                # health-series sketch deltas (ISSUE 14): mergeable
+                # bucket counts since the last reply — the parent
+                # merges them into the fleet series exactly like the
+                # counter deltas below (None when the series is off)
+                series = engine.take_series_delta()
                 send({
                     "ok": True,
                     "finished": [_fin_dict(f) for f in finished],
                     "first": first,
                     "hb": hb(),
+                    **({"series": series} if series else {}),
                     "counters": reg.counters(),
                     # disagg (ISSUE 13): queued page exports stay here
                     # (tensors never ride a JSON reply) — the parent

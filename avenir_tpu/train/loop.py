@@ -578,6 +578,34 @@ def run_training(cfg):
             echo=(print if master else
                   (lambda m: print(f"[p{jax.process_index()}] {m}"))),
         )
+    # fleet health engine (ISSUE 14): gradual-degradation detection the
+    # watchdog's total-stall tier cannot see — step-time drift and io
+    # retry rate over windowed series. Coordinator-only (the signals are
+    # global), disabled by default; when armed, a Tracer is installed so
+    # anomaly fires leave flight-anomaly-*.jsonl dumps in out_dir.
+    anomaly = [None]
+    _ae_tracer_installed = False
+    if cfg.get("anomaly_detect") and master:
+        from avenir_tpu.obs.anomaly import AnomalyEngine
+        from avenir_tpu.obs.trace import Tracer, get_tracer, set_tracer
+
+        _ae_tr = get_tracer()
+        if _ae_tr is None:
+            _ae_tr = Tracer(registry=reg, out_dir=cfg["out_dir"])
+            set_tracer(_ae_tr)  # spans feed it; restored in the finally
+            _ae_tracer_installed = True
+        anomaly[0] = AnomalyEngine(
+            registry=reg, sink=sink, tracer=_ae_tr,
+            window_s=float(cfg.get("anomaly_window_s", 1.0) or 1.0))
+    # gradual-degradation fault site (utils/faults.py,
+    # `train_step_degrade`): each fire adds a permanent +2 ms/iter of
+    # host latency — the slow rot the anomaly engine exists to catch
+    # and the watchdog, by design, never fires on (windows keep
+    # completing). Inert without AVENIR_FAULTS (enabled() is a dict
+    # lookup returning False).
+    from avenir_tpu.utils.faults import get_injector
+
+    _degrade = [0]
     from contextlib import nullcontext
 
     # declared host boundaries (eval, saves, expected compiles) hold the
@@ -637,6 +665,11 @@ def run_training(cfg):
         reg.hist("window_dt_ms").observe(dt * 1e3)
         if wd is not None:
             wd.notify(window_secs=dt * Kp, iter_num=start + Kp)
+        ae = anomaly[0]
+        if ae is not None:  # the single-branch disabled guard
+            ae.observe("step_time_ms", dt * 1e3)
+            ae.observe_counter_rate("io_retries")
+            ae.check()
         # every process checks (loss is a global value, identical on all
         # of them): a master-only raise would leave the other processes
         # blocked in the next collective on a pod
@@ -759,6 +792,15 @@ def run_training(cfg):
                         if iter_num < b:
                             K = min(K, b - iter_num)
                 K = max(K, 1)
+                # degradation fault site: fires accumulate a permanent
+                # per-iter host latency (gradual rot, not a stall —
+                # the anomaly engine's quarry, tools/anomaly_bench.py)
+                _inj = get_injector()
+                if _inj.enabled("train_step_degrade"):
+                    if _inj.should_fire("train_step_degrade"):
+                        _degrade[0] += 1
+                    if _degrade[0]:
+                        time.sleep(min(0.25, 0.002 * _degrade[0]) * K)
                 # stage THIS window while the previous one still runs on
                 # device (its metrics are only fetched below, after this
                 # dispatch is enqueued) — the upload and the memmap crops
@@ -908,10 +950,17 @@ def run_training(cfg):
             if wd is not None:
                 wd.stop()
             disarm_crash_hooks()  # the normal run_end below supersedes
+            if _ae_tracer_installed:
+                from avenir_tpu.obs.trace import set_tracer
+
+                set_tracer(None)  # the run's tracer must not leak
             snap = reg.snapshot()
+            series = reg.series_snapshot()  # sketches ride run_end so
+            # reports read percentiles without re-deriving (ISSUE 14)
             sink.write({
                 "kind": "run_end", "t": time.time(), "iter": iter_num,
                 "best_val_loss": float(best_val_loss), **snap,
+                **({"series": series} if series else {}),
             })
             set_run_sink(_prev_sink)  # before close: no writes to a
             sink.close()              # closed sink from stray threads

@@ -42,6 +42,13 @@ Sites consulted by the production IO paths:
                          AFTER its CRC is computed (serve/frames.py
                          writer) — trips the reader's CRC check, which
                          is treated as replica death, never retried
+    train_step_degrade   each fire adds a PERMANENT +2 ms/iter of host
+                         latency to the train loop (train/loop.py) —
+                         gradual rot, not a stall: windows keep
+                         completing so the watchdog never fires, which
+                         is exactly the gap the anomaly engine's
+                         step-time drift detector closes
+                         (obs/anomaly.py, tools/anomaly_bench.py)
 
 The default injector (no env var) is inert: `enabled()` is a dict
 lookup returning False, so the hot paths pay nothing. Inject faults in

@@ -89,8 +89,13 @@ def _loop_form(args, *, attn_impl, on_tpu, block, batch, scan=False,
     import numpy as np
 
     from avenir_tpu.obs import get_registry
+    # the ONE quantile rule (ISSUE 14): nearest-rank percentile(0.5)
+    # returns the lower-middle ELEMENT, bit-identical to the
+    # median_low this form always reported — plus the streaming sketch
+    # for the window-spread extras the perf-gate ledger's noise band
+    # is derived from
+    from avenir_tpu.obs.series import QuantileSketch, percentile
     from avenir_tpu.train.loop import run_training
-    from avenir_tpu.utils.benching import median_low
 
     n_chips = jax.device_count()
     iters = int(args.get("steps", 159 if on_tpu else 4))
@@ -144,7 +149,10 @@ def _loop_form(args, *, attn_impl, on_tpu, block, batch, scan=False,
         # sustains every window; on any other host min is just the
         # luckiest sample, so it ships as an `extra`, not the `value`.
         dt_min = min(full)
-        dt_med = median_low(full)
+        dt_med = percentile(full, 0.5)
+        wsk = QuantileSketch()
+        for w in full:
+            wsk.observe(w * 1e3)
         timing = args.get("timing", "median")  # validated up front in main()
         dt = dt_min if timing == "min" else dt_med
         value = res["tokens_per_iter"] / dt / n_chips
@@ -168,6 +176,11 @@ def _loop_form(args, *, attn_impl, on_tpu, block, batch, scan=False,
             "timing": f"trainer-loop-{timing}",
             "min_window_ms": round(dt_min * 1000, 2),
             "median_window_ms": round(dt_med * 1000, 2),
+            # window spread from the shared sketch: the run-variance
+            # record tools/perf_gate.py's ledger noise bands cite
+            "window_p90_ms": round(wsk.quantile(0.90), 2),
+            "window_spread_frac": round(
+                (max(full) - dt_min) / dt_med, 4) if dt_med else None,
             "goodput_ms": goodput_ms,
             # record what actually ran (auto resolves per platform) plus
             # the run's peak HBM — the loss-tail memory win's ledger

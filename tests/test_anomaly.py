@@ -1,0 +1,750 @@
+"""Fleet health engine (ISSUE 14): streaming sketches, windowed series,
+the detector table, detection-before-the-stall-tier pins, the zero-
+anomaly steady pin, disabled-path overhead micro-pins, and the shared
+stall-threshold rule. All tier-1 CPU except the timing-sensitive
+wall-clock cases (slow lane)."""
+
+import glob
+import json
+import time
+
+import numpy as np
+import pytest
+from flax import nnx
+
+from avenir_tpu.models.gpt import GPT, GPTConfig
+from avenir_tpu.obs import MetricsRegistry, Tracer
+from avenir_tpu.obs.anomaly import (
+    DETECTOR_SCHEMA,
+    AnomalyEngine,
+    Detector,
+    default_detectors,
+    ls_slope,
+    robust_z,
+)
+from avenir_tpu.obs.series import (
+    QuantileSketch,
+    Series,
+    SeriesStore,
+    percentile,
+    stall_threshold_secs,
+)
+from avenir_tpu.utils.faults import FaultInjector, set_injector
+
+
+# ---------------------------------------------------------------------------
+# QuantileSketch: error bound, merge, wire deltas
+# ---------------------------------------------------------------------------
+
+
+def test_sketch_vs_exact_within_relative_error_bound():
+    """The ISSUE 14 agreement pin: sketch quantiles agree with the
+    exact nearest-rank rule within the sketch's alpha relative-error
+    bound, across distributions a latency series actually produces."""
+    rng = np.random.default_rng(0)
+    for xs in (rng.lognormal(3.0, 1.0, 5000),
+               rng.uniform(0.5, 500.0, 5000),
+               rng.exponential(20.0, 5000) + 1.0):
+        sk = QuantileSketch(alpha=0.01)
+        for x in xs:
+            sk.observe(x)
+        for q in (0.5, 0.9, 0.95, 0.99):
+            exact = percentile(list(xs), q)
+            est = sk.quantile(q)
+            assert abs(est - exact) / exact <= sk.alpha + 1e-9, (
+                f"q={q}: sketch {est} vs exact {exact}")
+
+
+def test_sketch_handles_zero_and_tracks_extremes():
+    sk = QuantileSketch()
+    for v in (0.0, 0.0, 5.0, 10.0):
+        sk.observe(v)
+    assert sk.quantile(0.25) == 0.0
+    assert sk.min == 0.0 and sk.max == 10.0 and sk.count == 4
+    assert sk.quantile(1.0) == pytest.approx(10.0, rel=0.02)
+
+
+def test_sketch_merge_equals_direct_build():
+    rng = np.random.default_rng(1)
+    xs = rng.lognormal(2.0, 0.7, 4000)
+    a, b, direct = QuantileSketch(), QuantileSketch(), QuantileSketch()
+    for x in xs[:2000]:
+        a.observe(x)
+        direct.observe(x)
+    for x in xs[2000:]:
+        b.observe(x)
+        direct.observe(x)
+    a.merge(b)
+    assert a.bins == direct.bins
+    assert a.count == direct.count and a.zero == direct.zero
+    assert a.min == direct.min and a.max == direct.max
+
+
+def test_sketch_delta_shipping_merges_exactly():
+    """The process-worker wire form: periodic take_delta() payloads
+    merged parent-side rebuild EXACTLY the sketch a single stream
+    builds — the counter-delta mirroring contract, for quantiles."""
+    rng = np.random.default_rng(2)
+    xs = rng.exponential(10.0, 3000)
+    worker, parent, direct = (QuantileSketch(), QuantileSketch(),
+                              QuantileSketch())
+    for i, x in enumerate(xs):
+        worker.observe(x)
+        direct.observe(x)
+        if i % 113 == 0:
+            d = worker.take_delta()
+            if d:
+                parent.merge_dict(d)
+    d = worker.take_delta()
+    if d:
+        parent.merge_dict(d)
+    assert parent.bins == direct.bins
+    assert parent.count == direct.count
+    for q in (0.5, 0.99):
+        assert parent.quantile(q) == direct.quantile(q)
+
+
+def test_sketch_fixed_memory_collapses_low_buckets():
+    """Beyond max_bins the LOW buckets fold together: memory stays
+    fixed and the operator-facing tail quantiles keep their error
+    bound — only the low end degrades."""
+    sk = QuantileSketch(alpha=0.01, max_bins=128)
+    rng = np.random.default_rng(3)
+    xs = rng.lognormal(0.0, 1.0, 20000)
+    for x in xs:
+        sk.observe(x)
+    assert len(sk.bins) <= 128
+    exact = percentile(list(xs), 0.99)
+    assert abs(sk.quantile(0.99) - exact) / exact <= sk.alpha + 1e-9
+
+
+def test_sketch_round_trips_via_dict():
+    sk = QuantileSketch()
+    for v in (1.0, 2.0, 3.0, 100.0):
+        sk.observe(v)
+    back = QuantileSketch.from_dict(json.loads(json.dumps(sk.to_dict())))
+    assert back.bins == sk.bins and back.count == sk.count
+    assert back.quantile(0.5) == sk.quantile(0.5)
+
+
+# ---------------------------------------------------------------------------
+# Series / SeriesStore
+# ---------------------------------------------------------------------------
+
+
+def test_series_windows_roll_and_bound_memory():
+    t = [0.0]
+    s = Series("step_time_ms", window_s=1.0, n_windows=4,
+               clock=lambda: t[0])
+    for i in range(40):
+        t[0] = i * 0.5
+        s.observe(float(i), t=t[0])
+    means = s.window_means()
+    assert len(means) <= 5  # 4 ring windows + the open one
+    # windows are (start, mean) with rising means for a rising signal
+    assert means[-1][1] > means[0][1]
+    assert s.count == 40  # the sketch saw everything the ring evicted
+
+
+def test_series_store_rejects_undeclared_keys():
+    st = SeriesStore(clock=lambda: 0.0)
+    with pytest.raises(AssertionError):
+        st.series("not_a_metric_key")
+    st.series("step_time_ms").observe(1.0, t=0.0)  # declared: fine
+
+
+def test_registry_series_optin_and_snapshot():
+    reg = MetricsRegistry()
+    s = reg.series("ttft_ms")
+    s.observe(10.0, t=0.0)
+    s.observe(20.0, t=0.1)
+    snap = reg.series_snapshot()
+    assert snap["ttft_ms"]["sketch"]["count"] == 2
+    with pytest.raises(AssertionError):
+        reg.series("nonexistent_key")
+
+
+# ---------------------------------------------------------------------------
+# The shared stall-threshold rule (consolidation satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_and_replica_share_the_threshold_rule():
+    """max(floor, factor x median) lives in ONE place; both consumers
+    resolve through it (the request_met_slo consolidation pattern)."""
+    from avenir_tpu.obs.watchdog import StallWatchdog
+    from avenir_tpu.serve.replica import ReplicaHealth
+
+    assert stall_threshold_secs(10.0, 0.5) == 10.0
+    assert stall_threshold_secs(1.0, 0.5) == 5.0
+    assert stall_threshold_secs(1.0, 0.5, factor=3.0) == 1.5
+
+    wd = StallWatchdog(floor_secs=1.0, dump_stacks=False,
+                       echo=lambda *a: None)
+    try:
+        for _ in range(5):
+            wd.notify(window_secs=2.0)
+        assert wd.threshold_secs() == stall_threshold_secs(1.0, 2.0)
+    finally:
+        wd.stop()
+
+    class _Rep(ReplicaHealth):
+        busy = False
+
+    r = _Rep(0, clock=lambda: 0.0, stall_floor_secs=1.0,
+             stall_factor=10.0)
+    r._durs = [2.0, 2.0, 2.0]
+    assert r.stall_threshold_secs() == stall_threshold_secs(1.0, 2.0)
+    # the anomaly tier's heartbeat factor is strictly below the stall
+    # tier's — "fires first" is structural, not tuned
+    hb = next(d for d in default_detectors()
+              if d.name == "heartbeat_creep")
+    assert hb.factor < 10.0
+
+
+# ---------------------------------------------------------------------------
+# Detector statistics + table
+# ---------------------------------------------------------------------------
+
+
+def test_robust_z_resists_outliers_and_flat_baselines():
+    base = [10.0] * 20
+    assert robust_z(base, 10.2) < 1.0   # MAD floor: jitter is not 100σ
+    assert robust_z(base, 20.0) > 4.0
+    spiky = [10.0] * 19 + [1000.0]      # one outlier cannot drag it
+    assert robust_z(spiky, 10.2) < 1.0
+
+
+def test_ls_slope():
+    assert ls_slope([(0, 0.0), (1, 2.0), (2, 4.0)]) == pytest.approx(2.0)
+    assert ls_slope([(0, 5.0)]) == 0.0
+
+
+def _fed_series(values, window_s=1.0):
+    s = Series("step_time_ms", window_s=window_s, clock=lambda: 0.0)
+    for i, v in enumerate(values):
+        s.observe(v, t=float(i) * window_s)
+    return s
+
+
+def test_drift_detector_fires_on_ramp_not_on_steady():
+    det = Detector("step_time_drift", z_thresh=4.0, min_rel=0.35,
+                   sustain=1, min_windows=8)
+    rng = np.random.default_rng(0)
+    steady = _fed_series(list(100.0 + rng.normal(0, 2.0, 32)))
+    assert det.evaluate(steady) is None
+    # rot beginning mid-run must NOT evade by dragging its own
+    # baseline (the oldest-half windows stay pre-rot)
+    ramp = _fed_series([100.0] * 16
+                       + [100.0 + 8.0 * i for i in range(1, 17)])
+    hit = det.evaluate(ramp)
+    assert hit is not None and hit["z"] >= 4.0 and hit["rel_rise"] > 0.35
+
+
+def test_trend_detector_needs_floor_and_projected_growth():
+    det = Detector("queue_wait_trend", min_rel=1.0, floor=100.0,
+                   horizon_s=10.0, sustain=1, min_windows=4)
+    # sub-floor sawtooth: quiet
+    low = _fed_series([5.0, 40.0, 5.0, 40.0, 5.0, 40.0])
+    assert det.evaluate(low) is None
+    # a real backlog ramp above the floor: fires
+    ramp = _fed_series([50.0 * i for i in range(8)])
+    hit = det.evaluate(ramp)
+    assert hit is not None and hit["slope_per_s"] > 0
+
+
+def test_series_snapshot_stays_strict_json_after_idle_gap():
+    """A flush opening an empty window followed by an idle gap used to
+    ring a count-0 window whose inf/-inf min/max leaked Infinity into
+    the run_end JSONL (review finding) — strict parsers reject that."""
+    s = Series("step_time_ms", window_s=1.0, clock=lambda: 0.0)
+    s.observe(5.0, t=0.0)
+    s.flush(2.0)            # closes the busy window, opens an empty one
+    s.observe(7.0, t=10.0)  # idle gap: the empty window must NOT ring
+    snap = s.snapshot()
+    json.dumps(snap, allow_nan=False)  # raises on Infinity/NaN
+    assert all(w[1] > 0 for w in snap["windows"])
+
+
+def test_io_retry_rate_uses_window_sum_not_mean():
+    """The rate is the window SUM / window_s: a fast loop filing many
+    small per-check deltas must not divide the true rate away (review
+    finding: 10 retries/s over 100 checks/window read as 0.1/s)."""
+    det = Detector("io_retry_rate", floor=1.0, sustain=1)
+    s = Series("io_retries", window_s=1.0, clock=lambda: 0.0)
+    # 100 checks over one window: mostly 0-deltas, 10 retries total
+    for i in range(100):
+        s.observe(1.0 if i % 10 == 0 else 0.0, t=i * 0.01)
+    s.flush(1.5)
+    hit = det.evaluate(s)
+    assert hit is not None and hit["value"] == pytest.approx(10.0)
+    # a genuinely quiet window stays quiet
+    q = Series("io_retries", window_s=1.0, clock=lambda: 0.0)
+    for i in range(100):
+        q.observe(0.0, t=i * 0.01)
+    q.flush(1.5)
+    assert det.evaluate(q) is None
+
+
+def test_collapse_detector():
+    det = Detector("accept_rate_collapse", collapse_frac=0.5, floor=0.1,
+                   sustain=1, min_windows=6, recent=2)
+    healthy = _fed_series([0.8] * 12)
+    assert det.evaluate(healthy) is None
+    collapsed = _fed_series([0.8] * 10 + [0.2, 0.2])
+    hit = det.evaluate(collapsed)
+    assert hit is not None and hit["baseline"] == pytest.approx(0.8)
+    # a signal that never established a baseline cannot collapse
+    nobase = _fed_series([0.05] * 12)
+    assert det.evaluate(nobase) is None
+
+
+def test_detector_schema_is_the_gate():
+    with pytest.raises(AssertionError):
+        Detector("made_up_detector")
+    assert {d.name for d in default_detectors()} == set(DETECTOR_SCHEMA)
+
+
+# ---------------------------------------------------------------------------
+# AnomalyEngine: the four-way audit emission + cooldown
+# ---------------------------------------------------------------------------
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append(rec)
+
+
+def test_anomaly_emission_is_counter_record_event_and_dump(tmp_path):
+    t = [0.0]
+    reg = MetricsRegistry()
+    sink = _ListSink()
+    tracer = Tracer(registry=reg, clock=lambda: t[0],
+                    out_dir=str(tmp_path))
+    ae = AnomalyEngine(registry=reg, sink=sink, tracer=tracer,
+                       clock=lambda: t[0], window_s=1.0,
+                       detectors=[Detector("step_time_drift",
+                                           sustain=1, min_windows=8)])
+    for i in range(16):
+        t[0] = float(i)
+        ae.observe("step_time_ms", 100.0, t=t[0])
+        ae.check(t[0])
+    assert reg.snapshot()["counters"].get("anomaly", 0) == 0
+    for i in range(16, 22):
+        t[0] = float(i)
+        ae.observe("step_time_ms", 100.0 + 40.0 * (i - 15), t=t[0])
+        ae.check(t[0])
+    counters = reg.snapshot()["counters"]
+    assert counters["anomaly"] == 1
+    # the four-way trail: host log + JSONL record + trace event + dump
+    assert ae.fired and ae.fired[0]["detector"] == "step_time_drift"
+    recs = [r for r in sink.records if r["kind"] == "anomaly"]
+    assert len(recs) == 1 and recs[0]["detector"] == "step_time_drift"
+    assert {"value", "baseline", "z", "rel_rise"} <= set(recs[0])
+    evs = [e for e in tracer.events() if e["ev"] == "anomaly"]
+    assert len(evs) == 1 and evs[0]["detector"] == "step_time_drift"
+    dumps = glob.glob(str(tmp_path / "flight-anomaly-*.jsonl"))
+    assert len(dumps) == 1 and "step_time_drift" in dumps[0]
+    # an ongoing incident re-fires once per cooldown, suppressed counted
+    for i in range(22, 60):
+        t[0] = float(i)
+        ae.observe("step_time_ms", 500.0, t=t[0])
+        ae.check(t[0])
+    counters = reg.snapshot()["counters"]
+    assert counters["anomaly"] >= 2  # re-fired after cooldown_s=30
+    assert counters["anomalies_suppressed"] >= 1
+
+
+def test_anomaly_check_is_paced():
+    t = [0.0]
+    ae = AnomalyEngine(registry=MetricsRegistry(), clock=lambda: t[0],
+                       window_s=1.0)
+    ae.observe("step_time_ms", 1.0, t=0.0)
+    ae.check(0.0)
+    assert ae._last_check == 0.0
+    t[0] = 0.5
+    assert ae.check(0.5) == []      # inside the interval: one clock
+    assert ae._last_check == 0.0    # read, no evaluation pass
+    t[0] = 1.5
+    ae.check(1.5)
+    assert ae._last_check == 1.5    # a due check evaluates
+
+
+def test_heartbeat_creep_uses_shared_rule_at_smaller_factor():
+    t = [0.0]
+    reg = MetricsRegistry()
+    ae = AnomalyEngine(
+        registry=reg, clock=lambda: t[0], window_s=0.5,
+        detectors=[Detector("heartbeat_creep", floor=0.25, factor=3.0,
+                            sustain=1)])
+    # median step 100ms -> creep threshold max(0.25, 0.3) = 0.3s,
+    # strictly below the stall tier's 1.0s (10x)
+    for i in range(10):
+        ae.observe("step_time_ms", 100.0, t=float(i) * 0.1)
+    ae.observe("heartbeat_age_s", 0.2, t=1.0)
+    assert ae.check(1.0) == []
+    ae.observe("heartbeat_age_s", 0.5, t=2.0)
+    fired = ae.check(2.0)
+    assert fired and fired[0]["detector"] == "heartbeat_creep"
+    assert fired[0]["threshold"] == pytest.approx(
+        stall_threshold_secs(0.25, 0.1, factor=3.0), rel=0.02)
+    assert fired[0]["threshold"] < stall_threshold_secs(1.5, 0.1)
+
+
+# ---------------------------------------------------------------------------
+# Fleet integration: detection strictly before the stall tier; the
+# steady zero-anomaly pin. Driven clock — deterministic, tier-1.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    return GPT(GPTConfig(block_size=64, vocab_size=128, n_layer=1,
+                         n_head=2, n_embd=32, dropout=0.0, bias=True,
+                         attn_impl="xla"), rngs=nnx.Rngs(0))
+
+
+def _fleet(tiny_model, tmp_path, t, *, anomaly=True):
+    from avenir_tpu.serve import Router
+
+    reg = MetricsRegistry()
+    tracer = Tracer(registry=reg, clock=lambda: t[0],
+                    out_dir=str(tmp_path))
+    ae = None
+    if anomaly:
+        ae = AnomalyEngine(registry=reg, tracer=tracer,
+                           clock=lambda: t[0], window_s=0.25)
+    router = Router(tiny_model, n_replicas=2, n_slots=2, registry=reg,
+                    seed=0, clock=lambda: t[0], tracer=tracer,
+                    anomaly=ae, stall_floor_secs=1.5)
+    return router, reg, ae
+
+
+def test_wedge_anomaly_fires_strictly_before_stall_tier(tiny_model,
+                                                        tmp_path):
+    """THE detection pin: a wedging replica (replica_stall — the fault
+    site the stall tier was built on) trips heartbeat_creep with
+    evidence and a flight dump STRICTLY before the stall threshold
+    declares death. Driven clock: deterministic at tier-1 speed."""
+    t = [0.0]
+    router, reg, ae = _fleet(tiny_model, tmp_path, t)
+    rng = np.random.default_rng(0)
+
+    def pump(n=1, dt=0.05):
+        for _ in range(n):
+            t[0] += dt
+            router.step()
+
+    for i in range(12):
+        router.submit([int(x) for x in rng.integers(0, 128, 6)],
+                      max_new_tokens=32, temperature=1.0, top_k=None)
+    pump(4)  # both replicas warmed, beating, holding work
+    assert all(r.busy for r in router.replicas)
+    prev = set_injector(FaultInjector("replica_stall:p=1:n=1"))
+    try:
+        pump(1)  # the wedge lands on whichever consults first
+        assert sum(getattr(r, "_stalled", False)
+                   for r in router.replicas) == 1
+        t_wedge = t[0]
+        t_anom = t_dead = None
+        for _ in range(200):
+            pump(1)
+            if t_anom is None and any(f["detector"] == "heartbeat_creep"
+                                      for f in ae.fired):
+                t_anom = t[0]
+            if t_dead is None and any(r.state == "dead"
+                                      for r in router.replicas):
+                t_dead = t[0]
+            if t_anom is not None and t_dead is not None:
+                break
+        assert t_anom is not None, "anomaly engine never fired"
+        assert t_dead is not None, "stall tier never declared death"
+        assert t_anom < t_dead, (
+            f"anomaly at +{t_anom - t_wedge:.2f}s must precede the "
+            f"stall tier at +{t_dead - t_wedge:.2f}s")
+        first = next(f for f in ae.fired
+                     if f["detector"] == "heartbeat_creep")
+        assert first["value"] > first["threshold"]
+        assert glob.glob(str(tmp_path / "flight-anomaly-*.jsonl"))
+        assert reg.snapshot()["counters"]["anomaly"] >= 1
+    finally:
+        set_injector(prev)
+        router.close()
+
+
+def test_steady_fleet_fires_zero_anomalies(tiny_model, tmp_path):
+    """The no-flapping pin (test_autoscale style): a steady seeded
+    in-SLO run produces ZERO anomalies — firing on a healthy fleet
+    would train operators to ignore the tier."""
+    t = [0.0]
+    router, reg, ae = _fleet(tiny_model, tmp_path, t)
+    rng = np.random.default_rng(1)
+    done = 0
+    submitted = 0
+    try:
+        while done < 24:
+            while submitted < 24 and router.queue_depth < 3:
+                router.submit([int(x) for x in rng.integers(0, 128, 6)],
+                              max_new_tokens=8, temperature=1.0,
+                              top_k=None)
+                submitted += 1
+            t[0] += 0.05
+            done += len(router.step())
+        counters = reg.snapshot()["counters"]
+        assert counters.get("anomaly", 0) == 0, ae.fired
+        assert counters.get("anomalies_suppressed", 0) == 0
+        assert not glob.glob(str(tmp_path / "flight-anomaly-*.jsonl"))
+        # the per-series gauges refreshed from the sketches
+        gauges = reg.snapshot()["gauges"]
+        assert gauges.get("ttft_p99_ms") is not None
+        assert gauges.get("step_time_p99_ms") is not None
+    finally:
+        router.close()
+
+
+def test_committed_anomaly_bench_artifact_pins_the_story():
+    """BENCH_anomaly.json (tools/anomaly_bench.py) is committed with
+    detection-latency vs watchdog-latency per scenario; its own ok
+    flag asserts anomaly-before-stall, watchdog-silent-on-rot, and
+    the steady zero."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bench = json.load(open(os.path.join(repo, "BENCH_anomaly.json")))
+    assert bench["kind"] == "anomaly_bench" and bench["ok"] is True
+    sc = bench["scenarios"]
+    assert sc["train_step_degrade"]["anomalies"] >= 1
+    assert sc["train_step_degrade"]["watchdog_fired"] is False
+    assert (sc["serve_replica_wedge"]["anomaly_latency_s"]
+            < sc["serve_replica_wedge"]["stall_latency_s"])
+    assert sc["steady_serve"]["anomalies"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Overhead: the disabled path must stay near-zero (the PR 9 pins)
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_anomaly_guard_is_nanoseconds():
+    """Every wiring site holds `ae = self._anomaly; if ae is not
+    None` — the exact shape test_trace pins for tracing."""
+    class _Holder:
+        _anomaly = None
+
+    h = _Holder()
+    n = 200_000
+    t0 = time.perf_counter()
+    acc = 0
+    for _ in range(n):
+        ae = h._anomaly
+        if ae is not None:
+            acc += 1
+    per_op_us = (time.perf_counter() - t0) / n * 1e6
+    assert acc == 0
+    assert per_op_us < 1.0, (
+        f"disabled-anomaly guard costs {per_op_us:.3f} us/op")
+
+
+def test_disabled_anomaly_adds_no_measurable_step_overhead(tiny_model):
+    """Fleet-level pin (relative, the test_trace budget idiom): router
+    steps with anomaly=None are not slower than steps with the full
+    engine armed (which do strictly more work)."""
+    import statistics
+
+    from avenir_tpu.serve import Router
+
+    def median_step(arm):
+        reg = MetricsRegistry()
+        ae = AnomalyEngine(registry=reg, window_s=0.25) if arm else None
+        router = Router(tiny_model, n_replicas=1, n_slots=2,
+                        registry=reg, seed=0, anomaly=ae)
+        rng = np.random.default_rng(4)
+        durs = []
+        try:
+            for _ in range(3):
+                for _ in range(2):
+                    router.submit(
+                        [int(x) for x in rng.integers(0, 128, 6)],
+                        max_new_tokens=12, temperature=1.0, top_k=None)
+                while router.open_requests:
+                    t0 = time.perf_counter()
+                    router.step()
+                    durs.append(time.perf_counter() - t0)
+        finally:
+            router.close()
+        return statistics.median(durs)
+
+    base = median_step(False)          # the production default
+    armed = median_step(True)
+    assert base <= 3.0 * armed + 2e-3, (
+        f"anomaly-disabled step ({base * 1e3:.2f} ms) slower than 3x "
+        f"an armed step ({armed * 1e3:.2f} ms) + 2 ms")
+
+
+# ---------------------------------------------------------------------------
+# run_end sketches: obs_report reads p50/p99 without re-deriving
+# ---------------------------------------------------------------------------
+
+
+def test_obs_report_prefers_run_end_sketches():
+    from avenir_tpu.obs.report import format_report, summarize
+
+    sk = QuantileSketch()
+    for v in (10.0, 20.0, 30.0, 40.0):
+        sk.observe(v)
+    records = [
+        {"kind": "run_meta", "t": 0.0},
+        {"kind": "request", "t": 1.0, "ttft_ms": 999.0, "tpot_ms": 9.0,
+         "n_out": 4, "finish_reason": "length"},
+        {"kind": "run_end", "t": 2.0, "counters": {"tokens_out": 4.0},
+         "series": {"ttft_ms": {"sketch": sk.to_dict()},
+                    "tpot_ms": {"sketch": sk.to_dict()}}},
+    ]
+    s = summarize(records)
+    assert s["serve"]["latency_source"] == "sketch"
+    # the sketch's p50 (not the 999.0 the raw record claims)
+    assert s["serve"]["ttft_p50_ms"] == pytest.approx(20.0, rel=0.02)
+    assert "(run_end sketch)" in format_report(s)
+    # without sketches, the per-request records still answer
+    s2 = summarize(records[:2] + [{"kind": "run_end", "t": 2.0,
+                                   "counters": {"tokens_out": 4.0}}])
+    assert s2["serve"]["latency_source"] == "records"
+    assert s2["serve"]["ttft_p50_ms"] == 999.0
+
+
+def test_obs_report_anomalies_line():
+    from avenir_tpu.obs.report import format_report, summarize
+
+    records = [
+        {"kind": "run_meta", "t": 100.0},
+        {"kind": "iter", "t": 101.0, "iter": 0, "loss": 1.0,
+         "counters": {}},
+        {"kind": "anomaly", "t": 103.0, "detector": "step_time_drift",
+         "key": "step_time_ms", "value": 50.0, "threshold": 4.0},
+        {"kind": "anomaly", "t": 105.0, "detector": "step_time_drift",
+         "key": "step_time_ms", "value": 60.0, "threshold": 4.0},
+        {"kind": "run_end", "t": 106.0,
+         "counters": {"anomaly": 2.0, "anomalies_suppressed": 3.0}},
+    ]
+    s = summarize(records)
+    assert s["anomalies"]["n"] == 2
+    assert s["anomalies"]["by_detector"] == {"step_time_drift": 2}
+    out = format_report(s)
+    assert "ANOMALIES: 2" in out and "step_time_drift=2" in out
+    assert "first +3.0s" in out and "last +5.0s" in out
+    assert "3 suppressed" in out
+
+
+def test_fleet_report_links_anomalies_to_decisions():
+    from tools.fleet_report import summarize_fleet
+
+    events = [
+        {"rid": None, "ev": "anomaly", "t": 10.0,
+         "detector": "queue_wait_trend", "key": "queue_wait_ms"},
+        {"rid": None, "ev": "scale", "t": 14.0, "action": "up",
+         "reason": "queue_wait", "from_size": 1, "to_size": 2,
+         "window_s": 6.0},
+        {"rid": None, "ev": "scale", "t": 60.0, "action": "down",
+         "reason": "surplus", "from_size": 2, "to_size": 1,
+         "window_s": 6.0},
+    ]
+    s = summarize_fleet(events)
+    assert s["n_anomalies"] == 1
+    up, down = s["decisions"]
+    assert up["anomalies_before"] == [
+        {"t_rel_s": 0.0, "detector": "queue_wait_trend",
+         "key": "queue_wait_ms"}]
+    assert down["anomalies_before"] == []
+
+
+# ---------------------------------------------------------------------------
+# Engine health series + process sketch shipping (wire-form fast test;
+# the real worker round-trip rides the slow lane in test_serve_proc)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_health_series_collects_and_drains(tiny_model):
+    from avenir_tpu.serve import Engine
+
+    eng = Engine(tiny_model, n_slots=2, max_seq_len=32,
+                 registry=MetricsRegistry(), health_series=True)
+    assert eng.take_series_delta() is None  # nothing yet
+    rng = np.random.default_rng(0)
+    eng.submit([int(x) for x in rng.integers(0, 128, 4)],
+               max_new_tokens=4, temperature=1.0, top_k=None)
+    eng.drain()
+    d = eng.take_series_delta()
+    assert d and d["step_time_ms"]["count"] >= 1
+    assert eng.take_series_delta() is None  # drained: nothing new
+    eng.submit([int(x) for x in rng.integers(0, 128, 4)],
+               max_new_tokens=2, temperature=1.0, top_k=None)
+    eng.drain()
+    d2 = eng.take_series_delta()
+    assert d2 and d2["step_time_ms"]["count"] >= 1
+    # parent-side merge through the registry series (the proc path)
+    reg = MetricsRegistry()
+    reg.series("step_time_ms").sketch.merge_dict(d["step_time_ms"])
+    reg.series("step_time_ms").sketch.merge_dict(d2["step_time_ms"])
+    assert (reg.series("step_time_ms").sketch.count
+            == eng._hs.count)
+
+
+def test_engine_without_health_series_pays_one_branch(tiny_model):
+    from avenir_tpu.serve import Engine
+
+    eng = Engine(tiny_model, n_slots=2, max_seq_len=32,
+                 registry=MetricsRegistry())
+    assert eng._hs is None and eng.take_series_delta() is None
+
+
+# ---------------------------------------------------------------------------
+# slow lane: real wall clocks + real processes (the conftest
+# duration-artifact convention — timing-sensitive cases carry `slow`)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_train_loop_degrade_fires_anomaly_watchdog_stays_silent():
+    """The REAL training loop under the train_step_degrade fault site:
+    the drift detector fires (with a flight dump), and the watchdog —
+    whose contract is total stalls — never does. Wall-clock timing:
+    slow lane; the committed BENCH_anomaly.json pins the same run."""
+    from tools.anomaly_bench import train_degrade_scenario
+
+    out = train_degrade_scenario(0, degrade_after=4, max_iters=119)
+    assert out["anomalies"] >= 1
+    assert out["detector"] == "step_time_drift"
+    assert out["watchdog_fired"] is False
+    assert out["flight_dumps"] >= 1
+    assert out["anomaly_latency_s"] is not None
+
+
+@pytest.mark.slow
+def test_process_worker_ships_sketch_deltas_parent_merges(tiny_model):
+    """health_series over the process backend: the worker's step-wall
+    sketch rides step replies as bucket deltas and merges into the
+    PARENT registry's series — the counter-delta mirroring contract,
+    for quantiles, across a real pipe."""
+    from avenir_tpu.serve import Router
+
+    reg = MetricsRegistry()
+    router = Router(tiny_model, n_replicas=1, n_slots=2, registry=reg,
+                    seed=0, backend="process",
+                    engine_kwargs={"health_series": 1})
+    try:
+        rng = np.random.default_rng(0)
+        for _ in range(3):
+            router.submit([int(x) for x in rng.integers(0, 128, 6)],
+                          max_new_tokens=6, temperature=1.0, top_k=None)
+        done = router.drain()
+        assert len(done) == 3
+        sk = reg.series("step_time_ms").sketch
+        assert sk.count >= 1, "no sketch deltas crossed the pipe"
+        assert sk.quantile(0.5) is not None
+        snap = reg.series_snapshot()
+        assert snap["step_time_ms"]["sketch"]["count"] == sk.count
+    finally:
+        router.close()

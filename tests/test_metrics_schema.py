@@ -117,6 +117,45 @@ def test_trace_schema_keys_pinned():
     )
 
 
+def test_anomaly_schema_keys_pinned():
+    """ISSUE 14: the fleet health engine's keys, the `anomaly` record
+    kind and trace event are part of the pinned contract (the
+    set-equality tests above enforce the doc mirror; named explicitly
+    so a schema prune cannot drop them silently)."""
+    assert METRIC_SCHEMA["anomaly"][0] == "counter"
+    assert METRIC_SCHEMA["anomalies_suppressed"][0] == "counter"
+    assert METRIC_SCHEMA["step_time_ms"][0] == "hist"
+    assert METRIC_SCHEMA["queue_wait_ms"][0] == "hist"
+    for g in ("step_time_p99_ms", "ttft_p99_ms", "tpot_p99_ms",
+              "queue_wait_p99_ms"):
+        assert METRIC_SCHEMA[g][0] == "gauge"
+    assert "anomaly" in RECORD_KINDS
+    from avenir_tpu.obs.trace import TRACE_EVENTS
+
+    assert "anomaly" in TRACE_EVENTS
+
+
+def test_doc_detector_table_matches_schema():
+    """The detector table is schema-pinned exactly like METRIC_SCHEMA:
+    docs/OBSERVABILITY.md's "Anomaly detection & perf gate" table must
+    mirror anomaly.DETECTOR_SCHEMA, and every detector's series key
+    must itself be a declared metric."""
+    from avenir_tpu.obs.anomaly import DETECTOR_SCHEMA
+
+    text = open(DOC).read()
+    doc_rows = _doc_table_keys(text, "detector")
+    assert doc_rows, "detector table not found in docs/OBSERVABILITY.md"
+    assert set(doc_rows) == set(DETECTOR_SCHEMA), (
+        "docs detector table drifted from DETECTOR_SCHEMA:\n"
+        f"  undocumented: {sorted(set(DETECTOR_SCHEMA) - set(doc_rows))}\n"
+        f"  stale doc rows: {sorted(set(doc_rows) - set(DETECTOR_SCHEMA))}"
+    )
+    for name, (key, method, _desc) in DETECTOR_SCHEMA.items():
+        assert key in METRIC_SCHEMA, (
+            f"detector {name} watches undeclared series key {key!r}")
+        assert method in ("drift", "trend", "collapse", "level")
+
+
 def test_span_counter_keys_resolve():
     """span() derives `{name}_ms` from the annotation name unless given
     an explicit counter; both paths must land on schema keys."""

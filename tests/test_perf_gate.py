@@ -1,0 +1,125 @@
+"""Perf-regression gate (ISSUE 14 tentpole, part 3): the tier-1 smoke
+— the committed BENCH trajectory must pass the ledger, and a synthetic
+20%-regressed copy of ANY gated artifact must fail with a message
+naming the metric and the band. Pure JSON reads; no model runs."""
+
+import copy
+import json
+import os
+
+import pytest
+
+from tools.perf_gate import (
+    LEDGER,
+    check_entry,
+    dig,
+    load_json,
+    main,
+    run_check,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def ledger():
+    return load_json(LEDGER)
+
+
+def test_ledger_shape(ledger):
+    """Every entry carries the committed contract: artifact, path,
+    headline value, direction, and a noise band WITH its source (a
+    band someone cannot audit is a band someone will fudge)."""
+    assert ledger["kind"] == "perf_ledger"
+    assert ledger["benches"], "empty ledger gates nothing"
+    for name, e in ledger["benches"].items():
+        assert {"artifact", "path", "value", "noise_frac",
+                "noise_source"} <= set(e), name
+        assert 0.0 < e["noise_frac"] < 0.8, name
+        assert os.path.exists(os.path.join(REPO, e["artifact"])), (
+            f"{name}: ledger names a missing artifact")
+
+
+def test_gate_passes_on_committed_trajectory():
+    """The HEAD invariant: the committed artifacts satisfy their own
+    ledger. A PR that regresses a committed bench artifact (or deletes
+    one) fails tier-1 here."""
+    assert main(["--check"]) == 0
+
+
+def test_ledger_values_match_artifacts(ledger):
+    for name, e in ledger["benches"].items():
+        art = load_json(os.path.join(REPO, e["artifact"]))
+        assert dig(art, e["path"]) == pytest.approx(e["value"]), (
+            f"{name}: ledger value drifted from the artifact — rerun "
+            "tools/perf_gate.py --update")
+
+
+def _set_path(obj, path, value):
+    for k in path[:-1]:
+        obj = obj[k]
+    obj[path[-1]] = value
+
+
+def test_gate_fails_on_synthetic_20pct_regression(ledger, tmp_path,
+                                                  capsys):
+    """EVERY gated metric: a regressed copy exits non-zero and the
+    failure message names the metric and the band. Every perf-
+    trajectory entry must catch a plain 20% regression (bands < 20%);
+    only the wall-clock anomaly-lead stat may carry a wider band, and
+    it is regressed past its own band instead."""
+    wide = {n for n, e in ledger["benches"].items()
+            if e["noise_frac"] >= 0.2}
+    assert wide <= {"anomaly_wedge_lead_frac"}, (
+        "a perf-trajectory band grew past 20% — a silent 20% "
+        "regression would ship clean again")
+    for name, e in ledger["benches"].items():
+        art = copy.deepcopy(load_json(os.path.join(REPO,
+                                                   e["artifact"])))
+        frac = max(0.2, e["noise_frac"] + 0.05)
+        worse = (e["value"] * (1.0 - frac)
+                 if e.get("direction", "higher") == "higher"
+                 else e["value"] * (1.0 + frac))
+        _set_path(art, e["path"], worse)
+        cand = tmp_path / f"regressed_{name}.json"
+        cand.write_text(json.dumps(art))
+        rc = main([f"--candidate={cand}", f"--bench={name}"])
+        out = capsys.readouterr().out
+        assert rc == 1, f"{name}: 20% regression passed the gate\n{out}"
+        assert "REGRESSION" in out and name in out and "band" in out, (
+            f"{name}: failure must name the metric and the band\n{out}")
+
+
+def test_gate_fails_loud_on_missing_artifact(ledger, tmp_path, capsys):
+    name = next(iter(ledger["benches"]))
+    rc = main([f"--candidate={tmp_path / 'nope.json'}",
+               f"--bench={name}"])
+    assert rc == 2  # deleting a bench must not pass the gate
+    assert "cannot read" in capsys.readouterr().out
+
+
+def test_gate_refuses_false_ok_flag(ledger, tmp_path, capsys):
+    """An artifact whose own acceptance flag went false fails the gate
+    even when the headline metric is inside the band."""
+    e = ledger["benches"]["paged_vs_slab_concurrency_ratio"]
+    art = copy.deepcopy(load_json(os.path.join(REPO, e["artifact"])))
+    art["ok"] = False
+    cand = tmp_path / "not_ok.json"
+    cand.write_text(json.dumps(art))
+    rc = main([f"--candidate={cand}",
+               "--bench=paged_vs_slab_concurrency_ratio"])
+    assert rc == 1
+    assert "ok flag" in capsys.readouterr().out
+
+
+def test_check_entry_directions():
+    higher = {"value": 100.0, "noise_frac": 0.1, "direction": "higher"}
+    assert check_entry("m", higher, 95.0)[0]
+    assert not check_entry("m", higher, 85.0)[0]
+    lower = {"value": 100.0, "noise_frac": 0.1, "direction": "lower"}
+    assert check_entry("m", lower, 105.0)[0]
+    assert not check_entry("m", lower, 115.0)[0]
+
+
+def test_unknown_bench_is_an_error(ledger, capsys):
+    assert run_check(ledger, only="no_such_bench") == 2

@@ -54,11 +54,22 @@ def summarize_fleet(events, run_end=None):
     `format_fleet_report` renders it."""
     scales = sorted((e for e in events if e.get("ev") == "scale"),
                     key=lambda e: e["t"])
+    anomalies = sorted((e for e in events if e.get("ev") == "anomaly"),
+                       key=lambda e: e["t"])
     ts = [e["t"] for e in events]
     t0 = min(ts) if ts else 0.0
     t1 = max(ts) if ts else 0.0
     decisions = []
     for e in scales:
+        # fleet health linkage (ISSUE 14): any anomaly inside this
+        # decision's evidence window preceding it is the early-warning
+        # context — "the health tier saw it coming at +12.3s"
+        win = float(e.get("window_s") or 30.0)
+        before = [
+            {"t_rel_s": a["t"] - t0, "detector": a.get("detector"),
+             "key": a.get("key")}
+            for a in anomalies if e["t"] - win <= a["t"] <= e["t"]
+        ]
         decisions.append({
             "t": e["t"],
             "t_rel_s": e["t"] - t0,
@@ -67,6 +78,7 @@ def summarize_fleet(events, run_end=None):
             "from_size": e.get("from_size"),
             "to_size": e.get("to_size"),
             "evidence": {k: e[k] for k in _EVIDENCE_KEYS if k in e},
+            "anomalies_before": before,
         })
     by_action = {}
     for d in decisions:
@@ -79,6 +91,7 @@ def summarize_fleet(events, run_end=None):
                                     initial_size=initial)
     return {
         "n_decisions": len(decisions),
+        "n_anomalies": len(anomalies),
         "by_action": by_action,
         "decisions": decisions,
         "window_s": t1 - t0,
@@ -116,6 +129,8 @@ def _fmt_evidence(ev):
 def format_fleet_report(s):
     lines = ["== avenir fleet report (autoscale decision log) =="]
     head = [f"decisions: {s['n_decisions']}"]
+    if s.get("n_anomalies"):
+        head.append(f"anomalies: {s['n_anomalies']}")
     if s["by_action"]:
         head.append("(" + "  ".join(
             f"{k}={v}" for k, v in sorted(s["by_action"].items())) + ")")
@@ -145,6 +160,10 @@ def format_fleet_report(s):
             ev = _fmt_evidence(d["evidence"])
             if ev:
                 lines.append(f"      {ev}")
+            for a in d.get("anomalies_before") or []:
+                lines.append(
+                    f"      preceded by anomaly: {a['detector']} "
+                    f"({a['key']}) at +{a['t_rel_s']:.2f}s")
     else:
         lines.append("no scale decisions in this log — a steady fleet "
                      "(or the autoscaler was not armed)")

@@ -1,0 +1,170 @@
+"""Perf-regression gate over the committed BENCH trajectory (ISSUE 14
+tentpole, part 3).
+
+The repo's whole perf story lives in committed BENCH_*.json artifacts
+(70.3k -> 143.8k tok/s/chip, 3.2x paging, 2.75x disagg, 29.7%
+autoscale savings) — but until now nothing MACHINE-compared them, so a
+silent 15% regression in any PR shipped clean. This tool closes that:
+`PERF_LEDGER.json` pins each bench's headline metric plus a noise band
+(derived from the recorded run variance — window spreads, search
+granularity — with the source named per entry), and the gate fails
+NON-ZERO, naming the metric and the band, when an artifact falls below
+the band. It also refuses any artifact whose own acceptance flag
+(`ok`) went false — a bench that failed its bar must not ship quietly.
+
+Modes:
+
+    --check                 verify every ledger entry against the
+                            committed artifact it names (the tier-1
+                            smoke: tests/test_perf_gate.py runs this on
+                            HEAD — pure JSON reads, no model runs)
+    --candidate=F --bench=B verify ONE fresh/candidate artifact F
+                            against ledger entry B (run this on a new
+                            bench output before committing it)
+    --update                rewrite ledger `value`s from the committed
+                            artifacts (after an INTENDED perf change;
+                            bands and sources are preserved)
+
+Exit codes: 0 = within bands, 1 = regression (message names the
+metric, the measured value, and the band floor), 2 = ledger/artifact
+unreadable (a missing artifact is a failure, not a skip — deleting a
+bench must not pass the gate).
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LEDGER = os.path.join(REPO, "PERF_LEDGER.json")
+
+
+def dig(obj, path):
+    """Walk a JSON path (list of keys/ints) into an artifact."""
+    for k in path:
+        obj = obj[k]
+    return float(obj)
+
+
+def load_json(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def check_entry(name, entry, value):
+    """One ledger comparison. Returns (ok, message). `direction`
+    'higher' means bigger is better: the floor is
+    ledger_value * (1 - noise_frac); 'lower' mirrors it. A value
+    BETTER than the ledger passes (with a refresh hint) — the gate
+    guards regressions, it does not freeze improvements out."""
+    ref = float(entry["value"])
+    noise = float(entry["noise_frac"])
+    if entry.get("direction", "higher") == "higher":
+        floor = ref * (1.0 - noise)
+        ok = value >= floor
+        msg = (f"{name}: {value:g} vs ledger {ref:g} "
+               f"(band -{noise:.1%} => floor {floor:g})")
+    else:
+        ceil = ref * (1.0 + noise)
+        ok = value <= ceil
+        msg = (f"{name}: {value:g} vs ledger {ref:g} "
+               f"(band +{noise:.1%} => ceiling {ceil:g})")
+    if not ok:
+        msg = "REGRESSION " + msg
+    elif (value > ref * (1.0 + noise)
+          if entry.get("direction", "higher") == "higher"
+          else value < ref * (1.0 - noise)):
+        msg += "  [improved beyond the band — refresh with --update]"
+    return ok, msg
+
+
+def check_artifact(name, entry, artifact_path):
+    try:
+        art = load_json(artifact_path)
+    except (OSError, json.JSONDecodeError) as e:
+        return None, (f"{name}: cannot read {artifact_path} ({e}) — a "
+                      "missing/torn bench artifact fails the gate")
+    try:
+        value = dig(art, entry["path"])
+    except (KeyError, IndexError, TypeError, ValueError) as e:
+        return None, (f"{name}: {artifact_path} has no "
+                      f"{'.'.join(map(str, entry['path']))} ({e})")
+    ok, msg = check_entry(name, entry, value)
+    # the artifact's own acceptance flag: a bench that failed its bar
+    # must fail the gate even if the headline metric looks fine
+    if entry.get("require_ok", True) and "ok" in art \
+            and art["ok"] is not True:
+        ok = False
+        msg += "  [artifact's own ok flag is false]"
+    return ok, msg
+
+
+def run_check(ledger, *, only=None, artifact_override=None):
+    failures = 0
+    hard_errors = 0
+    for name, entry in sorted(ledger["benches"].items()):
+        if only is not None and name != only:
+            continue
+        path = (artifact_override if artifact_override is not None
+                else os.path.join(REPO, entry["artifact"]))
+        ok, msg = check_artifact(name, entry, path)
+        if ok is None:
+            hard_errors += 1
+            print(f"[perf_gate] ERROR {msg}")
+        elif not ok:
+            failures += 1
+            print(f"[perf_gate] FAIL  {msg}")
+        else:
+            print(f"[perf_gate] ok    {msg}")
+    if only is not None and not any(
+            n == only for n in ledger["benches"]):
+        print(f"[perf_gate] ERROR unknown bench {only!r} — ledger has "
+              f"{sorted(ledger['benches'])}")
+        return 2
+    if hard_errors:
+        return 2
+    return 1 if failures else 0
+
+
+def run_update(ledger, ledger_path=LEDGER):
+    for name, entry in sorted(ledger["benches"].items()):
+        path = os.path.join(REPO, entry["artifact"])
+        art = load_json(path)
+        new = dig(art, entry["path"])
+        if new != entry["value"]:
+            print(f"[perf_gate] {name}: {entry['value']:g} -> {new:g}")
+            entry["value"] = new
+    # write back to the ledger that was READ — an --update against a
+    # --ledger override must not clobber the committed baseline
+    with open(ledger_path, "w") as f:
+        json.dump(ledger, f, indent=1)
+        f.write("\n")
+    print(f"[perf_gate] ledger rewritten: {ledger_path}")
+    return 0
+
+
+def main(argv):
+    args = {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
+            for a in argv}
+    try:
+        ledger = load_json(args.get("ledger", LEDGER))
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"[perf_gate] ERROR cannot read ledger: {e}")
+        return 2
+    if "update" in args:
+        return run_update(ledger, args.get("ledger", LEDGER))
+    if "candidate" in args:
+        bench = args.get("bench")
+        if not bench:
+            print("[perf_gate] --candidate needs --bench=<ledger name>")
+            return 2
+        return run_check(ledger, only=bench,
+                         artifact_override=args["candidate"])
+    if "check" in args:
+        return run_check(ledger)
+    print(__doc__)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -48,6 +48,15 @@ flight-recorder dumps (`flight-*.jsonl`) on every replica death.
 `python tools/trace_report.py <events/log>` attributes TTFT across
 queue vs prefill vs failover per request.
 
+`--anomaly` (ISSUE 14) arms the fleet health engine
+(avenir_tpu/obs/anomaly.py): the router feeds step-time / heartbeat /
+queue-wait / TTFT / TPOT series each step and the detector table fires
+`anomaly` records + trace events + flight dumps on drift, trend or
+collapse — BEFORE the stall/SLO tiers react. With or without the flag,
+TTFT/TPOT percentiles are reported from the shared streaming sketch
+(obs/series.QuantileSketch) and the run_end record carries the sketch
+snapshots so obs_report prints p50/p99 without re-deriving them.
+
 `--load_shape={poisson,bursty,diurnal}` (ISSUE 12) swaps the arrival
 process: seeded non-homogeneous generators (thinning) whose config
 rides run_meta, so any shape replays bit-identically.
@@ -1047,9 +1056,20 @@ def main():
             n_head=2, n_embd=int(args.get("draft_embd", 32)),
             dropout=0.0, bias=True, attn_impl="xla",
         ), rngs=nnx.Rngs(seed + 7))
+    # --anomaly (ISSUE 14): the fleet health engine rides the router —
+    # every step feeds the series, the detector table checks at window
+    # cadence, fires land in --metrics_log as `anomaly` records (and as
+    # flight-anomaly-*.jsonl dumps when --trace arms a dump dir)
+    ae = None
+    if args.get("anomaly") not in (None, "0", "false"):
+        from avenir_tpu.obs.anomaly import AnomalyEngine
+
+        ae = AnomalyEngine(
+            registry=reg, sink=sink, tracer=tracer,
+            window_s=float(args.get("anomaly_window_s", 1.0)))
     router = Router(model, n_replicas=n_replicas, n_slots=n_slots,
                     registry=reg, sink=sink, seed=seed, backend=backend,
-                    draft_model=draft_model,
+                    draft_model=draft_model, anomaly=ae,
                     engine_kwargs=_kv_engine_kwargs(args), tracer=tracer,
                     # the supervisor is the process backend's recovery
                     # story; inproc kills are revived below
@@ -1193,26 +1213,46 @@ def main():
               f"\ntrace events: {events_out} "
               f"(attribute: python tools/trace_report.py {events_out})")
     disarm_crash_hooks()  # the normal run_end below supersedes
+    # ONE quantile rule (ISSUE 14): latency percentiles come from the
+    # shared streaming sketch, and the run_end record carries the
+    # sketch snapshots — obs_report prints its p50/p99 lines from the
+    # artifact instead of re-deriving them from per-request records
+    from avenir_tpu.obs.series import QuantileSketch
+
+    ttft_sk, tpot_sk = QuantileSketch(), QuantileSketch()
+    for f in done:
+        if f.ttft_ms is not None:
+            ttft_sk.observe(f.ttft_ms)
+        if f.n_out > 1:
+            tpot_sk.observe(f.tpot_ms)
+    series = reg.series_snapshot()  # the anomaly engine's, when armed
+    series.setdefault("ttft_ms", {"key": "ttft_ms"})["sketch"] = \
+        ttft_sk.to_dict()
+    series.setdefault("tpot_ms", {"key": "tpot_ms"})["sketch"] = \
+        tpot_sk.to_dict()
     snap = reg.snapshot()
     sink.write({"kind": "run_end", "t": time.time(),
                 "counters": snap["counters"],
+                "series": series,
                 # gauges carry the paged-KV pool pressure for the
                 # obs_report paging line (points, not totals)
                 "gauges": {k: v for k, v in snap["gauges"].items()
                            if v is not None}})
     sink.close()
 
-    ttfts = [f.ttft_ms for f in done if f.ttft_ms is not None]
-    tpots = [f.tpot_ms for f in done if f.n_out > 1]
+    def _skq(sk, q):
+        v = sk.quantile(q)
+        return float("nan") if v is None else v
+
     counters = reg.snapshot()["counters"]
     tokens_out = counters["tokens_out"]
     print(f"requests: {n_requests} at {rate:.1f} req/s (seed {seed}), "
           f"{n_replicas} {backend} replica(s) x {n_slots} slots, "
           f"wall {wall:.2f}s")
-    print(f"ttft: p50 {_pct(ttfts, 0.50):.1f} ms  "
-          f"p99 {_pct(ttfts, 0.99):.1f} ms")
-    print(f"tpot: p50 {_pct(tpots, 0.50):.2f} ms  "
-          f"p99 {_pct(tpots, 0.99):.2f} ms")
+    print(f"ttft: p50 {_skq(ttft_sk, 0.50):.1f} ms  "
+          f"p99 {_skq(ttft_sk, 0.99):.1f} ms")
+    print(f"tpot: p50 {_skq(tpot_sk, 0.50):.2f} ms  "
+          f"p99 {_skq(tpot_sk, 0.99):.2f} ms")
     print(f"goodput: {tokens_out / wall:,.1f} tok/s out "
           f"({tokens_out:.0f} tokens), "
           f"{len(done) / wall:.2f} req/s completed")

@@ -147,6 +147,14 @@ watchdog_secs = 0.0
 # dump stacks one last time and exit non-zero (code 70) so a pod supervisor
 # restarts the job from the last committed checkpoint. 0 = warn forever
 watchdog_fatal_count = 0
+# fleet health engine (avenir_tpu/obs/anomaly.py, docs/OBSERVABILITY.md
+# "Anomaly detection"): detect GRADUAL degradation — step-time drift, io
+# retry rate — before the watchdog's total-stall tier can. Each anomaly is
+# a counter + JSONL record + trace event + flight-recorder dump. Off by
+# default (the disabled path is one None check per window).
+anomaly_detect = False
+# series window width (seconds) for the anomaly detectors' ring aggregates
+anomaly_window_s = 1.0
 # -----------------------------------------------------------------------------
 from configurator import configure
 
