@@ -1,12 +1,25 @@
 """Multi-host memmap data loader (SURVEY.md §2b T8).
 
 Same on-disk contract as the torch trainer's get_batch (train.py:144-161):
-uint16 token memmaps, random crops of block_size+1. Made multi-host aware
+token memmaps, random crops of block_size+1. Made multi-host aware
 the jax way: every process samples its OWN disjoint stream of crops from
 the full local file (the corpus is replicated on each host's disk), and
 `jax.make_array_from_process_local_data` assembles the per-process shards
 into one global jax.Array laid out by the batch sharding — no host ever
 materializes the global batch.
+
+Wire formats (ISSUE 15 satellite — the config ladder's upper rungs):
+  - legacy: a raw headerless uint16 memmap (the nanoGPT .bin contract;
+    half the H2D bytes of int32 — the r5 win). Any vocab > 65536 against
+    this form fails loud at construction (ids would wrap silently).
+  - v2: an 8-byte header (magic 'AVNR', version byte, dtype code byte,
+    2 reserved zeros) followed by the raw token array — selected per
+    FILE by the header, so a mixed directory of legacy and v2 files
+    just works. dtype code 2 = uint32: the >65536-vocab form Llama-3's
+    128k vocab needs (write_token_file picks the narrowest dtype that
+    fits). The 8-byte offset keeps the uint32 memmap aligned.
+Both forms ride the H2D wire in their on-disk dtype; the jit'd step
+widens to int32 on device (train/step.py).
 
 The memmap is re-opened per batch, matching the reference's defense against
 the np.memmap leak (train.py:145-147).
@@ -23,11 +36,72 @@ from avenir_tpu.obs.metrics import get_registry
 from avenir_tpu.utils.faults import get_injector
 from avenir_tpu.utils.retry import call_with_retry
 
-# the on-disk .bin format AND the H2D wire format are uint16 (half the
-# transfer bytes of int32 — the r5 win); any vocab that doesn't fit must
-# fail at loader construction, not wrap token ids mid-run
+# the legacy on-disk .bin format AND its H2D wire format (headerless raw
+# uint16); v2 files carry their own dtype in the header below
 WIRE_DTYPE = np.uint16
 WIRE_VOCAB_CAP = int(np.iinfo(WIRE_DTYPE).max) + 1  # 65536
+
+# v2 container: 8-byte header then the raw token array
+WIRE_MAGIC = b"AVNR"
+WIRE_V2 = 2
+WIRE_HEADER_BYTES = 8
+_DTYPE_CODES = {1: np.uint16, 2: np.uint32}
+_CODE_FOR_DTYPE = {np.dtype(v): k for k, v in _DTYPE_CODES.items()}
+
+
+def write_token_file(path, tokens, vocab_size=None):
+    """Write a token array in the narrowest wire form that fits:
+    legacy raw uint16 when the vocab does (bit-compatible with every
+    existing .bin consumer incl. the torch trainer), the v2
+    header + uint32 form otherwise. Returns the numpy dtype written."""
+    tokens = np.asarray(tokens)
+    hi = int(vocab_size) if vocab_size is not None else (
+        int(tokens.max()) + 1 if tokens.size else 0)
+    assert tokens.size == 0 or (int(tokens.max()) < hi
+                                and int(tokens.min()) >= 0), (
+        f"token ids outside [0, {hi}) (max {int(tokens.max())}) — a "
+        "vocab_size/tokenizer mismatch; writing would silently wrap ids "
+        "into the narrow wire dtype (the exact corruption the wire gate "
+        "exists to prevent)"
+    )
+    if hi <= WIRE_VOCAB_CAP:
+        tokens.astype(np.uint16).tofile(path)
+        return np.dtype(np.uint16)
+    assert hi <= int(np.iinfo(np.uint32).max) + 1, (
+        f"vocab_size={hi} does not fit uint32")
+    with open(path, "wb") as f:
+        f.write(WIRE_MAGIC + bytes([WIRE_V2,
+                                    _CODE_FOR_DTYPE[np.dtype(np.uint32)],
+                                    0, 0]))
+        tokens.astype(np.uint32).tofile(f)
+    return np.dtype(np.uint32)
+
+
+def read_wire_format(path):
+    """(dtype, byte offset) of a token file: header-sniffed v2, else the
+    legacy raw-uint16 contract.
+
+    Collision discipline: a legacy corpus could in principle START with
+    tokens whose bytes spell the magic (0x5641, 0x524E as uint16 LE).
+    The reserved-zero bytes are therefore part of the sniff — a magic
+    match whose reserved bytes are nonzero reads as legacy, so the
+    silent-misparse window needs FIVE specific leading values
+    (~2^-64 for real corpora). A magic+reserved match with a bad
+    version/dtype byte fails LOUD rather than guessing: loud-on-
+    astronomically-rare beats silent garbage, and a future v3 writer
+    bumps the version byte into exactly this error."""
+    with open(path, "rb") as f:
+        head = f.read(WIRE_HEADER_BYTES)
+    if (len(head) < WIRE_HEADER_BYTES or head[:4] != WIRE_MAGIC
+            or head[6:8] != b"\x00\x00"):
+        return np.dtype(WIRE_DTYPE), 0
+    version, code = head[4], head[5]
+    assert version == WIRE_V2, (
+        f"{path}: unknown token-file version {version} (this build reads "
+        f"v{WIRE_V2}) — refusing to guess the layout")
+    assert code in _DTYPE_CODES, (
+        f"{path}: unknown token dtype code {code}")
+    return np.dtype(_DTYPE_CODES[code]), WIRE_HEADER_BYTES
 
 
 class DataLoader:
@@ -48,12 +122,15 @@ class DataLoader:
         self.flat = flat
         self._reg = get_registry()
         assert not (flat and grad_accum != 1)
-        assert vocab_size is None or vocab_size <= WIRE_VOCAB_CAP, (
-            f"vocab_size={vocab_size} does not fit the loader's "
-            f"{WIRE_DTYPE.__name__} wire/on-disk token format (max "
-            f"{WIRE_VOCAB_CAP}); token ids would wrap silently — the .bin "
-            "corpus format needs a wider dtype before such a vocab can run"
-        )
+        self.vocab_size = vocab_size
+        self._wire = {}  # split -> (dtype, byte offset), header-sniffed once
+        if vocab_size is not None:
+            # fail loud HERE, not mid-run: the train file's wire format
+            # must fit the vocab (ADVICE r5). The v2 uint32 form is what
+            # lets Llama-3's 128k vocab pass this gate.
+            train_bin = os.path.join(data_dir, "train.bin")
+            if os.path.exists(train_bin):
+                self._wire_format("train")
         n_proc = jax.process_count()
         assert batch_size % n_proc == 0, (
             f"global batch {batch_size} must divide over {n_proc} processes"
@@ -74,6 +151,26 @@ class DataLoader:
         self._prefetch_thread = None
         self._prefetch_error = None
 
+    def _wire_format(self, split):
+        """Header-sniffed (dtype, offset) of one split's token file,
+        cached (the file's layout cannot change mid-run), with the
+        vocab-fits-the-wire fail-loud applied on first sight."""
+        cached = self._wire.get(split)
+        if cached is not None:
+            return cached
+        dtype, offset = read_wire_format(
+            os.path.join(self.data_dir, f"{split}.bin"))
+        cap = int(np.iinfo(dtype).max) + 1
+        assert self.vocab_size is None or self.vocab_size <= cap, (
+            f"vocab_size={self.vocab_size} does not fit {split}.bin's "
+            f"{dtype.name} wire/on-disk token format (max {cap}); token "
+            "ids would wrap silently — regenerate the corpus with "
+            "write_token_file (the v2 uint32 form) before such a vocab "
+            "can run"
+        )
+        self._wire[split] = (dtype, offset)
+        return dtype, offset
+
     def _sample_local(self, split):
         n = self.grad_accum * self.local_batch
         # the rng draw happens ONCE, before the (retryable) file reads:
@@ -81,23 +178,25 @@ class DataLoader:
         # crops, or the consumed rng stream would depend on how flaky
         # the storage was (breaking the deterministic-resume contract)
         ix = None
+        dtype, offset = self._wire_format(split)
 
         def read():
             nonlocal ix
             get_injector().fail("data_read_fail", what=f"{split}.bin")
             arr = np.memmap(
                 os.path.join(self.data_dir, f"{split}.bin"),
-                dtype=WIRE_DTYPE, mode="r",
+                dtype=dtype, mode="r", offset=offset,
             )
             if ix is None:
                 ix = self.rng.integers(0, len(arr) - self.block_size,
                                        size=n)
-            # tokens stay uint16 ON THE WIRE (the .bin dtype; every vocab
-            # here fits) — the jit'd step casts to int32 on device
-            # (train/step.py), halving H2D bytes per batch. Measured r5
-            # on the tunneled bench chip: ~230ms of per-window transfer
-            # serialization at int32, the dominant loop-vs-step-harness
-            # gap; pods pay the same halving on DCN-attached hosts.
+            # tokens stay in the file's narrow dtype ON THE WIRE (uint16
+            # legacy, uint32 for >65536 vocabs) — the jit'd step casts to
+            # int32 on device (train/step.py), halving H2D bytes per
+            # batch at uint16. Measured r5 on the tunneled bench chip:
+            # ~230ms of per-window transfer serialization at int32, the
+            # dominant loop-vs-step-harness gap; pods pay the same
+            # halving on DCN-attached hosts.
             x = np.stack([arr[i : i + self.block_size] for i in ix])
             y = np.stack([arr[i + 1 : i + 1 + self.block_size] for i in ix])
             return x, y
@@ -125,9 +224,10 @@ class DataLoader:
         )
         n = self.grad_accum * self.local_batch
         for split, count in plan:
+            dtype, offset = self._wire_format(split)
             nbytes = os.path.getsize(
-                os.path.join(self.data_dir, f"{split}.bin"))
-            hi = nbytes // np.dtype(WIRE_DTYPE).itemsize - self.block_size
+                os.path.join(self.data_dir, f"{split}.bin")) - offset
+            hi = nbytes // dtype.itemsize - self.block_size
             for _ in range(int(count)):
                 self.rng.integers(0, hi, size=n)
 

@@ -67,11 +67,57 @@ def scan_layer_stack(x, layers, *, call=None, remat=False, remat_policy=None):
 
 
 def resolve_dtype(name):
+    """Config compute_dtype -> the base ARITHMETIC dtype. 'int8' (the
+    quantized-matmul knob, ops/quant.py) keeps bf16 as the base: norms,
+    softmax, residual stream and every non-hot-matmul op run exactly as
+    under 'bfloat16' — only the rules-table-eligible matmuls
+    (parallel/partition.py PrecisionPolicy) switch to the int8 path."""
     return {
         "float32": jnp.float32,
         "bfloat16": jnp.bfloat16,
         "float16": jnp.float16,
+        "int8": jnp.bfloat16,
     }[name]
+
+
+def quant_policies(compute_dtype, family, keys):
+    """The models' construction-time precision resolution: None unless
+    `compute_dtype` selects the int8 matmul path (ops/quant.py), else a
+    tuple of PrecisionPolicy — one per canonical param-path key — from
+    the unified partition+precision rules table (parallel/partition.py).
+    The table is the single source of truth; call sites only name their
+    own tensor."""
+    from avenir_tpu.ops.quant import quantized_compute
+
+    if not quantized_compute(compute_dtype):
+        return None
+    from avenir_tpu.parallel.partition import precision_for
+
+    return tuple(precision_for(family, k) for k in keys)
+
+
+def w_dtype_for(policies):
+    """CE-tail weight precision from a quant_policies result: 'int8'
+    when the head tensor's policy quantizes, else 'compute' — the ONE
+    derivation the GPT/Llama tails (reference, fused, 1f1b) share."""
+    pol = policies[0] if policies else None
+    return "int8" if (pol is not None and pol.quantize) else "compute"
+
+
+def quant_linear(lin, x, pol, cdtype):
+    """One projection through an nnx.Linear — as-is at bf16/fp32, or the
+    int8 quantized matmul over the same master kernel when `pol` (the
+    tensor's rules-table policy) says so. The ONE dispatch shared by the
+    GPT and Llama MLP/attention call sites."""
+    if pol is None or not pol.quantize:
+        return lin(x)
+    from avenir_tpu.ops.quant import int8_matmul
+
+    y = int8_matmul(x, lin.kernel.get_value().astype(cdtype),
+                    scaling=pol.scaling)
+    if lin.bias is not None:
+        y = y + lin.bias.get_value().astype(cdtype)
+    return y
 
 
 def head_major_project(x, kernel, bias, n_head, head_dim):

@@ -33,11 +33,14 @@ from avenir_tpu.models.common import (
     cross_entropy_loss,
     head_major_merge,
     head_major_project,
+    quant_linear,
+    quant_policies,
     resolve_dtype,
     resolve_remat_policy,
     scan_layer_stack,
     stacked_layers,
     transformer_flops_per_token,
+    w_dtype_for,
 )
 from avenir_tpu.ops import causal_attention
 
@@ -52,7 +55,11 @@ class GPTConfig:
     dropout: float = 0.0
     bias: bool = True
     # --- TPU-side knobs (no torch counterpart) ---
-    compute_dtype: str = "float32"  # 'bfloat16' on TPU; params stay fp32
+    # 'bfloat16' on TPU; params stay fp32. 'int8' = bf16 base arithmetic
+    # with the rules-table-eligible hot matmuls (QKV/O, MLP, lm-head+CE)
+    # quantized per-channel int8 (ops/quant.py; policy per tensor class
+    # in parallel/partition.py's unified rules table).
+    compute_dtype: str = "float32"
     attn_impl: str = "auto"  # 'auto' | 'pallas' | 'xla'
     remat: bool = False  # rematerialize each block on the backward pass
     # what remat saves: 'nothing' (full recompute) or 'dots' (weight-matmul
@@ -106,6 +113,9 @@ class CausalSelfAttention(nnx.Module):
         self.n_head = config.n_head
         self.dropout = config.dropout
         self.attn_impl = config.attn_impl
+        self._quant = quant_policies(
+            config.compute_dtype, "gpt",
+            ("attn/c_attn/kernel", "attn/c_proj/kernel"))
 
     def __call__(self, x, *, deterministic=True, rngs=None):
         B, T, C = x.shape
@@ -119,13 +129,28 @@ class CausalSelfAttention(nnx.Module):
         w = self.c_attn.kernel.get_value().astype(cdtype)  # (C, 3C)
         b = (self.c_attn.bias.get_value().astype(cdtype)
              if self.c_attn.bias is not None else None)
-        q, k, v = (
-            head_major_project(
-                x, w[:, i * C:(i + 1) * C],
-                None if b is None else b[i * C:(i + 1) * C], H, hd,
+        if self._quant and self._quant[0].quantize:
+            # int8 QKV: one fused (C, 3C) quantized matmul; the
+            # head-major transpose happens on the (8x smaller) int8-path
+            # output instead of riding the matmul epilogue
+            from avenir_tpu.ops.quant import int8_matmul
+
+            qkv = int8_matmul(x, w, scaling=self._quant[0].scaling)
+            if b is not None:
+                qkv = qkv + b
+            q, k, v = (
+                qkv[..., i * C:(i + 1) * C]
+                .reshape(B, T, H, hd).transpose(0, 2, 1, 3)
+                for i in range(3)
             )
-            for i in range(3)
-        )
+        else:
+            q, k, v = (
+                head_major_project(
+                    x, w[:, i * C:(i + 1) * C],
+                    None if b is None else b[i * C:(i + 1) * C], H, hd,
+                )
+                for i in range(3)
+            )
         use_dropout = self.dropout > 0.0 and not deterministic
         y = causal_attention(
             q, k, v,
@@ -133,11 +158,18 @@ class CausalSelfAttention(nnx.Module):
             dropout_rng=rngs.dropout() if use_dropout else None,
             impl=self.attn_impl, layout="bhtd",
         )  # (B, H, T, hd)
-        out = head_major_merge(
-            y, self.c_proj.kernel.get_value().astype(cdtype),
-            self.c_proj.bias.get_value().astype(cdtype)
-            if self.c_proj.bias is not None else None,
-        )
+        w_o = self.c_proj.kernel.get_value().astype(cdtype)
+        b_o = (self.c_proj.bias.get_value().astype(cdtype)
+               if self.c_proj.bias is not None else None)
+        if self._quant and self._quant[1].quantize:
+            from avenir_tpu.ops.quant import int8_matmul
+
+            out = int8_matmul(y.transpose(0, 2, 1, 3).reshape(B, T, C),
+                              w_o, scaling=self._quant[1].scaling)
+            if b_o is not None:
+                out = out + b_o
+        else:
+            out = head_major_merge(y, w_o, b_o)
         return self.resid_dropout(out, deterministic=deterministic, rngs=rngs)
 
 
@@ -160,14 +192,22 @@ class MLP(nnx.Module):
             dtype=cdtype, param_dtype=jnp.float32, rngs=rngs,
         )
         self.dropout = nnx.Dropout(config.dropout)
+        self._cdtype = cdtype
+        self._quant = quant_policies(
+            config.compute_dtype, "gpt",
+            ("mlp/c_fc/kernel", "mlp/c_proj/kernel"))
 
     def __call__(self, x, *, deterministic=True, rngs=None):
         # tanh-approximated GELU (gelu_new), matching model.py:116-118 and
         # HF GPT-2's activation_function="gelu_new". erf-GELU measured 35%
         # slower on the v5e VPU (BASELINE.md "GELU" note).
-        x = jax.nn.gelu(self.c_fc(x), approximate=True)
+        q = self._quant
+        x = jax.nn.gelu(
+            quant_linear(self.c_fc, x, q and q[0], self._cdtype),
+            approximate=True)
         return self.dropout(
-            self.c_proj(x), deterministic=deterministic, rngs=rngs
+            quant_linear(self.c_proj, x, q and q[1], self._cdtype),
+            deterministic=deterministic, rngs=rngs
         )
 
 
@@ -228,6 +268,11 @@ class GPT(nnx.Module):
             dtype=jnp.float32, param_dtype=jnp.float32, rngs=rngs,
         )
         self._cdtype = cdtype
+        # tied head: the wte tensor's MATMUL use (the CE projection)
+        # follows its rules-table policy; the embedding gather never
+        # quantizes (partition.py precision conventions)
+        self._quant_head = quant_policies(
+            config.compute_dtype, "gpt", ("wte/embedding",))
 
     def __call__(self, idx, targets=None, *, deterministic=True, rngs=None):
         B, T = idx.shape
@@ -268,12 +313,13 @@ class GPT(nnx.Module):
                                "w": self.wte.embedding.get_value()}
                 cd = self._cdtype
                 t_chunk = self.config.loss_chunk
+                wdt = w_dtype_for(self._quant_head)
 
                 def tail_fn(tp, h, y, stats):
                     hn = nnx.merge(ln_gd, tp["ln"])(h).astype(cd)
                     ls, _ = blocked_ce_terms(
                         hn, tp["w"].astype(cd), y, ignore_index=-1,
-                        w_layout="vc", t_chunk=t_chunk)
+                        w_layout="vc", t_chunk=t_chunk, w_dtype=wdt)
                     return ls, jnp.float32(0.0)
 
                 loss = pipeline_1f1b_loss(
@@ -315,6 +361,11 @@ class GPT(nnx.Module):
                 x = block_fn(block, x)
         x = self.ln_f(x).astype(self._cdtype)
 
+        # CE tail precision: weight-only int8 (per-vocab-row scales over
+        # the contraction axis) when the tied wte's rules-table policy
+        # says so — every impl (reference fake-quant oracle, blocked
+        # stripes, pallas stripes) lands on the same int8 grid
+        w_dtype = w_dtype_for(self._quant_head)
         if targets is not None:
             from avenir_tpu.ops.fused_ce import (
                 fused_cross_entropy,
@@ -323,7 +374,7 @@ class GPT(nnx.Module):
 
             loss_impl = resolve_loss_impl(self.config.loss_impl)
             if loss_impl == "reference":
-                logits = self.wte.attend(x)  # tied weights (model.py:149-151)
+                logits = self._head_logits(x, w_dtype)
                 loss = cross_entropy_loss(logits, targets, ignore_index=-1)
             else:
                 # fused chunked tail: the (B, T, V) logits never exist;
@@ -333,12 +384,25 @@ class GPT(nnx.Module):
                 loss = fused_cross_entropy(
                     x, emb, targets, ignore_index=-1, impl=loss_impl,
                     w_layout="vc", t_chunk=self.config.loss_chunk,
+                    w_dtype=w_dtype,
                 )
                 logits = None
         else:
-            logits = self.wte.attend(x[:, -1:, :])
+            logits = self._head_logits(x[:, -1:, :], w_dtype)
             loss = None
         return logits, loss
+
+    def _head_logits(self, x, w_dtype):
+        """Tied-head logits (model.py:149-151). Under the int8 knob the
+        tied embedding is consumed through the straight-through
+        fake-quant grid (ops/quant.py) — the full-logits twin of the
+        fused tail's int8 weight stripes."""
+        if w_dtype == "int8":
+            from avenir_tpu.ops.quant import fake_quant
+
+            emb = self.wte.embedding.get_value().astype(self._cdtype)
+            return jnp.einsum("btc,vc->btv", x, fake_quant(emb, 1))
+        return self.wte.attend(x)
 
     # ----- parity utilities (mirror model.py) -----
 

@@ -26,10 +26,13 @@ from avenir_tpu.models.common import (
     cross_entropy_loss,
     head_major_merge,
     head_major_project,
+    quant_linear,
+    quant_policies,
     resolve_dtype,
     resolve_remat_policy,
     scan_layer_stack,
     stacked_layers,
+    w_dtype_for,
 )
 from avenir_tpu.ops import apply_rope, causal_attention, rope_frequencies, swiglu
 from avenir_tpu.ops.rmsnorm import rmsnorm
@@ -78,7 +81,11 @@ class LlamaConfig:
             n_layer=model_args["n_layer"], n_head=model_args["n_head"],
             n_kv_head=n_kv, n_embd=model_args["n_embd"], ffn_hidden=ffn,
             rope_theta=cfg.get("rope_theta", 500000.0),
-            compute_dtype=("float32" if cfg["dtype"] == "float16" else cfg["dtype"]),
+            # the compute_dtype knob ('int8' = quantized hot matmuls over
+            # a bf16 base, ops/quant.py) overrides the dtype-derived base
+            compute_dtype=(cfg.get("compute_dtype")
+                           or ("float32" if cfg["dtype"] == "float16"
+                               else cfg["dtype"])),
             attn_impl=("auto" if cfg["use_pallas"] else "xla"),
             remat=cfg["remat"],
             remat_policy=cfg.get("remat_policy", "nothing"),
@@ -123,6 +130,9 @@ class LlamaAttention(nnx.Module):
         self.rope_theta = config.rope_theta
         self.max_t = config.block_size
         self.attn_impl = config.attn_impl
+        self._quant = quant_policies(
+            config.compute_dtype, "llama",
+            ("q_proj/kernel", "o_proj/kernel"))
 
     def __call__(self, x, positions=None):
         B, T, C = x.shape
@@ -130,15 +140,30 @@ class LlamaAttention(nnx.Module):
         # Head-major projections (models/common.py helpers; the transpose
         # into the kernel-native layout rides the matmul epilogue).
         cdtype = x.dtype
-        proj = lambda lin, nh: head_major_project(
-            x, lin.kernel.get_value().astype(cdtype), None, nh, hd)
+        if self._quant and self._quant[0].quantize:
+            from avenir_tpu.ops.quant import int8_matmul
+
+            def proj(lin, nh):
+                y2 = int8_matmul(
+                    x, lin.kernel.get_value().astype(cdtype),
+                    scaling=self._quant[0].scaling)
+                return y2.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+        else:
+            proj = lambda lin, nh: head_major_project(
+                x, lin.kernel.get_value().astype(cdtype), None, nh, hd)
         q, k, v = proj(self.q_proj, H), proj(self.k_proj, Hkv), proj(self.v_proj, Hkv)
         cos, sin = rope_frequencies(hd, self.max_t, self.rope_theta)
         q = apply_rope(q, cos, sin, positions=positions, layout="bhtd")
         k = apply_rope(k, cos, sin, positions=positions, layout="bhtd")
         y = causal_attention(q, k, v, impl=self.attn_impl, layout="bhtd")
-        return head_major_merge(
-            y, self.o_proj.kernel.get_value().astype(cdtype), None)
+        w_o = self.o_proj.kernel.get_value().astype(cdtype)
+        if self._quant and self._quant[1].quantize:
+            from avenir_tpu.ops.quant import int8_matmul
+
+            return int8_matmul(
+                y.transpose(0, 2, 1, 3).reshape(B, T, H * hd), w_o,
+                scaling=self._quant[1].scaling)
+        return head_major_merge(y, w_o, None)
 
 
 class LlamaMLP(nnx.Module):
@@ -155,8 +180,17 @@ class LlamaMLP(nnx.Module):
         self.gate_proj = lin(config.n_embd, config.ffn_hidden, init)
         self.up_proj = lin(config.n_embd, config.ffn_hidden, init)
         self.down_proj = lin(config.ffn_hidden, config.n_embd, d_init)
+        self._cdtype = cdtype
+        self._quant = quant_policies(
+            config.compute_dtype, "llama",
+            ("gate_proj/kernel", "down_proj/kernel"))
 
     def __call__(self, x):
+        if self._quant:
+            up, dn = self._quant
+            h = swiglu(quant_linear(self.gate_proj, x, up, self._cdtype),
+                       quant_linear(self.up_proj, x, up, self._cdtype))
+            return quant_linear(self.down_proj, h, dn, self._cdtype)
         return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
 
 
@@ -204,6 +238,8 @@ class Llama(nnx.Module):
             rngs=rngs,
         )
         self._cdtype = cdtype
+        self._quant_head = quant_policies(
+            config.compute_dtype, "llama", ("lm_head/kernel",))
 
     def __call__(self, idx, targets=None, *, deterministic=True, rngs=None):
         B, T = idx.shape
@@ -247,12 +283,13 @@ class Llama(nnx.Module):
                                "w": self.lm_head.kernel.get_value()}
                 cd = self._cdtype
                 t_chunk = self.config.loss_chunk
+                wdt = w_dtype_for(self._quant_head)
 
                 def tail_fn(tp, h, y, stats):
                     hn = nnx.merge(norm_gd, tp["norm"])(h).astype(cd)
                     ls, _ = blocked_ce_terms(
                         hn, tp["w"].astype(cd), y, ignore_index=-1,
-                        w_layout="cv", t_chunk=t_chunk)
+                        w_layout="cv", t_chunk=t_chunk, w_dtype=wdt)
                     aux = (coef * self._router_aux_loss(stats) if coef
                            else jnp.float32(0.0))
                     return ls, aux
@@ -292,6 +329,9 @@ class Llama(nnx.Module):
                 x, s = layer_fn(layer, x)
                 stats_sum = jax.tree.map(jnp.add, stats_sum, s)
         x = self.norm(x).astype(self._cdtype)
+        # CE tail precision follows the lm_head's rules-table policy:
+        # weight-only int8 across every impl (see GPT._head_logits)
+        w_dtype = w_dtype_for(self._quant_head)
         if targets is not None:
             from avenir_tpu.ops.fused_ce import (
                 fused_cross_entropy,
@@ -300,7 +340,7 @@ class Llama(nnx.Module):
 
             loss_impl = resolve_loss_impl(self.config.loss_impl)
             if loss_impl == "reference":
-                logits = self.lm_head(x)
+                logits = self._head_logits(x, w_dtype)
                 loss = cross_entropy_loss(logits, targets, ignore_index=-1)
             else:
                 # fused chunked tail (ops/fused_ce.py): w_layout='cv'
@@ -309,15 +349,27 @@ class Llama(nnx.Module):
                 loss = fused_cross_entropy(
                     x, w, targets, ignore_index=-1, impl=loss_impl,
                     w_layout="cv", t_chunk=self.config.loss_chunk,
+                    w_dtype=w_dtype,
                 )
                 logits = None
             coef = getattr(self.config, "router_aux_loss_coef", 0.0)
             if coef:
                 loss = loss + coef * self._router_aux_loss(stats_sum)
         else:
-            logits = self.lm_head(x[:, -1:, :])
+            logits = self._head_logits(x[:, -1:, :], w_dtype)
             loss = None
         return logits, loss
+
+    def _head_logits(self, x, w_dtype):
+        """Untied lm-head logits; under the int8 knob the kernel is
+        consumed through the straight-through fake-quant grid — the
+        full-logits twin of the fused tail's int8 stripes."""
+        if w_dtype == "int8":
+            from avenir_tpu.ops.quant import fake_quant
+
+            w = self.lm_head.kernel.get_value().astype(self._cdtype)
+            return x @ fake_quant(w, 0)
+        return self.lm_head(x)
 
     # router load-balancing hooks (overridden by MoE families)
 

@@ -75,12 +75,37 @@ class MixtralExperts(nnx.Module):
         self.w3 = nnx.Param(init(rngs.params(), (E, d, ff), jnp.float32))
         self.w2 = nnx.Param(init(rngs.params(), (E, ff, d), jnp.float32))
         self._cdtype = resolve_dtype(config.compute_dtype)
+        from avenir_tpu.models.common import quant_policies
+
+        self._quant = quant_policies(
+            config.compute_dtype, "mixtral", ("experts/w1", "experts/w2"))
 
     def __call__(self, x):  # x: (E, C, d)
         cd = self._cdtype
         w1 = self.w1.get_value().astype(cd)
         w3 = self.w3.get_value().astype(cd)
         w2 = self.w2.get_value().astype(cd)
+        if self._quant and any(p.quantize for p in self._quant):
+            # int8 expert FFNs: the per-expert matmul vmaps the ONE
+            # quantized-matmul op over the stacked E axis — per-channel
+            # scales stay per expert (ops/quant.py; custom_vjp batches).
+            # Each tensor honors its OWN rules-table policy (w1/w3 share
+            # the up-projection row, w2 the down-projection row).
+            from avenir_tpu.ops.quant import int8_matmul
+
+            def mm(a, b, pol, eq):
+                if not pol.quantize:
+                    return jnp.einsum(
+                        eq, a, b,
+                        preferred_element_type=jnp.float32).astype(cd)
+                return jax.vmap(lambda ae, be: int8_matmul(
+                    ae, be, scaling=pol.scaling))(a, b)
+
+            up, dn = self._quant
+            h = jax.nn.silu(
+                mm(x, w1, up, "ecd,edf->ecf").astype(jnp.float32)
+            ).astype(cd) * mm(x, w3, up, "ecd,edf->ecf")
+            return mm(h, w2, dn, "ecf,efd->ecd")
         h = jax.nn.silu(
             jnp.einsum("ecd,edf->ecf", x, w1,
                        preferred_element_type=jnp.float32).astype(jnp.float32)

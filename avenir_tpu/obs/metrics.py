@@ -111,6 +111,21 @@ METRIC_SCHEMA = {
     # -- watchdog --
     "watchdog_stalls": (
         "counter", "1", "stall-watchdog warnings fired"),
+    # -- int8 quantized training (ops/quant.py, ISSUE 15) --
+    "matmul_bits": (
+        "gauge", "bits",
+        "element width of the training hot-matmul operands: 8 under "
+        "compute_dtype='int8', 16 for bf16/fp16, 32 for fp32 — set at "
+        "loop startup (the kv_dtype idiom); an int8 run that silently "
+        "fell back to bf16 would halve throughput with no other "
+        "visible cause"),
+    "quant_scale_clip": (
+        "counter", "1",
+        "weight channels whose per-channel quantization scale clamped "
+        "to the SCALE_FLOOR in an int8 audit (ops/quant."
+        "audit_quantization: loop startup, tools/quant_bench.py) — an "
+        "all-zero channel wastes int8 range; a rising count across a "
+        "sweep means dead channels"),
     # -- fleet health engine (obs/series.py + obs/anomaly.py, ISSUE 14) --
     "anomaly": (
         "counter", "1",

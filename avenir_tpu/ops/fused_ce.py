@@ -131,14 +131,30 @@ def _chunk_loss_terms(xc, w, yc, *, ignore_index, w_layout):
 
 
 def blocked_ce_terms(x, w, targets, *, ignore_index=-1, w_layout="cv",
-                     t_chunk=0):
+                     t_chunk=0, w_dtype="compute"):
     """(loss_sum, valid_count) of the chunked tail — the un-normalized
     form the 1f1b pipeline runs per-MICRObatch at the last stage
     (parallel/pipeline.pipeline_1f1b_loss): callers own the division, so
     per-micro SUMS reduce to exactly the full-batch mean regardless of
     how the ignored positions fall across micros. Same chunking,
     jax.checkpoint and dtype discipline as the `blocked` impl of
-    fused_cross_entropy (which is this divided through)."""
+    fused_cross_entropy (which is this divided through).
+
+    `w_dtype='int8'` (the compute_dtype='int8' tail, ISSUE 15): the
+    projection weight is straight-through fake-quantized ONCE, outside
+    the chunk scan, with per-vocab-channel absmax scales over the
+    contraction axis (ops/quant.py) — every chunk of the step's window
+    consumes the same int8 grid (the delayed-scaling discipline), and
+    plain autodiff through the STE reproduces exactly the gradient the
+    pallas int8-stripe kernels hand-write. This blocked form is the
+    CPU-testable oracle; the pallas twin is where HBM actually moves
+    int8 stripes."""
+    if w_dtype == "int8":
+        from avenir_tpu.ops.quant import fake_quant
+
+        w = fake_quant(w, 0 if w_layout == "cv" else 1)
+    else:
+        assert w_dtype == "compute", f"unknown w_dtype {w_dtype!r}"
     B, T, C = x.shape
     tc = min(t_chunk or _DEFAULT_T_CHUNK, T)
     nc = -(-T // tc)
@@ -197,7 +213,8 @@ def blocked_ce_terms(x, w, targets, *, ignore_index=-1, w_layout="cv",
     return ls, nv
 
 
-def _blocked_ce(x, w, targets, *, ignore_index, w_layout, t_chunk):
+def _blocked_ce(x, w, targets, *, ignore_index, w_layout, t_chunk,
+                w_dtype="compute"):
     """lax.scan over T-chunks; jax.checkpoint on the chunk body so the
     backward recomputes each chunk's logits (the scan would otherwise
     stack them into the full (B, T, V) as residuals). dx is scattered
@@ -205,12 +222,13 @@ def _blocked_ce(x, w, targets, *, ignore_index, w_layout, t_chunk):
     accumulates across scan steps — neither pass holds more than one
     (B, t_chunk, V) slab."""
     ls, nv = blocked_ce_terms(x, w, targets, ignore_index=ignore_index,
-                              w_layout=w_layout, t_chunk=t_chunk)
+                              w_layout=w_layout, t_chunk=t_chunk,
+                              w_dtype=w_dtype)
     return ls / jnp.maximum(nv, 1).astype(jnp.float32)
 
 
 def fused_cross_entropy(x, w, targets, *, ignore_index=-1, impl="blocked",
-                        w_layout="cv", t_chunk=0):
+                        w_layout="cv", t_chunk=0, w_dtype="compute"):
     """Mean token cross-entropy of `x @ w` over non-ignored targets,
     without materializing the (B, T, V) logits.
 
@@ -223,21 +241,24 @@ def fused_cross_entropy(x, w, targets, *, ignore_index=-1, impl="blocked",
     within fp32 tolerance (the fused paths accumulate logits in fp32
     where the reference round-trips them through the compute dtype).
     `impl` must already be resolved ('blocked' | 'pallas' | 'auto');
-    'reference' is the callers' own full-logits branch, not ours."""
+    'reference' is the callers' own full-logits branch, not ours.
+    `w_dtype='int8'` (compute_dtype='int8'): weight-only quantization —
+    blocked consumes the STE fake-quant grid (oracle), pallas streams
+    real int8 stripes with fused dequant (ISSUE 15)."""
     impl = resolve_loss_impl(impl)
     assert impl in ("blocked", "pallas"), (
         "fused_cross_entropy handles the fused impls; the 'reference' "
         "path is the caller's full-logits branch"
     )
     assert w_layout in ("cv", "vc"), f"unknown w_layout {w_layout!r}"
-    _trace_events.append((impl, x.shape, w.shape))
+    _trace_events.append((impl, x.shape, w.shape, w_dtype))
     if impl == "pallas":
         from avenir_tpu.ops.attention import _on_tpu
         from avenir_tpu.ops.pallas.fused_ce import fused_ce_pallas
 
         return fused_ce_pallas(
             x, w, targets, ignore_index=ignore_index, w_layout=w_layout,
-            interpret=not _on_tpu(),
+            interpret=not _on_tpu(), w_dtype=w_dtype,
         )
     return _blocked_ce(x, w, targets, ignore_index=ignore_index,
-                       w_layout=w_layout, t_chunk=t_chunk)
+                       w_layout=w_layout, t_chunk=t_chunk, w_dtype=w_dtype)

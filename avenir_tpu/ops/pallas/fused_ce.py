@@ -82,8 +82,22 @@ def _scores(x, w, j, block_v, vocab, w_layout):
     return jnp.where(col < vocab, s, NEG_INF), col
 
 
-def _fwd_kernel(x_ref, w_ref, y_ref, rows_ref, lse_ref, m_ref, l_ref, t_ref,
-                *, block_v, vocab, ignore_index, w_layout):
+def _dequant_stripe(w_ref, ws_ref, dtype):
+    """Fused dequant of one int8 weight stripe (ISSUE 15): the stripe
+    arrives in VMEM as int8 — HBM moved 1/2 the bf16 bytes per grid
+    step — and the per-vocab-channel scale rides a tiny sidecar block
+    ((1, bv) for 'cv', (bv, 1) for 'vc' — shaped so the broadcast needs
+    no in-kernel transpose)."""
+    return (w_ref[...].astype(jnp.float32)
+            * ws_ref[...].astype(jnp.float32)).astype(dtype)
+
+
+def _fwd_kernel(x_ref, w_ref, *rest, block_v, vocab, ignore_index,
+                w_layout, w_int8=False):
+    if w_int8:
+        ws_ref, y_ref, rows_ref, lse_ref, m_ref, l_ref, t_ref = rest
+    else:
+        y_ref, rows_ref, lse_ref, m_ref, l_ref, t_ref = rest
     j = pl.program_id(1)
     nv = pl.num_programs(1)
 
@@ -93,7 +107,9 @@ def _fwd_kernel(x_ref, w_ref, y_ref, rows_ref, lse_ref, m_ref, l_ref, t_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         t_ref[...] = jnp.zeros_like(t_ref)
 
-    s, col = _scores(x_ref[...], w_ref[...], j, block_v, vocab, w_layout)
+    x = x_ref[...]
+    w = _dequant_stripe(w_ref, ws_ref, x.dtype) if w_int8 else w_ref[...]
+    s, col = _scores(x, w, j, block_v, vocab, w_layout)
     y = y_ref[...]  # (bt, 1) int32
     m_prev = m_ref[:, :1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -127,8 +143,12 @@ def _ds_block(x, w, y, lse, g, j, *, block_v, vocab, ignore_index, w_layout):
     return (p - onehot) * (g * valid)
 
 
-def _dx_kernel(x_ref, w_ref, y_ref, lse_ref, g_ref, dx_ref, dx_acc, *,
-               block_v, vocab, ignore_index, w_layout):
+def _dx_kernel(x_ref, w_ref, *rest, block_v, vocab, ignore_index,
+               w_layout, w_int8=False):
+    if w_int8:
+        ws_ref, y_ref, lse_ref, g_ref, dx_ref, dx_acc = rest
+    else:
+        y_ref, lse_ref, g_ref, dx_ref, dx_acc = rest
     j = pl.program_id(1)
     nv = pl.num_programs(1)
 
@@ -136,7 +156,8 @@ def _dx_kernel(x_ref, w_ref, y_ref, lse_ref, g_ref, dx_ref, dx_acc, *,
     def _init():
         dx_acc[...] = jnp.zeros_like(dx_acc)
 
-    w = w_ref[...]
+    w = (_dequant_stripe(w_ref, ws_ref, x_ref.dtype) if w_int8
+         else w_ref[...])
     ds = _ds_block(x_ref[...], w, y_ref[...], lse_ref[...], g_ref[0, 0], j,
                    block_v=block_v, vocab=vocab, ignore_index=ignore_index,
                    w_layout=w_layout)
@@ -150,8 +171,12 @@ def _dx_kernel(x_ref, w_ref, y_ref, lse_ref, g_ref, dx_ref, dx_acc, *,
         dx_ref[...] = dx_acc[...].astype(dx_ref.dtype)
 
 
-def _dw_kernel(x_ref, w_ref, y_ref, lse_ref, g_ref, dw_ref, dw_acc, *,
-               block_v, vocab, ignore_index, w_layout):
+def _dw_kernel(x_ref, w_ref, *rest, block_v, vocab, ignore_index,
+               w_layout, w_int8=False):
+    if w_int8:
+        ws_ref, y_ref, lse_ref, g_ref, dw_ref, dw_acc = rest
+    else:
+        y_ref, lse_ref, g_ref, dw_ref, dw_acc = rest
     # grid (nv, nt): the row index is innermost so one (C, block_v)
     # stripe of dw accumulates over every row block before ONE flush
     j, i = pl.program_id(0), pl.program_id(1)
@@ -162,7 +187,9 @@ def _dw_kernel(x_ref, w_ref, y_ref, lse_ref, g_ref, dw_ref, dw_acc, *,
         dw_acc[...] = jnp.zeros_like(dw_acc)
 
     x = x_ref[...]
-    ds = _ds_block(x, w_ref[...], y_ref[...], lse_ref[...], g_ref[0, 0], j,
+    w = (_dequant_stripe(w_ref, ws_ref, x.dtype) if w_int8
+         else w_ref[...])
+    ds = _ds_block(x, w, y_ref[...], lse_ref[...], g_ref[0, 0], j,
                    block_v=block_v, vocab=vocab, ignore_index=ignore_index,
                    w_layout=w_layout)
     if w_layout == "cv":  # (bt, C)^T @ (bt, bv) -> (C, bv)
@@ -241,34 +268,65 @@ def _ce_shard_axes(n_rows):
 
 @functools.lru_cache(maxsize=64)
 def _build_fused_ce(vocab, n_embd, w_layout, ignore_index, block_t, block_v,
-                    interpret):
+                    interpret, w_int8=False):
     """custom_vjp over (x2, w, y2) -> scalar loss SUM (the mean's divide
     lives in the caller, so the upstream cotangent already carries the
     1/n_valid factor). One build per static config, lru-cached like
-    flash_attention._build_flash."""
+    flash_attention._build_flash.
+
+    `w_int8` (ISSUE 15): the weight is quantized ONCE per call with
+    per-vocab-channel absmax scales over the contraction axis
+    (ops/quant.py) and every kernel — fwd, dx, dw — consumes int8
+    stripes with the dequant fused after the DMA, so the (V, C)-sized
+    HBM reads of all three grids move int8. dw is emitted against the
+    dequantized grid (straight-through, matching the blocked oracle's
+    fake-quant autodiff), in the compute dtype."""
     nv = -(-vocab // block_v)
     vp = nv * block_v
     kw = dict(block_v=block_v, vocab=vocab, ignore_index=ignore_index,
-              w_layout=w_layout)
+              w_layout=w_layout, w_int8=w_int8)
     if w_layout == "cv":
         w_block, w_index = (n_embd, block_v), lambda i, j: (0, j)
         w_block_jt, w_index_jt = (n_embd, block_v), lambda j, i: (0, j)
+        ws_shape = (1, vp)
+        ws_block, ws_index = (1, block_v), lambda i, j: (0, j)
+        ws_block_jt, ws_index_jt = (1, block_v), lambda j, i: (0, j)
     else:
         w_block, w_index = (block_v, n_embd), lambda i, j: (j, 0)
         w_block_jt, w_index_jt = (block_v, n_embd), lambda j, i: (j, 0)
+        ws_shape = (vp, 1)
+        ws_block, ws_index = (block_v, 1), lambda i, j: (j, 0)
+        ws_block_jt, ws_index_jt = (block_v, 1), lambda j, i: (j, 0)
     row_spec = pl.BlockSpec((block_t, 1), lambda i, j: (i, 0))
     g_spec = lambda ix: pl.BlockSpec((1, 1), ix, memory_space=pltpu.SMEM)
+
+    def _prep_w(w):
+        """Padded weight operands: (wp,) dense, (qw, ws) under w_int8 —
+        quantized AFTER padding (padded channels quantize to exact
+        zeros; their columns are NEG_INF-masked in _scores anyway).
+        Deterministic, so the bwd's re-quantization reproduces the
+        forward grid bit-for-bit."""
+        wp = _pad_vocab(w, vp, w_layout)
+        if not w_int8:
+            return (wp,)
+        from avenir_tpu.ops.quant import quantize_channelwise
+
+        qw, sw = quantize_channelwise(wp, 0 if w_layout == "cv" else 1)
+        return (qw, sw.reshape(ws_shape))
 
     def _kernel_fwd(x2, w, y2):
         """(rows (Np, 1), lse (Np, 1)) on padded rows."""
         np_, _ = x2.shape
         nt = np_ // block_t
+        w_ops = _prep_w(w)
+        w_specs = [pl.BlockSpec(w_block, w_index)] + (
+            [pl.BlockSpec(ws_block, ws_index)] if w_int8 else [])
         return pl.pallas_call(
             functools.partial(_fwd_kernel, **kw),
             grid=(nt, nv),
             in_specs=[
                 pl.BlockSpec((block_t, n_embd), lambda i, j: (i, 0)),
-                pl.BlockSpec(w_block, w_index),
+                *w_specs,
                 row_spec,
             ],
             out_specs=[row_spec, row_spec],
@@ -279,19 +337,21 @@ def _build_fused_ce(vocab, n_embd, w_layout, ignore_index, block_t, block_v,
             scratch_shapes=[pltpu.VMEM((block_t, _LANES), jnp.float32)] * 3,
             compiler_params=_compiler_params(1, 1),
             interpret=interpret,
-        )(x2, _pad_vocab(w, vp, w_layout), y2)
+        )(x2, *w_ops, y2)
 
     def _kernel_bwd(x2, w, y2, lse, g):
         np_, _ = x2.shape
         nt = np_ // block_t
-        wp = _pad_vocab(w, vp, w_layout)
+        w_ops = _prep_w(w)
         g2 = jnp.reshape(g.astype(jnp.float32), (1, 1))
+        w_specs = [pl.BlockSpec(w_block, w_index)] + (
+            [pl.BlockSpec(ws_block, ws_index)] if w_int8 else [])
         dx = pl.pallas_call(
             functools.partial(_dx_kernel, **kw),
             grid=(nt, nv),
             in_specs=[
                 pl.BlockSpec((block_t, n_embd), lambda i, j: (i, 0)),
-                pl.BlockSpec(w_block, w_index),
+                *w_specs,
                 row_spec, row_spec,
                 g_spec(lambda i, j: (0, 0)),
             ],
@@ -300,25 +360,28 @@ def _build_fused_ce(vocab, n_embd, w_layout, ignore_index, block_t, block_v,
             scratch_shapes=[pltpu.VMEM((block_t, n_embd), jnp.float32)],
             compiler_params=_compiler_params(1, 1),
             interpret=interpret,
-        )(x2, wp, y2, lse, g2)
+        )(x2, *w_ops, y2, lse, g2)
+        w_specs_jt = [pl.BlockSpec(w_block_jt, w_index_jt)] + (
+            [pl.BlockSpec(ws_block_jt, ws_index_jt)] if w_int8 else [])
         dwp = pl.pallas_call(
             functools.partial(_dw_kernel, **kw),
             grid=(nv, nt),
             in_specs=[
                 pl.BlockSpec((block_t, n_embd), lambda j, i: (i, 0)),
-                pl.BlockSpec(w_block_jt, w_index_jt),
+                *w_specs_jt,
                 pl.BlockSpec((block_t, 1), lambda j, i: (i, 0)),
                 pl.BlockSpec((block_t, 1), lambda j, i: (i, 0)),
                 g_spec(lambda j, i: (0, 0)),
             ],
             out_specs=pl.BlockSpec(w_block_jt, w_index_jt),
             out_shape=jax.ShapeDtypeStruct(
-                (n_embd, vp) if w_layout == "cv" else (vp, n_embd), w.dtype
+                (n_embd, vp) if w_layout == "cv" else (vp, n_embd),
+                x2.dtype if w_int8 else w.dtype
             ),
             scratch_shapes=[pltpu.VMEM(w_block, jnp.float32)],
             compiler_params=_compiler_params(1, 1),
             interpret=interpret,
-        )(x2, wp, y2, lse, g2)
+        )(x2, *w_ops, y2, lse, g2)
         if vp != vocab:
             dwp = (dwp[:, :vocab] if w_layout == "cv" else dwp[:vocab])
         return dx, dwp
@@ -405,16 +468,20 @@ def _build_fused_ce(vocab, n_embd, w_layout, ignore_index, block_t, block_v,
 
 
 def fused_ce_pallas(x, w, targets, *, ignore_index=-1, w_layout="cv",
-                    block_t=None, block_v=None, interpret=False):
+                    block_t=None, block_v=None, interpret=False,
+                    w_dtype="compute"):
     """Mean token cross-entropy of x @ w without materializing (B, T, V).
     Same contract as ops.fused_ce.fused_cross_entropy (which dispatches
-    here for impl='pallas')."""
+    here for impl='pallas'). `w_dtype='int8'` streams the weight as int8
+    stripes with fused dequant in every kernel — numerics pinned against
+    the blocked fake-quant oracle by tests/test_quant.py."""
     assert w_layout in ("cv", "vc"), f"unknown w_layout {w_layout!r}"
+    assert w_dtype in ("compute", "int8"), f"unknown w_dtype {w_dtype!r}"
     B, T, C = x.shape
     V = w.shape[1] if w_layout == "cv" else w.shape[0]
     bt, bv = pick_ce_blocks(B * T, V, block_t, block_v)
     f = _build_fused_ce(V, C, w_layout, int(ignore_index), bt, bv,
-                        bool(interpret))
+                        bool(interpret), w_dtype == "int8")
     loss_sum = f(x.reshape(B * T, C), w,
                  targets.reshape(B * T).astype(jnp.int32))
     n_valid = jnp.sum(targets != ignore_index)

@@ -1,0 +1,252 @@
+"""int8 quantized-compute matmuls for training (ISSUE 15 tentpole).
+
+Training has been pinned at 62% of bf16 peak for ten PRs; v5e int8 peak
+is ~2x its bf16 peak (394.8 TOPS vs 197 TFLOPS), so the next plateau
+lives behind the MXU's int8 mode. This module is the ONE home for the
+quantized-matmul numerics, behind the `compute_dtype='int8'` config knob
+(the attn_impl/loss_impl/kv_dtype knob pattern; kv side lives in
+ops/kv_quant.py). Which tensors participate is NOT decided here: the
+per-tensor `PrecisionPolicy` rides in the unified partition-rules table
+(parallel/partition.py) — one source of truth per tensor class for BOTH
+sharding and precision, resolved by the models at construction.
+
+Scheme (AQT-style symmetric absmax):
+
+  forward   y = (qx int8 . qw int8 -> int32) * sx * sw, where each
+            operand is quantized PER CHANNEL along its contraction axis
+            (x per row over C, w per output column over C) — scales
+            factor out of the dot exactly, so the MXU consumes int8 and
+            the fp32 rescale is a cheap epilogue.
+  backward  straight-through estimator w.r.t. the quantization grid
+            (round is piecewise constant — its true derivative is 0
+            a.e.; STE passes the cotangent through, the standard and
+            provably-stable choice for symmetric absmax), with BOTH
+            backward matmuls (dx = dy . w^T, dw = x^T . dy) also int8.
+            The residuals saved by the custom_vjp are the int8 data +
+            scales from the forward — HBM holds int8 between the
+            passes, which is the activation-memory half of the win.
+
+Delayed scaling (the `PrecisionPolicy.scaling='delayed'` default): the
+backward quantizes the incoming cotangent with ONE per-tensor scale
+calibrated over the whole window of rows and channels (a single amax
+reduction, reused by both backward matmuls), instead of re-deriving
+per-channel scales per matmul. Gradients are heavy-tailed across
+channels but the tail is what carries the signal — per-tensor absmax
+never clips it — and the single reduction keeps the backward's
+calibration cost O(1) instead of O(channels) reductions on the hot
+path. `scaling='dynamic'` restores per-channel cotangent scales for
+A/B. The x/w sides always reuse the FORWARD-calibrated int8 grid (the
+residuals) — backward never re-quantizes from master weights.
+
+Error budget (docs/PERFORMANCE.md "Past the bf16 plateau"): per-channel
+absmax rounding error is <= scale/2 = amax/254 per element, relative
+error ~0.4% of each channel's dynamic range; the parity contract is the
+loss-TRAJECTORY tolerance pinned by tests/test_quant.py, not bit
+equality — the same contract split as attn_impl='pallas' and
+kv_dtype='int8'.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# symmetric int8 range; absmax maps onto it exactly (ops/kv_quant.py
+# uses the same constants for the KV cache — training side kept
+# separate because the policies differ: per-channel here, per-head there)
+Q_MAX = 127.0
+# floor keeps an all-zero channel from a 0-divide; its dequantized zeros
+# stay exact zeros. Channels that HIT the floor are dead weight-range
+# (see audit_quantization / the quant_scale_clip counter).
+SCALE_FLOOR = 1e-8
+
+# One entry per TRACE of a quantized matmul (appends happen at trace
+# time only) — the ledger idiom shared with ops/fused_ce and
+# infer/decode. tests/test_quant.py pins that steady-state int8 steps
+# never retrace and that the bf16 path never touches this ledger.
+_trace_events = []
+
+
+def trace_count():
+    """Number of int8_matmul traces (== appearances in XLA compiles)."""
+    return len(_trace_events)
+
+
+def quantized_compute(compute_dtype) -> bool:
+    """True when the config's compute_dtype selects the int8 matmul
+    path. The base arithmetic dtype (norms, softmax, residual stream)
+    for 'int8' is bf16 — models/common.resolve_dtype owns that mapping."""
+    return compute_dtype == "int8"
+
+
+def resolve_compute_dtype(compute_dtype) -> str:
+    """The startup-line string for the resolved compute mode — mirrors
+    resolve_attention_impl/resolve_loss_impl so a silent fallback to
+    bf16 matmuls would be visible in the `[tpu]` startup log."""
+    if quantized_compute(compute_dtype):
+        return "int8"
+    return {"bfloat16": "bf16", "float32": "fp32", "float16": "fp16"}.get(
+        compute_dtype, str(compute_dtype))
+
+
+def matmul_bits(compute_dtype) -> int:
+    """Element width of the hot-matmul operands (the `matmul_bits`
+    gauge): 8 under the int8 knob, else the compute dtype's width."""
+    if quantized_compute(compute_dtype):
+        return 8
+    return {"bfloat16": 16, "float16": 16}.get(compute_dtype, 32)
+
+
+def quantize_channelwise(x, axis):
+    """Symmetric absmax int8 along `axis` (the contraction axis):
+    returns (int8 data, fp32 scale with `axis` removed). Per remaining
+    index ("channel"), scale = amax / 127 and data = round(x / scale);
+    round-trip error per element is bounded by scale/2."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axis)
+    scale = jnp.maximum(amax, SCALE_FLOOR) / Q_MAX
+    data = jnp.round(xf / jnp.expand_dims(scale, axis)).astype(jnp.int8)
+    return data, scale
+
+
+def quantize_tensorwise(x):
+    """One per-tensor scale calibrated over the whole window of rows and
+    channels — the delayed-scaling form the backward uses for the
+    cotangent (one amax reduction, shared by both backward matmuls)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf)), SCALE_FLOOR) / Q_MAX
+    data = jnp.round(xf / scale).astype(jnp.int8)
+    return data, scale
+
+
+def dequantize(data, scale, axis, dtype=jnp.float32):
+    """(int8 data, scale) -> dense values in `dtype`; `axis` is where the
+    reduced channel axis sits in `data` (same convention as
+    quantize_channelwise)."""
+    return (data.astype(jnp.float32)
+            * jnp.expand_dims(scale, axis)).astype(dtype)
+
+
+def fake_quant(x, axis):
+    """Straight-through fake quantization: forward lands exactly on the
+    per-channel int8 grid, backward is identity. The blocked fused-CE
+    tail uses this for its weight so plain autodiff reproduces the
+    int8 kernels' STE semantics (the CPU-testable oracle)."""
+    q, s = quantize_channelwise(x, axis)
+    return x + jax.lax.stop_gradient(
+        dequantize(q, s, axis, x.dtype) - x.astype(x.dtype))
+
+
+def _int_dot(qa, qb, dims):
+    """int8 x int8 -> int32 dot_general (the MXU's int8 mode on TPU;
+    XLA's integer dot elsewhere — same accumulation either way)."""
+    return jax.lax.dot_general(qa, qb, (dims, ((), ())),
+                               preferred_element_type=jnp.int32)
+
+
+def _quantize_cotangent(dy, axis, scaling):
+    """Quantize the incoming cotangent for the backward matmuls:
+    'delayed' -> one per-tensor window-calibrated scale (expanded to the
+    per-channel shape so both modes share the matmul epilogue),
+    'dynamic' -> per-channel over the contraction `axis`."""
+    if scaling == "delayed":
+        qdy, sdy = quantize_tensorwise(dy)
+        return qdy, jnp.broadcast_to(
+            sdy, tuple(d for i, d in enumerate(dy.shape) if i != axis
+                       % dy.ndim))
+    return quantize_channelwise(dy, axis)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _int8_matmul(x, w, w_layout, scaling, x_dtype, w_dtype):
+    y, _ = _int8_matmul_fwd(x, w, w_layout, scaling, x_dtype, w_dtype)
+    return y
+
+
+def _int8_matmul_fwd(x, w, w_layout, scaling, x_dtype, w_dtype):
+    # x: (..., K); w: (K, N) for 'io', (N, K) for 'oi' (the GPT tied
+    # embedding's orientation — consumed via contraction dims, never
+    # via a transposed copy, the fused_ce w_layout discipline)
+    k_ax = 0 if w_layout == "io" else 1
+    qx, sx = quantize_channelwise(x, -1)
+    qw, sw = quantize_channelwise(w, k_ax)
+    acc = _int_dot(qx, qw, (((x.ndim - 1,), (k_ax,))))
+    y = (acc.astype(jnp.float32) * sx[..., None] * sw).astype(x_dtype)
+    # residuals are the int8 grids + scales: what HBM holds between the
+    # passes is int8, not the bf16 originals
+    return y, (qx, sx, qw, sw)
+
+
+def _int8_matmul_bwd(w_layout, scaling, x_dtype, w_dtype, res, dy):
+    qx, sx, qw, sw = res
+    k_ax = 0 if w_layout == "io" else 1
+    n_ax = 1 - k_ax
+    dyf = dy.astype(jnp.float32)
+    # dx = dy . w^T (contraction over N): the weight grid from the
+    # forward is re-quantized along N (its forward scales ride along K's
+    # channel axis, which is now a free axis) — double rounding on an
+    # already-int8 grid, error bounded by one further scale/2 step
+    w_dq = dequantize(qw, sw, k_ax)
+    qw2, sw2 = quantize_channelwise(w_dq, n_ax)
+    qdy, sdy = _quantize_cotangent(dyf, -1, scaling)
+    acc = _int_dot(qdy, qw2, (((dy.ndim - 1,), (n_ax,))))
+    dx = (acc.astype(jnp.float32) * sdy[..., None] * sw2).astype(x_dtype)
+    # dw = x^T . dy (contraction over the flattened row window)
+    K = qx.shape[-1]
+    N = dyf.shape[-1]
+    x_dq = dequantize(qx, sx, -1).reshape(-1, K)
+    qx2, sx2 = quantize_channelwise(x_dq, 0)          # (K,)
+    dy2 = dyf.reshape(-1, N)
+    qdy2, sdy2 = _quantize_cotangent(dy2, 0, scaling)  # (N,)
+    acc_w = _int_dot(qx2, qdy2, (((0,), (0,))))        # (K, N)
+    dw_io = acc_w.astype(jnp.float32) * sx2[:, None] * sdy2[None, :]
+    dw = (dw_io if w_layout == "io" else dw_io.T).astype(w_dtype)
+    return dx, dw
+
+
+_int8_matmul.defvjp(_int8_matmul_fwd, _int8_matmul_bwd)
+
+
+def int8_matmul(x, w, *, w_layout="io", scaling="delayed"):
+    """Quantized matmul of x (..., K) with w — (K, N) under
+    w_layout='io' (nnx.Linear kernels), (N, K) under 'oi' (the GPT tied
+    wte embedding). Forward is a true int8 dot with per-channel absmax
+    scales; backward is STE with int8 matmuls over the saved int8
+    residuals (module docstring). `scaling` is the backward cotangent
+    calibration: 'delayed' (per-tensor, window-calibrated — the rules-
+    table default) or 'dynamic' (per-channel)."""
+    assert w_layout in ("io", "oi"), f"unknown w_layout {w_layout!r}"
+    assert scaling in ("delayed", "dynamic"), (
+        f"unknown scaling {scaling!r}; one of ['delayed', 'dynamic']")
+    _trace_events.append((x.shape, w.shape, w_layout, scaling))
+    # dtypes ride as STATIC names (residuals must be jax types; the
+    # cotangents must land back in the primal dtypes)
+    return _int8_matmul(x, w, w_layout, scaling,
+                        jnp.dtype(x.dtype).name, jnp.dtype(w.dtype).name)
+
+
+def audit_quantization(named_arrays):
+    """Host-side startup/bench audit: quantize each (name, array) pair
+    per-channel along its LAST axis and count channels whose scale
+    clamped to SCALE_FLOOR (an all-zero channel — harmless once, but a
+    rising count across a sweep means dead channels are wasting int8
+    range). Bumps the `quant_scale_clip` counter by the total and
+    returns {name: clipped_channels}. Pure numpy — callable on
+    checkpoint trees and on gathered params without entering jit."""
+    import numpy as np
+
+    from avenir_tpu.obs.metrics import get_registry
+
+    out = {}
+    total = 0
+    for name, arr in named_arrays:
+        a = np.asarray(arr, dtype=np.float32)
+        if a.ndim < 2:
+            continue  # scalar/vector params never quantize (rules table)
+        amax = np.max(np.abs(a), axis=-1)
+        n = int(np.sum(amax <= SCALE_FLOOR))
+        out[name] = n
+        total += n
+    if total:
+        get_registry().counter("quant_scale_clip").add(total)
+    return out

@@ -16,8 +16,11 @@ from avenir_tpu.parallel.mesh import (
     parse_mesh_shape,
 )
 from avenir_tpu.parallel.partition import (
+    PrecisionPolicy,
     batch_pspec,
     match_partition_rules,
+    match_precision_rules,
     named_shardings,
+    precision_for,
     rules_for_model,
 )

@@ -1,9 +1,20 @@
-"""Regex partition rules → PartitionSpec pytrees (SURVEY.md §2b T4).
+"""The unified partition + precision rules table (SURVEY.md §2b T4;
+ISSUE 15 refactor).
 
 The pattern follows the public match_partition_rules idiom (SNIPPETS.md:19-32):
-param paths are '/'-joined strings, rules are (regex, PartitionSpec) pairs
-tried in order, and an unmatched param is a hard error — fail loud
-(SNIPPETS.md:31) so silent replication can't eat HBM.
+param paths are '/'-joined strings, rules are ordered
+(regex, PartitionSpec, PrecisionPolicy) rows tried in order, and an
+unmatched param is a hard error — fail loud (SNIPPETS.md:31) so silent
+replication can't eat HBM (and a tensor with no declared precision can't
+silently pick one).
+
+ONE table serves every model family: each row is a TENSOR CLASS
+(column-parallel up-projection, row-parallel down-projection, embedding,
+norm, ...) whose regex names that class's parameter paths across
+GPT/Llama/Mixtral, so sharding AND quantization policy are declared once
+per class instead of once per (family, tensor). The per-family resolved
+specs are bit-equal to the old hand-wired per-family tables (pinned by
+tests/test_partition.py::test_unified_rules_match_legacy_specs).
 
 Sharding conventions (axes from mesh.AXES):
   - Linear kernels alternate ('fsdp','tensor') / ('tensor','fsdp') —
@@ -14,53 +25,84 @@ Sharding conventions (axes from mesh.AXES):
   - The batch shards on ('data','fsdp') combined: 'fsdp' is still data
     parallelism (ZeRO), it just also shards the params — XLA SPMD emits
     the all-gather-at-use / reduce-scatter-of-grads (BASELINE.json:9).
+
+Precision conventions (consumed under `compute_dtype='int8'`,
+ops/quant.py; inert at bf16/fp32 — the bf16 path through this table is
+bit-identical to the old one):
+  - Matmul kernels (projections, expert FFNs, the lm-head / tied wte in
+    its MATMUL uses) quantize with delayed backward scaling.
+  - Norm scales, biases, the position table and the tiny MoE router gate
+    never quantize (sub-percent of FLOPs; router logits decide token
+    routing, where rounding errors change the computation graph, not
+    just its numerics).
+  - Scalar/vector params are structurally skipped besides (there is no
+    contraction axis to carry a per-channel scale) —
+    match_precision_rules coerces them to no-quant whatever their row
+    says.
 """
 
+import functools
 import re
+from typing import NamedTuple
 
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
-# ---- rule tables per model family ----
+class PrecisionPolicy(NamedTuple):
+    """Per-tensor-class precision policy, riding in the rules table next
+    to the PartitionSpec: `quantize` marks the tensor's MATMUL consumers
+    as int8-eligible under compute_dtype='int8' (a tensor can also be
+    consumed by gathers — the wte embedding lookup — which never
+    quantize); `scaling` picks the backward cotangent calibration
+    (ops/quant.py: 'delayed' per-tensor window-calibrated, 'dynamic'
+    per-channel)."""
 
-GPT_RULES = (
-    (r"wte/embedding$", P("tensor", "fsdp")),
-    (r"wpe/embedding$", P(None, "fsdp")),
-    (r"attn/c_attn/kernel$", P("fsdp", "tensor")),
-    (r"attn/c_attn/bias$", P("tensor")),
-    (r"attn/c_proj/kernel$", P("tensor", "fsdp")),
-    (r"attn/c_proj/bias$", P()),
-    (r"mlp/c_fc/kernel$", P("fsdp", "tensor")),
-    (r"mlp/c_fc/bias$", P("tensor")),
-    (r"mlp/c_proj/kernel$", P("tensor", "fsdp")),
-    (r"mlp/c_proj/bias$", P()),
-    (r"(ln_1|ln_2|ln_f)/(scale|bias)$", P()),
+    quantize: bool = False
+    scaling: str = "delayed"
+
+
+QUANT = PrecisionPolicy(quantize=True, scaling="delayed")
+NO_QUANT = PrecisionPolicy(quantize=False)
+
+# ---- THE rules table: one row per tensor class, all families ----
+
+UNIFIED_RULES = (
+    # Mixtral stacked experts: (E, in, out) nnx.Params on a leading
+    # 'expert' axis; w1/w3 up-project (column-parallel), w2 down-projects
+    (r"experts/(w1|w3)$", P("expert", "fsdp", "tensor"), QUANT),
+    (r"experts/w2$", P("expert", "tensor", "fsdp"), QUANT),
+    # tiny MoE router: replicated, never quantized (routing decisions)
+    (r"block_sparse_moe/gate/kernel$", P(None, None), NO_QUANT),
+    # token embeddings: vocab on 'tensor', features on 'fsdp'. QUANT
+    # applies to the tensor's matmul uses (the GPT TIED lm-head consumes
+    # wte as the CE projection); the embedding GATHER itself never
+    # quantizes.
+    (r"(wte|embed_tokens)/embedding$", P("tensor", "fsdp"), QUANT),
+    # learned position table: gather-only, no matmul use
+    (r"wpe/embedding$", P(None, "fsdp"), NO_QUANT),
+    # column-parallel up-projections (QKV, MLP up / gate, untied lm-head)
+    (r"(attn/c_attn|mlp/c_fc|q_proj|k_proj|v_proj|gate_proj|up_proj"
+     r"|lm_head)/kernel$", P("fsdp", "tensor"), QUANT),
+    # row-parallel down-projections (attention out, MLP down)
+    (r"(attn/c_proj|mlp/c_proj|o_proj|down_proj)/kernel$",
+     P("tensor", "fsdp"), QUANT),
+    # biases follow their kernel's output sharding; never quantized
+    (r"(attn/c_attn|mlp/c_fc)/bias$", P("tensor"), NO_QUANT),
+    (r"(attn/c_proj|mlp/c_proj)/bias$", P(), NO_QUANT),
+    # norms: replicated, fp32-sensitive, never quantized
+    (r"(ln_1|ln_2|ln_f)/(scale|bias)$", P(), NO_QUANT),
+    (r"(input_layernorm|post_attention_layernorm|norm)/scale$", P(),
+     NO_QUANT),
 )
-
-LLAMA_RULES = (
-    (r"embed_tokens/embedding$", P("tensor", "fsdp")),
-    (r"(q_proj|k_proj|v_proj)/kernel$", P("fsdp", "tensor")),
-    (r"o_proj/kernel$", P("tensor", "fsdp")),
-    (r"(gate_proj|up_proj)/kernel$", P("fsdp", "tensor")),
-    (r"down_proj/kernel$", P("tensor", "fsdp")),
-    (r"lm_head/kernel$", P("fsdp", "tensor")),
-    (r"(input_layernorm|post_attention_layernorm|norm)/scale$", P()),
-)
-
-MIXTRAL_RULES = (
-    # experts are stacked nnx.Params on a leading 'expert' axis: (E, in, out)
-    (r"experts/(w1|w3)$", P("expert", "fsdp", "tensor")),
-    (r"experts/w2$", P("expert", "tensor", "fsdp")),
-    (r"block_sparse_moe/gate/kernel$", P(None, None)),  # tiny router, replicated
-) + LLAMA_RULES
 
 
 def rules_for_model(model_type: str):
-    return {
-        "gpt": GPT_RULES,
-        "llama": LLAMA_RULES,
-        "mixtral": MIXTRAL_RULES,
-    }[model_type]
+    """Every family resolves through the SAME table (the point of the
+    refactor); the family argument stays as the fail-loud gate on
+    unknown model types and the hook for any future family-gated row."""
+    assert model_type in ("gpt", "llama", "mixtral"), (
+        f"unknown model_type {model_type!r}")
+    return UNIFIED_RULES
 
 
 def path_str(path) -> str:
@@ -77,17 +119,20 @@ def has_scan_segment(path) -> bool:
 
 
 def match_partition_rules(rules, paths):
-    """Map each path (tuple or string) to its first matching PartitionSpec.
+    """Map each path (tuple or string) to its first matching PartitionSpec
+    (ordering wins — the first row whose regex matches decides).
     Params under a scan-stacked container get a leading 'pipe' axis:
     with pipeline parallelism each stage owns a contiguous block of
     layers (parallel/pipeline.py); on meshes without a pipe axis (size
     1) the entry is inert and each scan step finds its full layer
-    weights locally. Raises ValueError listing every unmatched path."""
+    weights locally. Raises ValueError listing every unmatched path.
+    Accepts both unified 3-tuple rows and legacy (regex, spec) pairs
+    (tests that pin the old hand-wired tables)."""
     out = {}
     misses = []
     for path in paths:
         s = path_str(path) if not isinstance(path, str) else path
-        for pattern, spec in rules:
+        for pattern, spec, *_ in rules:
             if re.search(pattern, s):
                 out[path] = (P("pipe", *tuple(spec))
                              if has_scan_segment(path) else spec)
@@ -100,6 +145,48 @@ def match_partition_rules(rules, paths):
             "Add a rule — silent replication is not allowed."
         )
     return out
+
+
+def match_precision_rules(rules, paths, shapes=None):
+    """The precision half of the same table: map each path to its
+    PrecisionPolicy by the SAME ordered first-match walk as
+    match_partition_rules — one regex, one row, both halves of the
+    tensor's policy. Legacy 2-tuple rows resolve to NO_QUANT.
+
+    `shapes` (when given, {path: dims}) applies the scalar skip: params
+    with fewer than 2 dims (norm scales, biases) coerce to NO_QUANT
+    whatever their row says — a vector has no contraction axis to carry
+    a per-channel scale. Fail-loud on unmatched paths, same policy."""
+    out = {}
+    misses = []
+    for path in paths:
+        s = path_str(path) if not isinstance(path, str) else path
+        for pattern, _spec, *rest in rules:
+            if re.search(pattern, s):
+                pol = rest[0] if rest else NO_QUANT
+                if shapes is not None and len(shapes[path]) < 2:
+                    pol = NO_QUANT  # scalar skip (structural)
+                out[path] = pol
+                break
+        else:
+            misses.append(s)
+    if misses:
+        raise ValueError(
+            f"no precision rule matched param path(s): {misses}. "
+            "Add a rule — a tensor with no declared precision policy "
+            "is not allowed."
+        )
+    return out
+
+
+@functools.lru_cache(maxsize=256)
+def precision_for(model_type: str, key: str) -> PrecisionPolicy:
+    """PrecisionPolicy for one canonical param-path suffix (e.g.
+    'attn/c_attn/kernel') — the call-site form the models use at
+    construction to decide which matmuls take the int8 path under
+    compute_dtype='int8'. Same table, same first-match ordering, same
+    fail-loud contract as match_precision_rules."""
+    return match_precision_rules(rules_for_model(model_type), (key,))[key]
 
 
 def sanitize_specs(spec_by_path, shapes, mesh, *, strict=False, log=None):

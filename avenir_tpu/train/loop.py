@@ -97,7 +97,11 @@ def build_model_factory(cfg, model_args, mesh=None):
             n_layer=model_args["n_layer"], n_head=model_args["n_head"],
             n_embd=model_args["n_embd"], dropout=model_args["dropout"],
             bias=model_args["bias"],
-            compute_dtype=("float32" if cfg["dtype"] == "float16" else cfg["dtype"]),
+            # the compute_dtype knob ('int8' = quantized hot matmuls over
+            # a bf16 base, ops/quant.py) overrides the dtype-derived base
+            compute_dtype=(cfg.get("compute_dtype")
+                           or ("float32" if cfg["dtype"] == "float16"
+                               else cfg["dtype"])),
             attn_impl=(cp or cfg.get("attn_impl")
                        or ("auto" if cfg["use_pallas"] else "xla")),
             remat=cfg["remat"],
@@ -318,6 +322,17 @@ def run_training(cfg):
             f"sharded set in {resume_src['dir']} disappeared or tore "
             "between the header check and the body read"
         )
+    # matmul element width as a gauge (the kv_dtype idiom): an int8 run
+    # that silently fell back to bf16 matmuls would halve throughput
+    # with zero visible cause — the gauge plus the startup line below
+    # make the resolved width a recorded fact on every process
+    from avenir_tpu.ops.quant import matmul_bits, resolve_compute_dtype
+
+    _compute_resolved = resolve_compute_dtype(
+        getattr(st["model_config"], "compute_dtype", cfg["dtype"]))
+    get_registry().gauge("matmul_bits").set(
+        matmul_bits(getattr(st["model_config"], "compute_dtype",
+                            cfg["dtype"])))
     if master:
         # print the RESOLVED hot-path impls — a silent fallback to the slow
         # path on a misconfigured pod must be visible at startup
@@ -341,6 +356,7 @@ def run_training(cfg):
             print(f"[tpu] pipeline_schedule={sched} "
                   f"microbatches={cfg.get('pipeline_microbatches', 0) or 'auto'}")
         print(f"[tpu] attention={attn_resolved} loss={loss_resolved} "
+              f"compute={_compute_resolved} "
               f"optimizer=optax_adamw "
               f"scan_layers={cfg.get('scan_layers', False)} "
               f"remat={cfg.get('remat', False)}")
@@ -363,6 +379,30 @@ def run_training(cfg):
     else:
         params = restore_params(ckpt, st["abs_state"], shardings,
                                 model_family=st["model_type"])
+
+    # int8 startup audit (ISSUE 15 obs satellite): count weight channels
+    # whose quantization scale clamps to the floor (dead channels waste
+    # int8 range — harmless at init, a symptom worth a counter when
+    # restoring a long-trained checkpoint). Scoped to the tensors the
+    # rules table actually quantizes — a dead wpe row or router column
+    # never enters the int8 path and must not pollute the counter. One
+    # host gather, single-process only (a pod-wide gather at startup is
+    # not worth a counter).
+    if _compute_resolved == "int8" and jax.process_count() == 1:
+        from avenir_tpu.ops.quant import audit_quantization
+        from avenir_tpu.parallel.partition import match_precision_rules
+
+        flat = params.flat_state()
+        pols = match_precision_rules(
+            rules_for_model(st["model_type"]), [p for p, _ in flat],
+            {p: tuple(v.get_value().shape) for p, v in flat})
+        clipped = audit_quantization(
+            (("/".join(str(s) for s in p), np.asarray(v.get_value()))
+             for p, v in flat if pols[p].quantize))
+        n_clip = sum(clipped.values())
+        if master and n_clip:
+            print(f"[tpu] quant audit: {n_clip} weight channel(s) at the "
+                  "scale floor (quant_scale_clip)")
 
     # ---- optimizer ----
     tx, lr_schedule = make_optimizer(

@@ -47,8 +47,9 @@ def make_step_fns(graphdef, *, dropout: float):
     """
 
     def _i32(t):
-        # batches arrive uint16 (the loader's wire format — half the H2D
-        # bytes; data/loader.py) — widen on device, fused into the gather
+        # batches arrive in the loader's narrow wire dtype (uint16
+        # legacy, uint32 for >65536-vocab v2 files — data/loader.py) —
+        # widen on device, fused into the gather
         return t.astype(jnp.int32) if t.dtype != jnp.int32 else t
 
     def micro_loss(params, x, y, step_rng):

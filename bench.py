@@ -48,6 +48,14 @@ def _peak_hbm_bytes():
     return peak_hbm_bytes()
 
 
+def _resolved_compute(compute_dtype, base_dtype):
+    """ONE home for the bench's resolved-precision string (mirrors the
+    trainer startup line): 'int8' under the knob, else the base."""
+    from avenir_tpu.ops.quant import resolve_compute_dtype
+
+    return resolve_compute_dtype(compute_dtype or base_dtype)
+
+
 def _gpt_mfu(value, *, n_layer, n_head, n_embd, block):
     """tokens/sec/chip → MFU for a GPT at these dims. ONE home for the
     param-count/flops accounting so the loop and step forms can never
@@ -76,7 +84,7 @@ def _gpt_mfu(value, *, n_layer, n_head, n_embd, block):
 
 
 def _loop_form(args, *, attn_impl, on_tpu, block, batch, scan=False,
-               remat=False, loss_impl="auto"):
+               remat=False, loss_impl="auto", compute_dtype=""):
     """Measure through the shipped training loop. Builds a synthetic
     uint16 token memmap (the loader's real path; content is irrelevant to
     throughput), runs run_training for 5 full 32-step dispatch windows,
@@ -124,6 +132,7 @@ def _loop_form(args, *, attn_impl, on_tpu, block, batch, scan=False,
             seed=1337, mesh_shape="", remat=remat, scan_layers=scan,
             use_pallas=attn_impl == "pallas", attn_impl=attn_impl,
             loss_impl=loss_impl, loss_chunk=0,
+            compute_dtype=compute_dtype,
             fused_adamw=False, profile=False,
             allow_unsharded_fallback=False,
         )
@@ -185,6 +194,10 @@ def _loop_form(args, *, attn_impl, on_tpu, block, batch, scan=False,
             # record what actually ran (auto resolves per platform) plus
             # the run's peak HBM — the loss-tail memory win's ledger
             "loss_impl": resolve_loss_impl(cfg["loss_impl"]),
+            # the resolved matmul precision (ISSUE 15): BENCH artifacts
+            # must say which compute path their headline measured
+            "compute_dtype": _resolved_compute(cfg.get("compute_dtype"),
+                                               cfg["dtype"]),
             "peak_hbm_bytes": _peak_hbm_bytes(),
         }
     finally:
@@ -260,6 +273,12 @@ def main():
     from avenir_tpu.ops.fused_ce import resolve_loss_impl
 
     resolve_loss_impl(loss_impl)  # validate before burning chip time
+    # --compute_dtype=int8 arms the quantized-matmul path (ops/quant.py);
+    # '' follows the base dtype — validated up front like --timing
+    compute_dtype = args.get("compute_dtype", "")
+    assert compute_dtype in ("", "int8", "bfloat16", "float32"), (
+        f"--compute_dtype must be ''|int8|bfloat16|float32, got "
+        f"{compute_dtype!r}")
     if form == "loop":
         # --dispatch selects the step harness's dispatcher; the loop form
         # always uses the trainer's windowed dispatch — reject rather than
@@ -271,7 +290,7 @@ def main():
         value, mfu, extra = _loop_form(
             args, attn_impl=attn_impl, on_tpu=on_tpu, block=block,
             batch=batch_candidates[0], scan=scan, remat=remat,
-            loss_impl=loss_impl,
+            loss_impl=loss_impl, compute_dtype=compute_dtype,
         )
         result = {
             "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
@@ -292,7 +311,8 @@ def main():
     cfg = GPTConfig(
         block_size=block, vocab_size=50304, n_layer=12, n_head=12,
         n_embd=768, dropout=0.0, bias=True,
-        compute_dtype="bfloat16" if on_tpu else "float32",
+        compute_dtype=(compute_dtype
+                       or ("bfloat16" if on_tpu else "float32")),
         attn_impl=attn_impl,
         remat=remat,
         scan_layers=scan,
@@ -425,6 +445,7 @@ def main():
             "remat": cfg.remat,
             "scan_layers": cfg.scan_layers,
             "loss_impl": resolve_loss_impl(cfg.loss_impl),
+            "compute_dtype": _resolved_compute(cfg.compute_dtype, cfg.compute_dtype),
             "peak_hbm_bytes": _peak_hbm_bytes(),
         },
     }

@@ -748,3 +748,38 @@ def test_process_worker_ships_sketch_deltas_parent_merges(tiny_model):
         assert snap["step_time_ms"]["sketch"]["count"] == sk.count
     finally:
         router.close()
+
+
+def test_int8_to_bf16_silent_fallback_fires_step_time_drift(tmp_path):
+    """ISSUE 15 obs satellite, driven clock: an int8 run whose matmuls
+    silently fall back to bf16 roughly DOUBLES its step time — a
+    permanent plateau, not a stall, so the watchdog stays quiet by
+    design and step_time_drift is the tier that must catch it (pair the
+    fire with the matmul_bits gauge to name the cause). 2x is far past
+    the detector's 35% min_rel floor: it must fire within a few checks
+    of the flip, and never before it."""
+    t = [0.0]
+    ae = AnomalyEngine(registry=MetricsRegistry(), clock=lambda: t[0],
+                       window_s=1.0, check_interval_s=1.0,
+                       detectors=[Detector("step_time_drift",
+                                           z_thresh=4.0, min_rel=0.35,
+                                           sustain=2, min_windows=8)])
+    rng = np.random.default_rng(1)
+    fired_at = None
+    for i in range(48):
+        t[0] = float(i)
+        # 24 healthy int8 windows at ~110ms, then the silent bf16
+        # fallback: ~220ms from one window to the next, permanently
+        base = 110.0 if i < 24 else 220.0
+        ae.observe("step_time_ms", base + rng.normal(0, 2.0))
+        out = ae.check()
+        if out and fired_at is None:
+            fired_at = i
+        if i < 24:
+            assert not out, f"fired on healthy int8 steady state at {i}"
+    assert fired_at is not None, "2x silent-fallback step time never fired"
+    assert fired_at <= 32, f"fired too late ({fired_at}) after the flip at 24"
+    ev = ae.fired[0]
+    assert ev["detector"] == "step_time_drift"
+    assert ev["value"] > 1.8 * ev["baseline"]  # ~2x the int8 baseline
+    assert ev["rel_rise"] > 0.35

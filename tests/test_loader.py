@@ -143,3 +143,128 @@ def test_process_streams_disjoint(loader_dir, monkeypatch):
     x0, _ = dl0.get_batch("train")
     x1, _ = dl1.get_batch("train")
     assert not np.array_equal(np.asarray(x0), np.asarray(x1))
+
+
+# ---------------------------------------------------------------------------
+# v2 uint32 wire format (ISSUE 15 satellite: the >65536-vocab path)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def u32_dir(tmp_path):
+    """A v2 uint32 corpus with token ids past the uint16 cap (the
+    Llama-3 128k-vocab shape), train + val."""
+    from avenir_tpu.data.loader import write_token_file
+
+    rng = np.random.default_rng(0)
+    vocab = 128_256
+    for split, n in (("train", 20_000), ("val", 4_000)):
+        toks = rng.integers(0, vocab, n).astype(np.uint32)
+        # guarantee ids beyond the uint16 wire in both splits
+        toks[::7] = rng.integers(70_000, vocab, toks[::7].shape)
+        dt = write_token_file(str(tmp_path / f"{split}.bin"), toks,
+                              vocab_size=vocab)
+        assert dt == np.dtype(np.uint32)
+    return str(tmp_path)
+
+
+def test_write_token_file_picks_narrowest_form(tmp_path):
+    from avenir_tpu.data.loader import (
+        WIRE_HEADER_BYTES,
+        read_wire_format,
+        write_token_file,
+    )
+
+    small = tmp_path / "small.bin"
+    assert write_token_file(str(small), np.arange(100), 50_000) \
+        == np.dtype(np.uint16)
+    # legacy form is headerless raw uint16 — bit-compatible with every
+    # existing .bin consumer
+    dt, off = read_wire_format(str(small))
+    assert (dt, off) == (np.dtype(np.uint16), 0)
+    np.testing.assert_array_equal(
+        np.fromfile(small, dtype=np.uint16), np.arange(100))
+
+    big = tmp_path / "big.bin"
+    assert write_token_file(str(big), np.arange(100), 128_256) \
+        == np.dtype(np.uint32)
+    dt, off = read_wire_format(str(big))
+    assert (dt, off) == (np.dtype(np.uint32), WIRE_HEADER_BYTES)
+    np.testing.assert_array_equal(
+        np.fromfile(big, dtype=np.uint32, offset=off), np.arange(100))
+
+
+def test_u32_loader_serves_wide_ids(u32_dir):
+    """The 128k vocab passes the construction gate against a v2 file,
+    batches arrive uint32, and ids beyond 65535 survive the wire."""
+    dl = DataLoader(u32_dir, block_size=32, batch_size=4, grad_accum=2,
+                    seed=0, vocab_size=128_256)
+    x, y = dl.get_batch("train")
+    assert x.shape == (2, 4, 32)
+    assert np.asarray(x).dtype == np.uint32
+    assert int(np.asarray(x).max()) > 65_535  # really past the old wire
+    np.testing.assert_array_equal(np.asarray(x)[..., 1:],
+                                  np.asarray(y)[..., :-1])
+
+
+def test_legacy_wire_still_rejects_oversized_vocab(loader_dir):
+    """The uint16 fail-loud is unchanged for legacy files — only the v2
+    uint32 form opens the gate."""
+    with pytest.raises(AssertionError, match="wire"):
+        DataLoader(loader_dir, block_size=32, batch_size=4,
+                   vocab_size=128_256)
+
+
+def test_u32_fast_forward_bit_identical_resume(u32_dir):
+    """The deterministic-resume contract over the NEW form: a fresh
+    loader fast-forwarded past k consumed draws reproduces the
+    uninterrupted loader's stream BIT-identically (the bound-aware rng
+    replay must use the v2 header-offset bound, not the raw file
+    size)."""
+    a = DataLoader(u32_dir, block_size=16, batch_size=2, grad_accum=2,
+                   seed=9, vocab_size=128_256)
+    stream = [a.get_batch("train") for _ in range(4)]
+    b = DataLoader(u32_dir, block_size=16, batch_size=2, grad_accum=2,
+                   seed=9, vocab_size=128_256)
+    b.fast_forward([("train", 3)])
+    xb, yb = b.get_batch("train")
+    np.testing.assert_array_equal(np.asarray(stream[3][0]), np.asarray(xb))
+    np.testing.assert_array_equal(np.asarray(stream[3][1]), np.asarray(yb))
+
+
+def test_u32_windowed_prefetch_stream_order(u32_dir):
+    """The windowed/prefetch path over the v2 form stays bit-identical
+    to fresh single draws (the uint16 twin of
+    test_prefetch_preserves_stream_order)."""
+    a = DataLoader(u32_dir, block_size=16, batch_size=2, seed=4)
+    xw, _ = a.get_batch_window("train", 3)
+    b = DataLoader(u32_dir, block_size=16, batch_size=2, seed=4)
+    singles = np.stack([np.asarray(b.get_batch("train")[0])
+                        for _ in range(3)])
+    np.testing.assert_array_equal(np.asarray(xw), singles)
+
+
+def test_unknown_header_fails_loud(tmp_path):
+    from avenir_tpu.data.loader import WIRE_MAGIC, read_wire_format
+
+    p = tmp_path / "bad.bin"
+    p.write_bytes(WIRE_MAGIC + bytes([9, 2, 0, 0]) + b"\x00" * 64)
+    with pytest.raises(AssertionError, match="version"):
+        read_wire_format(str(p))
+    p2 = tmp_path / "bad2.bin"
+    p2.write_bytes(WIRE_MAGIC + bytes([2, 9, 0, 0]) + b"\x00" * 64)
+    with pytest.raises(AssertionError, match="dtype code"):
+        read_wire_format(str(p2))
+
+
+def test_u32_batch_widens_on_device_like_uint16(u32_dir):
+    """train/step._i32 widens whatever the wire delivers: a uint32
+    batch through the jitted cast lands int32 with values intact."""
+    import jax.numpy as jnp
+
+    dl = DataLoader(u32_dir, block_size=16, batch_size=2, grad_accum=1,
+                    seed=1, vocab_size=128_256)
+    x, _ = dl.get_batch("train")
+    widened = jax.jit(lambda t: t.astype(jnp.int32))(jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(widened),
+                                  np.asarray(x).astype(np.int32))

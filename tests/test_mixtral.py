@@ -114,7 +114,13 @@ def test_ep_trajectory_matches_and_hlo_has_all_to_all(char_dataset, tmp_path):
     np.testing.assert_allclose(got_l, ref_l, atol=3e-4, rtol=3e-4)
 
 
-def test_ep_hlo_contains_all_to_all(char_dataset):
+_EP_HLO_FRESH = []  # first lowering of the session, cached for the
+# isolation-order pin (one ~6s SPMD compile instead of two)
+
+
+def _lower_ep_step_hlo():
+    """Compile the expert:4 train step and return its final HLO text —
+    the shared lowering for the EP-exchange tests below."""
     from flax import nnx as _nnx
     from jax.sharding import NamedSharding
 
@@ -144,10 +150,94 @@ def test_ep_hlo_contains_all_to_all(char_dataset):
     train_step, _ = make_step_fns(st["graphdef"], dropout=0.0)
     bsh = NamedSharding(mesh, batch_pspec())
     x = jax.device_put(np.zeros((1, 8, 32), np.int32), bsh)
-    hlo = jax.jit(
+    return jax.jit(
         lambda p, o, r, xx, yy: train_step(p, o, tx, r, xx, yy)
     ).lower(params, opt_state, jax.random.key(0), x, x).compile().as_text()
-    assert "all-to-all" in hlo, "EP dispatch did not lower to all-to-all"
+
+
+def _ep_exchange_kind(hlo):
+    """Classify how the compiled EP step exchanges tokens over the
+    expert axis. On an expert:4 mesh every other axis has size 1, so ANY
+    cross-device collective in the module runs over the expert groups:
+
+      'all-to-all'  the canonical EP dispatch (what GSPMD emits on
+                    modern partitioners / TPU — the ICI economics the
+                    docstring claims)
+      'gathered'    this container's legacy XLA:CPU partitioner instead
+                    decomposes the gather-based dispatch into expert-
+                    group all-gathers of the token rows + a collective-
+                    permute chain (verified from the post-SPMD dump:
+                    the (N, d) rows are gathered to each expert shard,
+                    which then gathers its C tokens locally) — same
+                    exchange, different (chattier) lowering
+      None          NO collective at all: the dispatch silently
+                    unpartitioned / fully replicated — the regression
+                    this test exists to catch on every runtime
+    """
+    if "all-to-all" in hlo:
+        return "all-to-all"
+    if "all-gather" in hlo or "collective-permute" in hlo:
+        return "gathered"
+    return None
+
+
+def _legacy_partitioner():
+    from avenir_tpu import compat
+
+    return getattr(jax, "shard_map", None) is compat.shard_map
+
+
+def test_ep_hlo_contains_all_to_all(char_dataset):
+    """The EP dispatch must EXCHANGE tokens over the expert axis in the
+    compiled step. Strict all-to-all where the partitioner forms it
+    (modern jax); the legacy jax-0.4.x CPU partitioner in this container
+    never forms one for the gather-based dispatch (it decomposes into
+    expert-group all-gathers — see _ep_exchange_kind), which is the
+    environment drift that made this assertion an unconditional failure
+    for three PRs. Either way a module with NO expert collective fails:
+    that would mean the dispatch silently stopped being partitioned."""
+    hlo = _lower_ep_step_hlo()
+    if not _EP_HLO_FRESH:
+        _EP_HLO_FRESH.append(_ep_exchange_kind(hlo))
+    kind = _ep_exchange_kind(hlo)
+    assert kind is not None, (
+        "EP step compiled with no expert-axis collective at all — the "
+        "dispatch is no longer partitioned over 'expert'"
+    )
+    if not _legacy_partitioner():
+        assert kind == "all-to-all", (
+            f"EP dispatch lowered to {kind!r} on a modern partitioner — "
+            "expected the canonical all-to-all"
+        )
+
+
+@pytest.mark.slow
+def test_ep_hlo_classification_is_order_independent(char_dataset):
+    """Isolation-order pin for the fix above: the exchange
+    classification must not depend on what compiled before it (the old
+    assertion was reported as order-dependent across PRs 12-14). Lower
+    once fresh (reusing the in-session cache when the tier-1 test
+    already lowered first — that ordering is itself part of the pin),
+    then again after unrelated SPMD work on a different mesh has
+    populated caches and ambient state, and require the SAME
+    classification."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from avenir_tpu.parallel.mesh import make_mesh
+
+    first = (_EP_HLO_FRESH[0] if _EP_HLO_FRESH
+             else _ep_exchange_kind(_lower_ep_step_hlo()))
+    # unrelated SPMD compilation on a different mesh (the kind of
+    # neighbor the full tier-1 run interleaves before this file)
+    mesh = make_mesh("data:2,fsdp:2")
+    sh = NamedSharding(mesh, P(("data", "fsdp")))
+    arr = jax.device_put(np.ones((8, 16), np.float32), sh)
+    jax.jit(lambda a: (a * 2).sum())(arr).block_until_ready()
+    second = _ep_exchange_kind(_lower_ep_step_hlo())
+    assert first == second, (
+        f"EP exchange classification flipped with compile order: "
+        f"{first!r} fresh vs {second!r} after unrelated SPMD work"
+    )
 
 
 def test_expert_opt_state_sharded(char_dataset):

@@ -12,9 +12,14 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from avenir_tpu.parallel.mesh import AXES, make_mesh, parse_mesh_shape
 from avenir_tpu.parallel.partition import (
+    NO_QUANT,
+    QUANT,
+    PrecisionPolicy,
     constrain,
     has_scan_segment,
     match_partition_rules,
+    match_precision_rules,
+    precision_for,
     rules_for_model,
     sanitize_specs,
 )
@@ -53,6 +58,161 @@ def test_rules_cover_every_param(family, ctor_info):
     paths = [p for p, _ in nnx.state(model, nnx.Param).flat_state()]
     specs = match_partition_rules(rules_for_model(family), paths)
     assert set(specs) == set(paths)
+
+
+# ---------------------------------------------------------------------------
+# the unified rules table (ISSUE 15 refactor)
+# ---------------------------------------------------------------------------
+
+# The pre-refactor hand-wired per-family tables, kept VERBATIM as test
+# fixtures: the unified table's resolved specs must be bit-equal to
+# these for every param of every family (the bf16 path through the new
+# table is the old path).
+LEGACY_GPT_RULES = (
+    (r"wte/embedding$", P("tensor", "fsdp")),
+    (r"wpe/embedding$", P(None, "fsdp")),
+    (r"attn/c_attn/kernel$", P("fsdp", "tensor")),
+    (r"attn/c_attn/bias$", P("tensor")),
+    (r"attn/c_proj/kernel$", P("tensor", "fsdp")),
+    (r"attn/c_proj/bias$", P()),
+    (r"mlp/c_fc/kernel$", P("fsdp", "tensor")),
+    (r"mlp/c_fc/bias$", P("tensor")),
+    (r"mlp/c_proj/kernel$", P("tensor", "fsdp")),
+    (r"mlp/c_proj/bias$", P()),
+    (r"(ln_1|ln_2|ln_f)/(scale|bias)$", P()),
+)
+LEGACY_LLAMA_RULES = (
+    (r"embed_tokens/embedding$", P("tensor", "fsdp")),
+    (r"(q_proj|k_proj|v_proj)/kernel$", P("fsdp", "tensor")),
+    (r"o_proj/kernel$", P("tensor", "fsdp")),
+    (r"(gate_proj|up_proj)/kernel$", P("fsdp", "tensor")),
+    (r"down_proj/kernel$", P("tensor", "fsdp")),
+    (r"lm_head/kernel$", P("fsdp", "tensor")),
+    (r"(input_layernorm|post_attention_layernorm|norm)/scale$", P()),
+)
+LEGACY_MIXTRAL_RULES = (
+    (r"experts/(w1|w3)$", P("expert", "fsdp", "tensor")),
+    (r"experts/w2$", P("expert", "tensor", "fsdp")),
+    (r"block_sparse_moe/gate/kernel$", P(None, None)),
+) + LEGACY_LLAMA_RULES
+
+
+def _family_model(family):
+    if family == "gpt":
+        from avenir_tpu.models.gpt import GPT, GPTConfig
+
+        return nnx.eval_shape(lambda: GPT(
+            GPTConfig(block_size=16, vocab_size=64, n_layer=2, n_head=2,
+                      n_embd=32), rngs=nnx.Rngs(0)))
+    if family == "llama":
+        from avenir_tpu.models.llama import Llama, LlamaConfig
+
+        return nnx.eval_shape(lambda: Llama(
+            LlamaConfig(block_size=16, vocab_size=64, n_layer=2, n_head=2,
+                        n_kv_head=1, n_embd=32, ffn_hidden=64),
+            rngs=nnx.Rngs(0)))
+    from avenir_tpu.models.mixtral import Mixtral, MixtralConfig
+
+    return nnx.eval_shape(lambda: Mixtral(
+        MixtralConfig(block_size=16, vocab_size=64, n_layer=2, n_head=2,
+                      n_kv_head=1, n_embd=32, ffn_hidden=64,
+                      n_experts=4), rngs=nnx.Rngs(0)))
+
+
+_LEGACY = {"gpt": LEGACY_GPT_RULES, "llama": LEGACY_LLAMA_RULES,
+           "mixtral": LEGACY_MIXTRAL_RULES}
+
+
+@pytest.mark.parametrize("family", ["gpt", "llama", "mixtral"])
+def test_unified_rules_match_legacy_specs(family):
+    """bf16 acceptance pin: the ONE unified table resolves every family
+    to specs BIT-EQUAL to the old hand-wired per-family tables."""
+    model = _family_model(family)
+    paths = [p for p, _ in nnx.state(model, nnx.Param).flat_state()]
+    new = match_partition_rules(rules_for_model(family), paths)
+    old = match_partition_rules(_LEGACY[family], paths)
+    assert new == old
+    assert set(new) == set(paths)
+
+
+@pytest.mark.parametrize("family", ["gpt", "llama", "mixtral"])
+def test_precision_round_trip_every_param(family):
+    """Every param path resolves a PrecisionPolicy through the SAME
+    table walk: matmul kernels (incl. the tied/untied heads and the
+    stacked experts) are int8-eligible with delayed scaling; norms,
+    biases, the position table and the MoE router gate never are."""
+    model = _family_model(family)
+    flat = nnx.state(model, nnx.Param).flat_state()
+    paths = [p for p, _ in flat]
+    shapes = {p: tuple(v.get_value().shape) for p, v in flat}
+    pols = match_precision_rules(rules_for_model(family), paths, shapes)
+    assert set(pols) == set(paths)
+    for p in paths:
+        s = "/".join(str(seg) for seg in p)
+        pol = pols[p]
+        if any(k in s for k in ("ln_", "layernorm", "/norm/", "bias",
+                                "wpe", "gate/kernel")) or s.endswith(
+                                    "norm/scale"):
+            assert not pol.quantize, s
+        elif s.endswith(("kernel", "w1", "w2", "w3", "embedding")) \
+                and len(shapes[p]) >= 2 and "wpe" not in s:
+            assert pol.quantize, s
+            assert pol.scaling == "delayed", s
+
+
+def test_rule_ordering_wins():
+    """First matching row decides — for BOTH halves of the policy."""
+    rules = (
+        (r"special/kernel$", P("tensor"), NO_QUANT),
+        (r"kernel$", P("fsdp"), QUANT),
+    )
+    specs = match_partition_rules(rules, ["a/special/kernel", "b/kernel"])
+    assert tuple(specs["a/special/kernel"]) == ("tensor",)
+    assert tuple(specs["b/kernel"]) == ("fsdp",)
+    pols = match_precision_rules(rules, ["a/special/kernel", "b/kernel"])
+    assert not pols["a/special/kernel"].quantize
+    assert pols["b/kernel"].quantize
+
+
+def test_precision_scalar_skip_and_fail_loud():
+    """A 1-d param coerces to NO_QUANT even when its row says QUANT
+    (no contraction axis to carry a per-channel scale); an unmatched
+    path fails loud like the partition half."""
+    rules = ((r"kernel$", P("fsdp"), QUANT),)
+    pols = match_precision_rules(rules, ["a/kernel", "b/kernel"],
+                                 {"a/kernel": (8, 8), "b/kernel": (8,)})
+    assert pols["a/kernel"].quantize and not pols["b/kernel"].quantize
+    with pytest.raises(ValueError, match="no precision rule"):
+        match_precision_rules(rules, ["mystery/scale"])
+    with pytest.raises(ValueError, match="no precision rule"):
+        precision_for("gpt", "mystery/thing")
+
+
+def test_precision_for_call_site_keys():
+    """The canonical call-site keys the models use must resolve, with
+    the policies the docstring promises."""
+    for fam, key in [("gpt", "attn/c_attn/kernel"),
+                     ("gpt", "mlp/c_proj/kernel"),
+                     ("gpt", "wte/embedding"),
+                     ("llama", "q_proj/kernel"),
+                     ("llama", "lm_head/kernel"),
+                     ("mixtral", "experts/w1"),
+                     ("mixtral", "experts/w2")]:
+        assert precision_for(fam, key).quantize, (fam, key)
+    assert not precision_for("mixtral",
+                             "block_sparse_moe/gate/kernel").quantize
+    assert not precision_for("gpt", "wpe/embedding").quantize
+    assert isinstance(precision_for("gpt", "wte/embedding"),
+                      PrecisionPolicy)
+
+
+def test_legacy_two_tuple_rules_still_accepted():
+    """match_partition_rules consumes (regex, spec) pairs (external
+    callers, these fixtures); their precision resolves to NO_QUANT."""
+    rules = ((r"kernel$", P("fsdp")),)
+    assert tuple(match_partition_rules(rules, ["x/kernel"])["x/kernel"]) \
+        == ("fsdp",)
+    assert not match_precision_rules(rules, ["x/kernel"])["x/kernel"].quantize
 
 
 def test_sanitize_drops_nondivisible_axes():

@@ -1,0 +1,427 @@
+"""int8 quantized-training tests (ISSUE 15 tentpole): op-level bounds,
+STE gradient sanity, the loss-trajectory parity acceptance (int8 tracks
+bf16 over 128 steps on the tiny-GPT config, CPU blocked-oracle path),
+bit-identical step purity (the kill-resume contract under the knob),
+no-retrace ledger pins, and pallas int8-stripe vs blocked fake-quant
+oracle parity."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from avenir_tpu.ops.quant import (
+    Q_MAX,
+    SCALE_FLOOR,
+    audit_quantization,
+    dequantize,
+    fake_quant,
+    int8_matmul,
+    matmul_bits,
+    quantize_channelwise,
+    quantize_tensorwise,
+    resolve_compute_dtype,
+)
+
+# ---------------------------------------------------------------------------
+# op level
+# ---------------------------------------------------------------------------
+
+
+def test_roundtrip_error_bounded_by_half_scale(rng):
+    x = jnp.asarray(rng.normal(size=(16, 64)).astype(np.float32))
+    q, s = quantize_channelwise(x, -1)
+    back = dequantize(q, s, -1)
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    assert (err <= np.asarray(s)[:, None] / 2 + 1e-7).all()
+    # per-channel: each row's max maps to +-127 exactly
+    assert np.abs(np.asarray(q)).max() == 127
+
+
+def test_zero_channel_scale_floor_and_exact_zeros():
+    x = jnp.zeros((4, 8))
+    q, s = quantize_channelwise(x, -1)
+    assert np.allclose(np.asarray(s), SCALE_FLOOR / Q_MAX)
+    assert np.asarray(dequantize(q, s, -1)).sum() == 0.0
+
+
+def test_tensorwise_is_one_scale():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 8)) * 100)
+    q, s = quantize_tensorwise(x)
+    assert np.ndim(np.asarray(s)) == 0
+    assert np.abs(np.asarray(q)).max() == 127
+
+
+def test_int8_matmul_forward_matches_dequantized_reference(rng):
+    x = jnp.asarray(rng.normal(size=(3, 5, 16)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+    y = int8_matmul(x, w)
+    qx, sx = quantize_channelwise(x, -1)
+    qw, sw = quantize_channelwise(w, 0)
+    ref = dequantize(qx, sx, -1) @ dequantize(qw, sw, 0)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # and the quantized grid is CLOSE to the dense product (absmax bound)
+    dense = np.asarray(x) @ np.asarray(w)
+    assert np.abs(np.asarray(y) - dense).max() < 0.15 * np.abs(dense).max()
+
+
+def test_int8_matmul_oi_layout_matches_io_on_transpose(rng):
+    x = jnp.asarray(rng.normal(size=(4, 16)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(int8_matmul(x, w, w_layout="io")),
+        np.asarray(int8_matmul(x, w.T, w_layout="oi")),
+        rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("scaling", ["delayed", "dynamic"])
+def test_int8_matmul_ste_gradients_track_dense(rng, scaling):
+    """STE backward: grads of the quantized matmul must be close to the
+    dense matmul's grads (the quantization error is bounded, and round
+    is identity-through). Both backward calibration modes."""
+    x = jnp.asarray(rng.normal(size=(8, 16)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+
+    def f(fn):
+        return jax.grad(lambda a, b: jnp.sum(jnp.sin(fn(a, b))),
+                        argnums=(0, 1))(x, w)
+
+    gx_q, gw_q = f(lambda a, b: int8_matmul(a, b, scaling=scaling))
+    gx_d, gw_d = f(lambda a, b: a @ b)
+    for gq, gd in ((gx_q, gx_d), (gw_q, gw_d)):
+        gq, gd = np.asarray(gq), np.asarray(gd)
+        denom = np.abs(gd).max() + 1e-9
+        assert np.abs(gq - gd).max() / denom < 0.1, (
+            np.abs(gq - gd).max() / denom)
+
+
+def test_int8_matmul_vmaps_like_the_expert_stack(rng):
+    """The Mixtral experts path: vmap over the stacked E axis of both
+    operands, forward AND grad (custom_vjp batching)."""
+    x = jnp.asarray(rng.normal(size=(4, 6, 16)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(4, 16, 8)).astype(np.float32))
+    mm = jax.vmap(lambda a, b: int8_matmul(a, b))
+    y = mm(x, w)
+    for e in range(4):
+        np.testing.assert_allclose(
+            np.asarray(y[e]), np.asarray(int8_matmul(x[e], w[e])),
+            rtol=1e-6, atol=1e-6)
+    g = jax.grad(lambda a, b: jnp.sum(mm(a, b)), argnums=1)(x, w)
+    assert np.isfinite(np.asarray(g)).all() and np.abs(np.asarray(g)).sum() > 0
+
+
+def test_fake_quant_is_ste_and_lands_on_grid(rng):
+    w = jnp.asarray(rng.normal(size=(8, 16)).astype(np.float32))
+    wq = fake_quant(w, 1)
+    q, s = quantize_channelwise(w, 1)
+    np.testing.assert_allclose(np.asarray(wq),
+                               np.asarray(dequantize(q, s, 1)), rtol=1e-6)
+    g = jax.grad(lambda a: jnp.sum(fake_quant(a, 1) * 3.0))(w)
+    np.testing.assert_allclose(np.asarray(g), 3.0)  # identity-through
+
+
+def test_resolvers_and_bits():
+    assert resolve_compute_dtype("int8") == "int8"
+    assert resolve_compute_dtype("bfloat16") == "bf16"
+    assert matmul_bits("int8") == 8
+    assert matmul_bits("bfloat16") == 16
+    assert matmul_bits("float32") == 32
+
+
+def test_audit_counts_floor_channels_and_bumps_counter():
+    from avenir_tpu.obs.metrics import get_registry, reset_registry
+
+    reset_registry()
+    arrs = [("a/kernel", np.random.default_rng(0).normal(size=(4, 8))),
+            ("b/kernel", np.zeros((3, 8))),  # 3 dead channels (last-axis
+                                             # reduce -> per-row scales)
+            ("c/scale", np.zeros((8,)))]     # vector: structurally skipped
+    out = audit_quantization(arrs)
+    assert out == {"a/kernel": 0, "b/kernel": 3}
+    assert get_registry().counter("quant_scale_clip").total == 3
+    reset_registry()
+
+
+# ---------------------------------------------------------------------------
+# the trajectory-parity acceptance (tiny-GPT, 128 steps, blocked oracle)
+# ---------------------------------------------------------------------------
+
+# THE documented tolerance budget (docs/PERFORMANCE.md "Past the bf16
+# plateau"): per-channel absmax int8 perturbs each matmul by ~0.4% of
+# its dynamic range; over 128 optimizer steps of the tiny-GPT config the
+# measured trajectory gap stays ~3e-3 peak / ~2e-4 final (both orders of
+# magnitude inside the band). The band is deliberately loose enough to
+# survive XLA re-lowerings and tight enough that a broken STE (gradient
+# mis-scaled by even 10%) blows through it within 20 steps.
+PARITY_MAX_ABS = 0.05
+PARITY_FINAL_ABS = 0.02
+PARITY_STEPS = 128
+
+
+def _parity_data(steps, B=2, T=16, vocab=64, seed=0):
+    """Learnable synthetic stream (noisy periodic tokens): loss must FALL
+    well below ln(vocab) so the parity claim covers a moving trajectory,
+    not a flat one."""
+    rng = np.random.default_rng(seed)
+    base = np.arange(steps * B * (T + 1)) % 7
+    toks = (base * 9 + rng.integers(0, 2, base.shape)) % vocab
+    toks = toks.reshape(steps, 1, B, T + 1)
+    return toks[..., :-1].astype(np.int32), toks[..., 1:].astype(np.int32)
+
+
+def _train_tiny_gpt(compute_dtype, steps=PARITY_STEPS):
+    """One jitted multi-step dispatch of the tiny-GPT config over the
+    blocked CE tail — the CPU oracle path the acceptance names."""
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+    from avenir_tpu.train.optimizer import make_optimizer
+    from avenir_tpu.train.step import jit_multi_train_step, make_step_fns
+
+    cfg = GPTConfig(block_size=16, vocab_size=64, n_layer=1, n_head=2,
+                    n_embd=32, dropout=0.0, bias=True,
+                    compute_dtype=compute_dtype, attn_impl="xla",
+                    loss_impl="blocked")
+    m = GPT(cfg, rngs=nnx.Rngs(0))
+    graphdef, params = nnx.split(m, nnx.Param)
+    tx, _ = make_optimizer(params, learning_rate=3e-3, weight_decay=0.1,
+                           beta1=0.9, beta2=0.95, grad_clip=1.0,
+                           warmup_iters=10, lr_decay_iters=200,
+                           min_lr=3e-4)
+    opt = jax.jit(tx.init)(params)
+    step_fn, _ = make_step_fns(graphdef, dropout=0.0)
+    step = jit_multi_train_step(step_fn, tx)
+    xs, ys = _parity_data(steps)
+    p, o, mtr = step(params, opt, jax.random.key(0), jnp.asarray(xs),
+                     jnp.asarray(ys))
+    return np.asarray(mtr["loss"]), jax.tree.map(np.asarray, p)
+
+
+@pytest.fixture(scope="module")
+def parity_runs():
+    """Both 128-step trajectories, built once for the module (the PR 10
+    warmed-fixture idiom: the two ~3s compiles charge setup, and every
+    assertion below reads the same runs)."""
+    lb, pb = _train_tiny_gpt("bfloat16")
+    li, pi = _train_tiny_gpt("int8")
+    return {"bf16": (lb, pb), "int8": (li, pi)}
+
+
+def test_int8_loss_trajectory_tracks_bf16(parity_runs):
+    """THE acceptance pin: int8 training tracks the bf16 loss curve
+    within the documented tolerance band over >=128 steps, and both
+    curves actually LEARN (final loss far below the ln(64) start)."""
+    lb, _ = parity_runs["bf16"]
+    li, _ = parity_runs["int8"]
+    assert len(lb) == PARITY_STEPS
+    d = np.abs(lb - li)
+    assert d.max() <= PARITY_MAX_ABS, (d.max(), d.argmax())
+    assert d[-1] <= PARITY_FINAL_ABS, d[-1]
+    assert lb[-1] < 1.2 and li[-1] < 1.2, (lb[-1], li[-1])
+    assert lb[0] > 3.5  # started near ln(64): the curve moved
+
+
+@pytest.fixture(scope="module")
+def resume_win():
+    """Warmed int8 windowed-step closure + state for the resume pin
+    (compile charges setup — the PR 10 warmed-fixture idiom)."""
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+    from avenir_tpu.train.optimizer import make_optimizer
+    from avenir_tpu.train.step import jit_windowed_train_step, make_step_fns
+
+    cfg = GPTConfig(block_size=8, vocab_size=32, n_layer=1, n_head=2,
+                    n_embd=16, dropout=0.0, bias=True,
+                    compute_dtype="int8", attn_impl="xla",
+                    loss_impl="blocked")
+    m = GPT(cfg, rngs=nnx.Rngs(0))
+    graphdef, params0 = nnx.split(m, nnx.Param)
+    tx, _ = make_optimizer(params0, learning_rate=3e-3, weight_decay=0.1,
+                           beta1=0.9, beta2=0.95, grad_clip=1.0,
+                           warmup_iters=2, lr_decay_iters=20, min_lr=3e-4)
+    opt0 = jax.jit(tx.init)(params0)
+    step_fn, _ = make_step_fns(graphdef, dropout=0.0)
+    win = jit_windowed_train_step(step_fn, tx)
+    xs, ys = _parity_data(8, T=8, vocab=32, seed=3)
+    # warm the ONE window-length compile (state not donated from these
+    # throwaway copies' originals: fresh trees below)
+    _ = win(jax.tree.map(jnp.array, params0),
+            jax.tree.map(jnp.array, opt0), jax.random.key(7), 0,
+            jnp.asarray(xs[:4]), jnp.asarray(ys[:4]))
+    return dict(win=win, params0=jax.tree.map(np.asarray, params0),
+                opt0=jax.tree.map(np.asarray, opt0), xs=xs, ys=ys)
+
+
+def test_int8_step_is_pure_and_resume_bit_identical(resume_win):
+    """The BENCH_chaos contract under the knob: the int8 step is a pure
+    function of (params, opt, rng, batch) — running two 4-step windows
+    with a host round-trip of the state between them (the resume shape)
+    reproduces the uninterrupted pair BIT-identically."""
+    win, xs, ys = resume_win["win"], resume_win["xs"], resume_win["ys"]
+    params0, opt0 = resume_win["params0"], resume_win["opt0"]
+    key = jax.random.key(7)
+
+    def host(t):  # the resume round-trip: device -> numpy -> device
+        return jax.tree.map(lambda a: jnp.asarray(np.asarray(a)), t)
+
+    def run(round_trip):
+        """Two 4-step windows; `round_trip` bounces the state through
+        host numpy between them (the restore shape). One compiled
+        window length either way — windowed==single equivalence is
+        already pinned generically by test_train_tpu."""
+        p, o = host(params0), host(opt0)
+        p, o, m1 = win(p, o, key, 0, jnp.asarray(xs[:4]),
+                       jnp.asarray(ys[:4]))
+        if round_trip:
+            p, o = host(p), host(o)  # "kill" + restore
+        p, o, m2 = win(p, o, key, 4, jnp.asarray(xs[4:]),
+                       jnp.asarray(ys[4:]))
+        losses = np.concatenate([np.asarray(m1["loss"]),
+                                 np.asarray(m2["loss"])])
+        return losses, jax.tree.map(np.asarray, p)
+
+    la, pa = run(round_trip=False)
+    lb, pb = run(round_trip=True)
+    np.testing.assert_array_equal(la, lb)
+    for a, b in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_no_retrace_and_bf16_never_touches_the_quant_ledger(parity_runs):
+    """No-retrace pins over the quant trace ledger: (1) a second
+    identical int8 dispatch adds ZERO traces (steady state never
+    retraces); (2) a bf16 model adds zero quant traces; (3) flipping the
+    knob to int8 adds exactly one compile's worth of traces — the trace
+    delta of the flip is the new jit, nothing else."""
+    from avenir_tpu.ops import quant
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+
+    def logits_fn(compute_dtype):
+        cfg = GPTConfig(block_size=16, vocab_size=64, n_layer=1, n_head=2,
+                        n_embd=32, dropout=0.0, bias=True,
+                        compute_dtype=compute_dtype, attn_impl="xla")
+        m = GPT(cfg, rngs=nnx.Rngs(0))
+        gd, p = nnx.split(m, nnx.Param)
+        f = jax.jit(lambda pp, x: nnx.merge(gd, pp)(x)[0])
+        x = jnp.zeros((2, 16), jnp.int32)
+        return f, p, x
+
+    f16, p16, x = logits_fn("bfloat16")
+    before = quant.trace_count()
+    f16(p16, x)
+    assert quant.trace_count() == before, "bf16 path touched the ledger"
+
+    f8, p8, _ = logits_fn("int8")
+    f8(p8, x)
+    first_compile = quant.trace_count() - before
+    assert first_compile > 0
+    f8(p8, x)  # steady state: same shapes, no retrace
+    assert quant.trace_count() == before + first_compile
+    # flipping the knob again (a second int8 jit of the same shape)
+    # adds exactly the one compile's traces — no hidden extras
+    f8b, p8b, _ = logits_fn("int8")
+    f8b(p8b, x)
+    assert quant.trace_count() == before + 2 * first_compile
+
+
+# ---------------------------------------------------------------------------
+# fused CE: blocked fake-quant oracle vs pallas int8 stripes
+# ---------------------------------------------------------------------------
+
+
+def _ce_case(rng, B=2, T=12, C=16, V=40):
+    x = jnp.asarray(rng.normal(size=(B, T, C)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, V, (B, T)).astype(np.int32))
+    y = y.at[0, 0].set(-1)  # an ignore_index row rides along
+    return x, y
+
+
+@pytest.mark.parametrize("w_layout", ["cv", "vc"])
+def test_pallas_int8_stripes_match_blocked_fake_quant_oracle(rng, w_layout):
+    """The kernels consuming int8 weight stripes (fused dequant) must
+    reproduce the blocked STE fake-quant oracle: same grid, same loss,
+    same dx/dw — tight tolerance, both weight layouts."""
+    from avenir_tpu.ops.fused_ce import _blocked_ce
+    from avenir_tpu.ops.pallas.fused_ce import fused_ce_pallas
+
+    x, y = _ce_case(rng)
+    V, C = 40, 16
+    w = jnp.asarray(rng.normal(
+        size=(C, V) if w_layout == "cv" else (V, C)).astype(np.float32))
+
+    def blocked(xx, ww):
+        return _blocked_ce(xx, ww, y, ignore_index=-1, w_layout=w_layout,
+                           t_chunk=4, w_dtype="int8")
+
+    def pallas(xx, ww):
+        return fused_ce_pallas(xx, ww, y, ignore_index=-1,
+                               w_layout=w_layout, interpret=True,
+                               w_dtype="int8")
+
+    lb, (gxb, gwb) = jax.value_and_grad(blocked, argnums=(0, 1))(x, w)
+    lp, (gxp, gwp) = jax.value_and_grad(pallas, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(float(lb), float(lp), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(gxb), np.asarray(gxp),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(gwb), np.asarray(gwp),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_int8_ce_close_to_dense_and_reference_tail_matches_blocked(rng):
+    """Weight-only int8 CE stays close to the dense CE (error budget),
+    and the models' reference fake-quant tail IS the blocked tail's
+    numerics (same grid → near-exact agreement)."""
+    from avenir_tpu.models.common import cross_entropy_loss
+    from avenir_tpu.ops.fused_ce import _blocked_ce
+
+    x, y = _ce_case(rng)
+    w = jnp.asarray(rng.normal(size=(40, 16)).astype(np.float32))  # vc
+    dense = cross_entropy_loss(jnp.einsum("btc,vc->btv", x, w), y,
+                               ignore_index=-1)
+    blocked_q = _blocked_ce(x, w, y, ignore_index=-1, w_layout="vc",
+                            t_chunk=4, w_dtype="int8")
+    ref_q = cross_entropy_loss(
+        jnp.einsum("btc,vc->btv", x, fake_quant(w, 1)), y, ignore_index=-1)
+    assert abs(float(dense) - float(blocked_q)) < 0.05
+    np.testing.assert_allclose(float(ref_q), float(blocked_q),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def family_losses():
+    """loss + grad-finiteness per (family, compute_dtype) — four small
+    jit(value_and_grad) compiles, charged to setup once."""
+    from avenir_tpu.models.llama import Llama, LlamaConfig
+    from avenir_tpu.models.mixtral import Mixtral, MixtralConfig
+
+    rng = np.random.default_rng(0)
+    out = {}
+    for family, cls, ccls, kw in (
+            ("llama", Llama, LlamaConfig, {}),
+            ("mixtral", Mixtral, MixtralConfig, dict(n_experts=4))):
+        for cd in ("bfloat16", "int8"):
+            cfg = ccls(block_size=8, vocab_size=32, n_layer=1, n_head=2,
+                       n_kv_head=1, n_embd=16, ffn_hidden=32,
+                       compute_dtype=cd, attn_impl="xla", **kw)
+            m = cls(cfg, rngs=nnx.Rngs(0))
+            gd, p = nnx.split(m, nnx.Param)
+            x = jnp.asarray(rng.integers(0, 32, (2, 8)).astype(np.int32))
+            loss, g = jax.jit(jax.value_and_grad(
+                lambda pp: nnx.merge(gd, pp)(x, x)[1]))(p)
+            out[(family, cd)] = (
+                float(loss),
+                all(np.isfinite(np.asarray(l)).all()
+                    for l in jax.tree.leaves(g)))
+    return out
+
+
+@pytest.mark.parametrize("family", ["llama", "mixtral"])
+def test_llama_and_mixtral_int8_close_to_bf16(family_losses, family):
+    """One forward+grad per family under the knob: loss within the op
+    error budget of the bf16 run, grads finite — the family wiring pin
+    (the GPT trajectory test above carries the deep coverage)."""
+    l_bf, ok_bf = family_losses[(family, "bfloat16")]
+    l_i8, ok_i8 = family_losses[(family, "int8")]
+    assert ok_bf and ok_i8
+    assert abs(l_i8 - l_bf) < 0.06, (family, l_bf, l_i8)

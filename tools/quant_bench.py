@@ -1,0 +1,208 @@
+"""bf16 vs int8 quantized-training sweep (ISSUE 15 satellite).
+
+One JSON artifact (committed as BENCH_r06.json) with a cell per
+compute_dtype: ms/step, tokens/s/chip, peak HBM, and — the correctness
+half — the loss@N trajectory parity between the cells from IDENTICAL
+init and data. The parity gate is the acceptance: int8 must track the
+bf16 curve within the documented tolerance band (docs/PERFORMANCE.md
+"Past the bf16 plateau"; the same budget tests/test_quant.py pins in
+tier-1), and both curves must actually learn.
+
+Platform honesty (the BENCH_spec_decode caveat pattern): on this CPU
+container the int8 cell measures the CORRECTNESS path — XLA:CPU
+emulates the int8 dot, so ms/step is not the story and `ok` checks
+parity, not speed. On a v5e the same tool measures the real step-time
+win (int8 MXU peak ~2x bf16; run with --steps=40 on the bench chip and
+refresh the ledger with tools/perf_gate.py --update).
+
+Usage:
+  python tools/quant_bench.py [--steps=128] [--seeds=1] [--out=FILE]
+                              [--batch=N] [--block=N]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from avenir_tpu.platform import honor_jax_platforms_env  # noqa: E402
+
+# the documented parity tolerance budget (docs/PERFORMANCE.md; mirrored
+# by tests/test_quant.py PARITY_MAX_ABS / PARITY_FINAL_ABS)
+PARITY_MAX_ABS = 0.05
+PARITY_FINAL_ABS = 0.02
+
+
+def _learnable_tokens(steps, B, T, vocab, seed):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    base = np.arange(steps * B * (T + 1)) % 7
+    toks = (base * 9 + rng.integers(0, 2, base.shape)) % vocab
+    toks = toks.reshape(steps, 1, B, T + 1)
+    return toks[..., :-1].astype(np.int32), toks[..., 1:].astype(np.int32)
+
+
+def run_cell(compute_dtype, *, dims, steps, seed, rounds=3):
+    """One compute_dtype cell: trajectory (first dispatch, fixed data)
+    plus median ms/step over `rounds` timed re-dispatches."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from flax import nnx
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+    from avenir_tpu.obs.series import percentile
+    from avenir_tpu.ops.quant import audit_quantization
+    from avenir_tpu.train.optimizer import make_optimizer
+    from avenir_tpu.train.step import jit_multi_train_step, make_step_fns
+    from avenir_tpu.utils.benching import peak_hbm_bytes
+
+    cfg = GPTConfig(dropout=0.0, bias=True, compute_dtype=compute_dtype,
+                    attn_impl=dims["attn_impl"], loss_impl="blocked",
+                    block_size=dims["block"], vocab_size=dims["vocab"],
+                    n_layer=dims["n_layer"], n_head=dims["n_head"],
+                    n_embd=dims["n_embd"])
+    m = GPT(cfg, rngs=nnx.Rngs(seed))
+    graphdef, params = nnx.split(m, nnx.Param)
+    # audit only the tensors the rules table quantizes (the counter's
+    # documented meaning: dead channels WASTING int8 range)
+    from avenir_tpu.parallel.partition import (
+        match_precision_rules,
+        rules_for_model,
+    )
+
+    flat = params.flat_state()
+    pols = match_precision_rules(
+        rules_for_model("gpt"), [p for p, _ in flat],
+        {p: tuple(v.get_value().shape) for p, v in flat})
+    clip = sum(audit_quantization(
+        (("/".join(str(s) for s in p), np.asarray(v.get_value()))
+         for p, v in flat if pols[p].quantize)).values())
+    tx, _ = make_optimizer(params, learning_rate=3e-3, weight_decay=0.1,
+                           beta1=0.9, beta2=0.95, grad_clip=1.0,
+                           warmup_iters=10, lr_decay_iters=2 * steps,
+                           min_lr=3e-4)
+    opt = jax.jit(tx.init)(params)
+    step_fn, _ = make_step_fns(graphdef, dropout=0.0)
+    step = jit_multi_train_step(step_fn, tx)
+    xs, ys = _learnable_tokens(steps, dims["batch"], dims["block"],
+                               dims["vocab"], seed)
+    xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+
+    def host(t):
+        return jax.tree.map(lambda a: jnp.asarray(np.asarray(a)), t)
+
+    # trajectory dispatch (includes compile; not timed)
+    p, o, mtr = step(host(params), host(opt), jax.random.key(seed), xs, ys)
+    losses = np.asarray(mtr["loss"]).astype(float)
+    # timed rounds: fresh state copies per round (donated buffers)
+    walls = []
+    for _ in range(rounds):
+        pr, orr = host(params), host(opt)
+        t0 = time.perf_counter()
+        pr, orr, mr = step(pr, orr, jax.random.key(seed), xs, ys)
+        float(mr["loss"][-1])  # D2H fence
+        walls.append(time.perf_counter() - t0)
+    n_chips = jax.device_count()
+    ms_step = percentile(walls, 0.5) / steps * 1e3
+    tok_per_iter = dims["batch"] * dims["block"]
+    return {
+        "compute_dtype": compute_dtype,
+        "ms_per_step": round(ms_step, 3),
+        "tok_per_sec_per_chip": round(tok_per_iter / (ms_step / 1e3)
+                                      / n_chips, 1),
+        "peak_hbm_bytes": peak_hbm_bytes(),
+        "loss_first": round(float(losses[0]), 6),
+        "loss_last": round(float(losses[-1]), 6),
+        "quant_scale_clip": clip,
+        "losses": [round(float(v), 6) for v in losses],
+    }
+
+
+def main(argv):
+    honor_jax_platforms_env()
+    import numpy as np
+
+    import jax
+
+    args = {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
+            for a in argv}
+    on_tpu = jax.default_backend() == "tpu"
+    steps = int(args.get("steps", 128))
+    seeds = int(args.get("seeds", 1))
+    if on_tpu:
+        dims = dict(n_layer=12, n_head=12, n_embd=768, vocab=50304,
+                    block=int(args.get("block", 1024)),
+                    batch=int(args.get("batch", 16)), attn_impl="pallas")
+    else:
+        dims = dict(n_layer=2, n_head=2, n_embd=32, vocab=64,
+                    block=int(args.get("block", 16)),
+                    batch=int(args.get("batch", 2)), attn_impl="xla")
+
+    per_seed = []
+    for s in range(seeds):
+        cells = {cd: run_cell(cd, dims=dims, steps=steps, seed=s)
+                 for cd in ("bfloat16", "int8")}
+        lb = np.array(cells["bfloat16"].pop("losses"))
+        li = np.array(cells["int8"].pop("losses"))
+        d = np.abs(lb - li)
+        per_seed.append({
+            "seed": s, "cells": cells,
+            "parity": {
+                "max_abs_delta": round(float(d.max()), 6),
+                "final_abs_delta": round(float(d[-1]), 6),
+                "mean_abs_delta": round(float(d.mean()), 6),
+            },
+        })
+
+    head = per_seed[0]
+    parity = head["parity"]
+    learned = all(
+        r["cells"][cd]["loss_last"] < r["cells"][cd]["loss_first"] - 1.0
+        for r in per_seed for cd in ("bfloat16", "int8"))
+    ok = (learned
+          and all(r["parity"]["max_abs_delta"] <= PARITY_MAX_ABS
+                  and r["parity"]["final_abs_delta"] <= PARITY_FINAL_ABS
+                  for r in per_seed))
+    speed_ratio = (head["cells"]["bfloat16"]["ms_per_step"]
+                   / head["cells"]["int8"]["ms_per_step"])
+    out = {
+        "kind": "quant_bench",
+        "metric": "int8_vs_bf16_training",
+        "cells": head["cells"],
+        "parity": parity,
+        "parity_budget": {"max_abs": PARITY_MAX_ABS,
+                          "final_abs": PARITY_FINAL_ABS},
+        "int8_step_speedup": round(speed_ratio, 4),
+        "seeds": per_seed if seeds > 1 else None,
+        "ok": bool(ok),
+        "run_meta": {
+            "device": str(jax.devices()[0].device_kind),
+            "n_chips": jax.device_count(),
+            "steps": steps, "dims": dims, "loss_impl": "blocked",
+            "note": (
+                "TPU cell: int8 MXU path, speedup is the headline"
+                if on_tpu else
+                "CPU container: the int8 cell exercises the blocked "
+                "oracle numerics (XLA:CPU emulates the int8 dot, so "
+                "ms/step is not the win here — parity is the gated "
+                "claim; the ~2x step-time headline is the v5e int8-peak "
+                "claim, docs/PERFORMANCE.md)"),
+        },
+    }
+    js = json.dumps(out, indent=1)
+    if "out" in args:
+        with open(args["out"], "w") as f:
+            f.write(js + "\n")
+        print(f"wrote {args['out']} ok={ok}")
+    else:
+        print(js)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

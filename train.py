@@ -78,6 +78,12 @@ min_lr = 6e-5
 backend = "cuda"  # 'cuda' (torch ref incl. CPU) | 'tpu' (jax)
 device = "cuda"  # torch device string for the cuda backend; 'cpu' works
 dtype = "bfloat16"  # 'float32' | 'bfloat16' | 'float16'
+# tpu backend: '' (follow dtype) | 'int8' — quantized hot matmuls (QKV/O,
+# MLP/SwiGLU/experts, lm-head+CE) over a bf16 base with per-channel absmax
+# scales and delayed backward scaling (avenir_tpu/ops/quant.py); which
+# tensors participate is declared per tensor class in the unified
+# partition+precision rules table (avenir_tpu/parallel/partition.py)
+compute_dtype = ""
 compile = True  # torch.compile on the cuda backend; documented no-op on tpu (always jit)
 seed = 1337
 debug_nans = False  # tpu: raise at the first NaN-producing op (jax_debug_nans)
