@@ -335,6 +335,25 @@ METRIC_SCHEMA = {
         "chunked-prefill dispatches by the paged engine (each computes "
         "at most prefill_chunk prompt tokens, so long prompts never "
         "stall a decode tick)"),
+    # -- fleet cache telescope (ISSUE 16): the counterfactual reuse
+    #    audit partitions every dispatched prompt's tokens into exactly
+    #    these three (reused + missed + cold == prompt tokens, per
+    #    dispatch decision; Router(cache_telescope=...) arms it) --
+    "prefix_tokens_reused": (
+        "counter", "tok",
+        "prompt tokens the CHOSEN replica already held as a shared "
+        "prefix chain at dispatch (cache-map content view; may "
+        "overstate the actual attach by up to one page)"),
+    "prefix_tokens_missed": (
+        "counter", "tok",
+        "prompt tokens some OTHER replica held but the chosen one did "
+        "not — the fleet recomputing prefixes it already has; the "
+        "missed-reuse headline an affinity router (PR 17) would "
+        "reclaim"),
+    "prefix_tokens_cold": (
+        "counter", "tok",
+        "prompt tokens no tracked replica held at dispatch — "
+        "genuinely new prefill work no placement could have avoided"),
     # -- disaggregated prefill/decode (ISSUE 13) --
     "kv_pages_exported": (
         "counter", "1",

@@ -178,6 +178,23 @@ def summarize(records, *, skipped_lines=()):
             "spec_proposed": counters.get("spec_proposed", 0.0),
             "spec_accepted": counters.get("spec_accepted", 0.0),
             "kv_dtype_bits": (end.get("gauges") or {}).get("kv_dtype"),
+            # fleet cache telescope (ISSUE 16): the reuse audit's token
+            # partition; est saved ms derives from the run's own
+            # measured per-token prefill cost over the tokens prefill
+            # actually computed (missed + cold)
+            "prefix_tokens_reused": counters.get(
+                "prefix_tokens_reused", 0.0),
+            "prefix_tokens_missed": counters.get(
+                "prefix_tokens_missed", 0.0),
+            "prefix_tokens_cold": counters.get("prefix_tokens_cold", 0.0),
+            "est_prefill_ms_saved": (
+                counters.get("prefix_tokens_missed", 0.0)
+                * counters.get("serve_prefill_ms", 0.0)
+                / (counters.get("prefix_tokens_missed", 0.0)
+                   + counters.get("prefix_tokens_cold", 0.0))
+                if (counters.get("prefix_tokens_missed", 0.0)
+                    + counters.get("prefix_tokens_cold", 0.0)) > 0
+                else 0.0),
         }
     by_detector = {}
     for r in anomalies:
@@ -360,6 +377,19 @@ def format_report(s):
                 bits.append(f"pages free {sv['kv_pages_free']:.0f}")
             if sv.get("prefix_hit_rate") is not None:
                 bits.append(f"prefix hit {sv['prefix_hit_rate']:.0%}")
+            if (sv.get("prefix_tokens_reused") or sv.get(
+                    "prefix_tokens_missed") or sv.get(
+                    "prefix_tokens_cold")):
+                # reuse audit (ISSUE 16): the dispatch token partition
+                # plus the prefill ms a cache-affine placement would
+                # have saved
+                bits.append(
+                    f"reused {sv['prefix_tokens_reused']:.0f}"
+                    f"/missed {sv['prefix_tokens_missed']:.0f}"
+                    f"/cold {sv['prefix_tokens_cold']:.0f} tok")
+                if sv.get("est_prefill_ms_saved"):
+                    bits.append("est saved "
+                                f"{sv['est_prefill_ms_saved']:.1f} ms")
             lines.append("  paging: " + "   ".join(bits))
         if sv.get("spec_proposed"):
             rate = sv["spec_accepted"] / sv["spec_proposed"]

@@ -81,6 +81,12 @@ TRACE_EVENTS = {
                      # that triggered it (burn rate, attainment, queue
                      # wait) — the auditable control-plane trail
                      # (serve/autoscale.py, ISSUE 12)
+    "missed_reuse",  # the reuse auditor found a BETTER placement than
+                     # the dispatch took (ISSUE 16): attrs replica/
+                     # best_replica/reused/missed/cold/est_ms_saved —
+                     # the per-request counterfactual behind the
+                     # prefix_tokens_missed counter (router-emitted,
+                     # only when missed > 0)
     "anomaly",       # one health-engine detector fire (rid=None):
                      # detector/key/value/threshold + robust-statistic
                      # evidence (obs/anomaly.py, ISSUE 14) — also a

@@ -1,0 +1,116 @@
+"""Router-side fleet cache map (ISSUE 16 tentpole, layer 2).
+
+The Router already mirrors every replica's page BUDGET (free pages,
+util, hit rate) off the heartbeat, but stayed blind to what each cache
+CONTAINS — so it cannot know that the prompt it is about to dispatch to
+replica A sits fully prefilled on replica B. This module holds the
+content view: per-replica bounded chain summaries (the allocator's
+`chain_summary()` wire form, shipped as step-reply deltas by process
+workers and read directly from in-process engines), with staleness
+accounting, answering
+
+    match(prompt)       -> {replica_id: deepest shared-chain tokens}
+    best_match(prompt)  -> (replica_id, deepest shared-chain tokens)
+
+Matching is digest-based: a summary node is keyed by the blake2b digest
+of its full root token path (`pages.chain_digest`), so the map compares
+a prompt against a REMOTE replica's cache by digesting the prompt's own
+prefixes — no raw token chains ever cross the wire. Depths are the
+summary's `n_tokens` values (whole registered pages), so a match may
+overstate the attach an actual admission would get by up to one page
+(`plan()` caps `shared_len` at len(prompt)-1 and can extend into a
+partially matching page) — this is TELEMETRY, feeding the counterfactual
+reuse auditor (serve/router.py), never routing; PR 17's affinity router
+is the consumer that must tolerate exactly this approximation.
+
+Staleness: each update stamps the fleet clock; a dead replica's summary
+is dropped by the router's failover path, so a corpse's cache content
+never keeps advertising itself (the `_EngineProxy.clear()` rule).
+"""
+
+import time
+
+from avenir_tpu.serve.pages import chain_digest
+
+
+def merge_chain_delta(state, delta):
+    """Apply one `take_chain_delta()` wire dict to a summary dict —
+    THE merge rule (shared by `_EngineProxy.apply_chain_delta` and the
+    parity tests): apply every delta in order onto {} and you have the
+    direct `chain_summary()`, exactly."""
+    state.update(delta.get("upd") or {})
+    for d in delta.get("gone") or ():
+        state.pop(d, None)
+    return state
+
+
+class FleetCacheMap:
+    """Per-replica chain summaries + staleness, the router's content
+    view of fleet cache state. Pure host dict bookkeeping — update()
+    cost is one dict swap per replica per step, match() cost is one
+    digest per DISTINCT advertised depth <= len(prompt)."""
+
+    def __init__(self, clock=None):
+        self._clock = clock if clock is not None else time.perf_counter
+        self._nodes = {}   # replica_id -> {digest: [n_tok, depth, ref,
+        #                                            hits, last_use]}
+        self._stamp = {}   # replica_id -> fleet-clock update time
+
+    def update(self, replica_id, nodes, now=None):
+        """Replace one replica's summary (inproc replicas hand the
+        direct summary; process replicas hand the delta-merged mirror)."""
+        self._nodes[replica_id] = dict(nodes or {})
+        self._stamp[replica_id] = (self._clock() if now is None
+                                   else float(now))
+
+    def drop(self, replica_id):
+        """Forget a replica (death/retire): a corpse's cache content
+        must not keep winning best_match."""
+        self._nodes.pop(replica_id, None)
+        self._stamp.pop(replica_id, None)
+
+    def replicas(self):
+        return sorted(self._nodes)
+
+    def nodes(self, replica_id):
+        return self._nodes.get(replica_id, {})
+
+    def staleness_s(self, replica_id, now=None):
+        """Seconds since this replica's summary was refreshed (None if
+        unknown) — the consumer's freshness check."""
+        t = self._stamp.get(replica_id)
+        if t is None:
+            return None
+        return (self._clock() if now is None else float(now)) - t
+
+    def match(self, prompt):
+        """{replica_id: deepest matching chain depth in TOKENS} for
+        `prompt` against every tracked summary. Each distinct advertised
+        depth is digested at most once per call."""
+        prompt = [int(t) for t in prompt]
+        dig = {}  # depth -> digest of prompt[:depth], computed lazily
+        out = {}
+        for rid, nodes in self._nodes.items():
+            best = 0
+            for d, node in nodes.items():
+                n = int(node[0])
+                if n <= best or n > len(prompt):
+                    continue
+                got = dig.get(n)
+                if got is None:
+                    got = dig[n] = chain_digest(prompt[:n])
+                if got == d:
+                    best = n
+            out[rid] = best
+        return out
+
+    def best_match(self, prompt):
+        """(replica_id, deepest shared-chain tokens) — the fleet-best
+        placement for `prompt`, or (None, 0) when no tracked replica
+        shares any prefix. Deterministic tie-break on replica id."""
+        m = self.match(prompt)
+        best_rid, best_n = None, 0
+        for rid in sorted(m, key=str):
+            if m[rid] > best_n:
+                best_rid, best_n = rid, m[rid]
+        return best_rid, best_n

@@ -159,7 +159,7 @@ class Engine:
                  prefill_chunk=None, prefix_sharing=True,
                  paged_attn_impl="auto", tracer=None, kv_dtype="bf16",
                  spec_decode="off", spec_k=4, draft_model=None,
-                 role="both", health_series=False):
+                 role="both", health_series=False, chain_topk=0):
         """`kv_impl` (ISSUE 9, the attn_impl/loss_impl pattern):
         'slab' keeps the fixed per-slot KV columns (serve/slots.py);
         'paged' stores KV in a pool of `n_pages` blocks of `page_size`
@@ -219,7 +219,13 @@ class Engine:
         (`take_series_delta()` drains the bucket DELTAS — the wire
         form a process worker ships in its step replies, merged
         parent-side like the counter deltas). Off by default: the
-        disabled path is one `is None` branch per step."""
+        disabled path is one `is None` branch per step.
+
+        `chain_topk` (ISSUE 16): > 0 arms prefix-chain telemetry —
+        `take_chain_delta()` drains the allocator's bounded top-K chain
+        summary as incremental deltas (the ISSUE 14 wire pattern), so
+        the router's FleetCacheMap can see what this engine's cache
+        contains. 0 (the default) ships nothing; paged engines only."""
         # one clock for submit timestamps, TTFT/TPOT, and deadline
         # expiry — injectable so the deadline tests drive time instead
         # of sleeping through it
@@ -288,6 +294,7 @@ class Engine:
         self._pending = []  # rejected-at-submit records, flushed by step()
         self._tick_s = []   # recent decode-tick durations (clock secs)
         self._tr = tracer   # None = tracing off (the near-zero path)
+        self.chain_topk = int(chain_topk)  # 0 = chain telemetry off
         self._hs = None     # None = health series off (ISSUE 14)
         if health_series:
             from avenir_tpu.obs.series import QuantileSketch
@@ -857,6 +864,11 @@ class Engine:
                 "pages_free": a["free"] + a["cached"],
                 "page_util": a["util"],
                 "prefix_hit_rate": self._paged.prefix_hit_rate(),
+                # the rate's WEIGHT (ISSUE 16 satellite): admitted
+                # prompt tokens — the fleet gauge averages per-replica
+                # rates weighted by this, so an idle replica's 0.0
+                # cannot drag the fleet number
+                "prefix_attempts": self._paged.prompt_tokens,
             }
         return s
 
@@ -954,6 +966,25 @@ class Engine:
             return None
         d = self._hs.take_delta()
         return {"step_time_ms": d} if d else None
+
+    def take_chain_delta(self):
+        """Prefix-chain summary delta since the last take (ISSUE 16):
+        {"upd": {digest: node}, "gone": [digest]}, or None when chain
+        telemetry is off (`chain_topk=0`), this engine is not paged, or
+        nothing changed — the step-reply wire form (serve/worker.py
+        ships it, serve/proc.py applies it to the parent-side mirror
+        exactly like counter/sketch deltas)."""
+        if self.chain_topk <= 0 or self._paged is None:
+            return None
+        return self._paged.alloc.take_chain_delta(self.chain_topk)
+
+    def chain_summary(self):
+        """Direct (non-incremental) chain summary — the parity oracle
+        the merged deltas are pinned against, and what an in-process
+        replica reads instead of merging its own heartbeats."""
+        if self.chain_topk <= 0 or self._paged is None:
+            return {}
+        return self._paged.alloc.chain_summary(self.chain_topk)
 
     def _step_slab(self):
         state = self._state

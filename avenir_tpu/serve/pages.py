@@ -52,6 +52,7 @@ oracle is unchanged: per-request bit-parity with one-shot
 """
 
 import dataclasses
+import hashlib
 from bisect import insort
 from collections import OrderedDict
 from typing import NamedTuple, Optional, Tuple
@@ -65,6 +66,19 @@ from avenir_tpu.infer.decode import _attend_cached, bucket_ladder, \
 from avenir_tpu.serve.slots import key_data_width
 
 ROOT = -1  # the prefix chain's root node id (no parent page)
+
+
+def chain_digest(tokens):
+    """Stable 8-byte digest of a token path (root -> chain node), as a
+    hex string. This is the WIRE identity of a chain node (ISSUE 16):
+    two allocators in different processes — or a worker and the router
+    — computing the digest of the same token prefix get the same value,
+    which is what lets the fleet cache map compare cache content across
+    replicas without shipping raw token chains every heartbeat. Python's
+    builtin hash() is salted per process and cannot serve here."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(b"".join(int(t).to_bytes(4, "little") for t in tokens))
+    return h.hexdigest()
 
 
 # ---------------------------------------------------------------------------
@@ -140,6 +154,20 @@ class PageAllocator:
         self.pages_exported = 0    # bumped by the engine's export path
         self.pages_imported = 0
         self.imported_cow_copies = 0
+        # `imported_live` maintained incrementally on ref transitions
+        # (ISSUE 16 satellite) — stats() rides every heartbeat, and a
+        # scan of `_imported` per beat scaled with transfer volume;
+        # audit() asserts counter == scan
+        self._imported_live = 0
+        # chain telemetry (ISSUE 16 tentpole): per-node hotness for the
+        # bounded top-K summary — hits = admissions that attached this
+        # node, last_use = the monotone admit tick of the latest (a
+        # COUNTER, not a clock: summaries must be deterministic and
+        # cross-process comparable)
+        self._meta = {}           # page -> [hits, last_use_tick]
+        self._tick = 0
+        self._chains_dirty = True  # True: a take_chain_delta is due
+        self._last_summary = {}    # digest -> node, as of the last take
 
     # -- capacity --
 
@@ -164,8 +192,7 @@ class PageAllocator:
             # activity landed on chains another allocator computed
             "pages_exported": self.pages_exported,
             "pages_imported": self.pages_imported,
-            "imported_live": sum(1 for p in self._imported
-                                 if self._ref.get(p, 0) > 0),
+            "imported_live": self._imported_live,
             "imported_cow_copies": self.imported_cow_copies,
         }
 
@@ -237,9 +264,14 @@ class PageAllocator:
             return None
         self._reserved[rid] = plan.new_pages
         table = []
+        self._tick += 1
         for page in plan.shared_pages:
             self._incref(page)
             table.append(PageRef(page, owned=False))
+            m = self._meta.get(page)
+            if m is not None:   # hotness: one hit per attaching admit
+                m[0] += 1
+                m[1] = self._tick
         if plan.partial is not None:
             self._incref(plan.partial)
             table.append(PageRef(plan.partial, owned=False))
@@ -363,6 +395,8 @@ class PageAllocator:
             self._evictable[page] = None   # cached: ref 0, registered
             self._imported.add(page)
             self.pages_imported += 1
+            self._meta[page] = [0, self._tick]
+            self._chains_dirty = True
             out.append((page, True))
             parent = page
         return out
@@ -402,6 +436,65 @@ class PageAllocator:
         self._node[entry.page] = (parent, tokens)
         kids[tokens] = entry.page
         self._chain[rid] = entry.page
+        self._meta[entry.page] = [0, self._tick]
+        self._chains_dirty = True
+
+    # -- chain telemetry (ISSUE 16 tentpole) --
+
+    def _path_tokens(self, page):
+        """The full token path ROOT -> `page` (a registered node)."""
+        parts = []
+        cur = page
+        while cur != ROOT:
+            parent, toks = self._node[cur]
+            parts.append(toks)
+            cur = parent
+        out = []
+        for toks in reversed(parts):
+            out.extend(toks)
+        return out
+
+    def chain_summary(self, top_k=32):
+        """Bounded summary of the registered prefix chains: the top-K
+        nodes by (hits, recency), each keyed by the `chain_digest` of
+        its full root path and valued `[n_tokens, depth_pages, ref,
+        hits, last_use_tick]`. The cap bounds the heartbeat wire form:
+        at most K entries of a 16-hex-char digest plus five small ints
+        (~60 bytes/node JSON-ish, so K=32 is ~2 KB worst case)."""
+        top_k = int(top_k)
+        if top_k <= 0 or not self._node:
+            return {}
+        pages = sorted(
+            self._node,
+            key=lambda p: (self._meta[p][0], self._meta[p][1], p),
+            reverse=True)[:top_k]
+        out = {}
+        for page in pages:
+            path = self._path_tokens(page)
+            hits, last = self._meta[page]
+            out[chain_digest(path)] = [
+                len(path), len(path) // self.page_size,
+                self._ref.get(page, 0), hits, last]
+        return out
+
+    def take_chain_delta(self, top_k=32):
+        """Incremental wire form of `chain_summary`: what changed since
+        the previous take, as {"upd": {digest: node}, "gone": [digest]}
+        — or None when nothing did (the common idle heartbeat ships
+        zero extra bytes). Applying every delta in order onto an empty
+        dict rebuilds `chain_summary(top_k)` EXACTLY (the counter/sketch
+        merge-of-deltas contract, pinned by tests/test_cache_obs.py)."""
+        if not self._chains_dirty:
+            return None
+        self._chains_dirty = False
+        cur = self.chain_summary(top_k)
+        prev = self._last_summary
+        upd = {d: v for d, v in cur.items() if prev.get(d) != v}
+        gone = [d for d in prev if d not in cur]
+        self._last_summary = cur
+        if not upd and not gone:
+            return None
+        return {"upd": upd, "gone": gone}
 
     # -- internals --
 
@@ -409,15 +502,21 @@ class PageAllocator:
         n = self._ref.get(page, 0)
         if n == 0:
             self._evictable.pop(page, None)  # cached -> live
+            if page in self._imported:
+                self._imported_live += 1
         self._ref[page] = n + 1
+        self._chains_dirty = True  # a registered node's ref moved
 
     def _decref(self, page):
         n = self._ref.get(page, 0)
         assert n >= 1, f"double free of page {page}"
+        self._chains_dirty = True
         if n > 1:
             self._ref[page] = n - 1
             return
         self._ref.pop(page)
+        if page in self._imported:
+            self._imported_live -= 1
         if page in self._node:
             self._evictable[page] = None   # keep for future prefix hits
         else:
@@ -444,6 +543,8 @@ class PageAllocator:
         self._evictable.pop(page)
         parent, toks = self._node.pop(page)
         self._imported.discard(page)   # no longer a transferred chain node
+        self._meta.pop(page, None)
+        self._chains_dirty = True
         self._children.get(parent, {}).pop(toks, None)
         for child in list(self._children.pop(page, {}).values()):
             self._deregister_subtree(child)
@@ -451,7 +552,13 @@ class PageAllocator:
 
     def _deregister_subtree(self, page):
         self._node.pop(page)
+        if page in self._imported and page not in self._evictable:
+            # a LIVE imported page losing its registration also leaves
+            # the imported set — the incremental counter must follow
+            self._imported_live -= 1
         self._imported.discard(page)
+        self._meta.pop(page, None)
+        self._chains_dirty = True
         for child in list(self._children.pop(page, {}).values()):
             self._deregister_subtree(child)
         if page in self._evictable:
@@ -496,6 +603,14 @@ class PageAllocator:
             assert page not in free, (
                 f"imported page {page} is simultaneously registered and "
                 "free — splice accounting broken")
+        # ISSUE 16 satellite: the incrementally maintained imported-live
+        # counter must equal the scan it replaced on the heartbeat path
+        scan = sum(1 for p in self._imported if self._ref.get(p, 0) > 0)
+        assert self._imported_live == scan, (
+            f"imported_live counter {self._imported_live} != scan {scan}"
+            " — a ref transition missed its increment")
+        assert set(self._meta) == set(self._node), (
+            "chain hotness meta out of sync with registered nodes")
         assert sum(self._reserved.values()) <= len(free) + len(cached), (
             "outstanding reservations exceed reclaimable pages")
         return self.stats()

@@ -99,6 +99,7 @@ OP_TIMEOUT_S = {
     # megabytes, not a control message — so they get the submit budget
     "fetch_pages": 60.0,
     "import_pages": 60.0,
+    "chains": 10.0,
 }
 IDEMPOTENT_OPS = frozenset({"ping"})
 
@@ -155,6 +156,7 @@ class _EngineProxy:
         self._pending = 0
         self._prefilling = 0       # paged: slots mid-chunked-prefill
         self.kv = None             # paged: page-budget heartbeat mirror
+        self.chains = None         # paged: chain-summary mirror (ISSUE 16)
         self._tick_s = 0.0
 
     def tick_estimate_s(self):
@@ -175,6 +177,17 @@ class _EngineProxy:
             self.kv = dict(hb["kv"])  # page budget rides every beat
         self._tick_s = float(hb.get("tick_s", 0.0))
 
+    def apply_chain_delta(self, delta):
+        """Merge one step reply's chain-summary delta (ISSUE 16) into
+        the parent-side mirror — the counter/sketch delta pattern:
+        applying every delta in arrival order rebuilds the worker's
+        direct `chain_summary()` exactly (pinned)."""
+        if self.chains is None:
+            self.chains = {}
+        self.chains.update(delta.get("upd") or {})
+        for d in delta.get("gone") or ():
+            self.chains.pop(d, None)
+
     def clear(self):
         self.sched.free_slots = 0
         self.sched.queue_depth = 0
@@ -182,7 +195,8 @@ class _EngineProxy:
         self._pending = 0
         self._prefilling = 0
         self.kv = None  # a corpse's page stats must not keep feeding
-        self._tick_s = 0.0  # the router's fleet paging gauges
+        self.chains = None  # the router's fleet paging gauges / cache
+        self._tick_s = 0.0  # map — its next life re-ships from scratch
 
 
 class ProcReplica(ReplicaHealth):
@@ -432,6 +446,9 @@ class ProcReplica(ReplicaHealth):
             # sketch equals one built from the worker's raw stream
             for key, d in reply["series"].items():
                 self._reg.series(key).sketch.merge_dict(d)
+        if reply.get("chains"):
+            # prefix-chain summary deltas (ISSUE 16): same merge story
+            self.engine.apply_chain_delta(reply["chains"])
         if reply.get("trace"):
             # restamp NOW, at arrival: age_s was measured against the
             # worker clock when the reply was built; parent_now - age is
@@ -624,6 +641,14 @@ class ProcReplica(ReplicaHealth):
             what=f"replica {self.replica_id} ping",
             policy=RetryPolicy(attempts=3, base_s=0.05, cap_s=0.5),
             retry_on=(FrameTimeout,), registry=self._reg, sink=self.sink)
+
+    def chain_summary(self):
+        """The worker's DIRECT chain summary over RPC (ISSUE 16) — the
+        parity oracle for the delta-merged `engine.chains` mirror
+        (tests only; the router never takes this extra round trip)."""
+        reply = self._rpc({"op": "chains"},
+                          timeout_s=OP_TIMEOUT_S["chains"])
+        return reply.get("chains") or {}
 
     def arm_fault(self, spec, seed=0):
         """Install a seeded fault injector in THIS worker (the chaos

@@ -51,6 +51,7 @@ from typing import Optional, Tuple
 import jax
 
 from avenir_tpu.obs import NullSink, get_registry
+from avenir_tpu.serve.cache_map import FleetCacheMap
 from avenir_tpu.serve.engine import FinishedRequest
 from avenir_tpu.serve.replica import (
     DEAD,
@@ -124,7 +125,8 @@ class Router:
                  backend="inproc", model_spec=None, supervise=False,
                  respawn_policy=None, max_respawns=5, proc_kwargs=None,
                  engine_kwargs=None, tracer=None, draft_model=None,
-                 n_prefill=0, disagg_min_prompt=None, anomaly=None):
+                 n_prefill=0, disagg_min_prompt=None, anomaly=None,
+                 cache_telescope=False):
         """`weights`: dispatch shares per priority class (default
         interactive 4 : batch 1). `queue_limits`: max queued per class
         before shedding (default 16/64 x fleet slots). `clock` is shared
@@ -185,6 +187,23 @@ class Router:
         default) disables tracing end to end — replicas then build no
         buffers and workers ship no trace frames.
 
+        `cache_telescope` (ISSUE 16): arms the fleet cache telescope —
+        every replica ships its allocator's top-K prefix-chain summary
+        (chain_topk rides `engine_kwargs`; process workers attach
+        deltas to step-reply heartbeats, in-process engines are read
+        directly) into a router-side FleetCacheMap, and every dispatch
+        decision is audited COUNTERFACTUALLY: the chosen replica's
+        shared-prefix depth vs the fleet-best placement's. The audit
+        partitions each dispatched prompt's tokens exactly into the
+        `prefix_tokens_reused` / `prefix_tokens_missed` /
+        `prefix_tokens_cold` counters and emits a `missed_reuse` trace
+        event when a better placement existed. Observability ONLY —
+        routing reads NOTHING from the map this issue (the PR 17
+        affinity router is the consumer); False (the default) disables
+        it end to end behind one pointer check. Pass True for the
+        default top-K of 32 or an int to set the per-replica summary
+        cap (heartbeat growth is bounded at ~60 bytes/node).
+
         `anomaly` (ISSUE 14): an obs/anomaly.py AnomalyEngine — the
         fleet health tier. Each step the router feeds it replica step
         walls, heartbeat age, oldest-queued wait, TTFT/TPOT of finished
@@ -211,6 +230,19 @@ class Router:
             stall_floor_secs=stall_floor_secs,
             stall_factor=stall_factor)
         self._engine_kwargs = dict(engine_kwargs or {})
+        # fleet cache telescope (ISSUE 16): content view + reuse audit.
+        # Armed BEFORE replicas build so chain_topk rides every hello
+        self._cache_map = None
+        if cache_telescope:
+            topk = 32 if cache_telescope is True else int(cache_telescope)
+            assert topk > 0, "cache_telescope top-K must be positive"
+            self._engine_kwargs.setdefault("chain_topk", topk)
+            self._cache_map = FleetCacheMap(clock=self._clock)
+            # pre-create the partition counters so a zero-traffic fleet
+            # still exports all three (and the schema lint sees them)
+            self._reg.counter("prefix_tokens_reused")
+            self._reg.counter("prefix_tokens_missed")
+            self._reg.counter("prefix_tokens_cold")
         self._draft_model = draft_model
         self._spec = None
         self._pk = {}
@@ -449,6 +481,8 @@ class Router:
                 self._retiring.discard(rep.replica_id)
                 self._by_replica.pop(rep.replica_id)
                 self._role.pop(rep.replica_id, None)
+                if self._cache_map is not None:
+                    self._cache_map.drop(rep.replica_id)
                 self.replicas.remove(rep)
                 if hasattr(rep, "close"):
                     rep.close()
@@ -637,18 +671,45 @@ class Router:
             if paged is not None:
                 a = paged.alloc.stats()
                 kvs.append((a["free"] + a["cached"], a["util"],
-                            paged.prefix_hit_rate()))
+                            paged.prefix_hit_rate(),
+                            paged.prompt_tokens))
             elif getattr(r.engine, "kv", None):
                 kv = r.engine.kv
                 kvs.append((kv.get("pages_free", 0),
                             kv.get("page_util", 0.0),
-                            kv.get("prefix_hit_rate", 0.0)))
+                            kv.get("prefix_hit_rate", 0.0),
+                            kv.get("prefix_attempts", 0)))
         if kvs:
             self._reg.gauge("kv_pages_free").set(sum(k[0] for k in kvs))
             self._reg.gauge("kv_page_util").set(
                 sum(k[1] for k in kvs) / len(kvs))
+            # attempt-weighted, not a plain mean of per-replica rates: a
+            # replica that admitted 3 prompts must not drag down (or
+            # prop up) the fleet rate as hard as one that admitted 300.
+            # Weights are prompt-token attach attempts — inproc read
+            # directly, process shipped in the heartbeat kv dict
+            # (`prefix_attempts`); a fleet with no attempts yet falls
+            # back to the unweighted mean (all rates are 0.0 anyway)
+            w = sum(k[3] for k in kvs)
             self._reg.gauge("prefix_hit_rate").set(
-                sum(k[2] for k in kvs) / len(kvs))
+                sum(k[2] * k[3] for k in kvs) / w if w
+                else sum(k[2] for k in kvs) / len(kvs))
+        cm = self._cache_map
+        if cm is not None:
+            # refresh the content view AFTER replicas stepped, so this
+            # step's admissions are visible to next step's audits.
+            # Inproc engines are read directly; process replicas expose
+            # the heartbeat-delta-merged mirror (proxy.chains, None
+            # until the worker's first summary ships)
+            t_cm = self._clock()
+            for r in self.replicas:
+                if r.state == DEAD:
+                    continue
+                eng = r.engine
+                if getattr(eng, "_paged", None) is not None:
+                    cm.update(r.replica_id, eng.chain_summary(), now=t_cm)
+                elif getattr(eng, "chains", None) is not None:
+                    cm.update(r.replica_id, eng.chains, now=t_cm)
         if ae is not None:
             self._feed_anomaly(ae, finished)
         return finished
@@ -977,6 +1038,48 @@ class Router:
                                  replica=rep.replica_id,
                                  eng_rid=eng_rid,
                                  failovers=req.failovers)
+            if self._cache_map is not None:
+                self._audit_dispatch(req, rep)
+
+    def _audit_dispatch(self, req, rep):
+        """Counterfactual reuse audit (ISSUE 16): compare the CHOSEN
+        replica's shared-prefix depth for this prompt against the
+        fleet-best placement's, per the cache map's content view. The
+        prompt's tokens are partitioned EXACTLY into three counters —
+        reused (the chosen replica already holds them), missed (some
+        OTHER replica holds them: the fleet is about to recompute a
+        prefix it has), cold (no tracked replica holds them) — and a
+        `missed_reuse` trace event fires when a better placement
+        existed. Audits the dispatch DECISION: a failover or disagg
+        handoff re-dispatch is a new decision and is re-audited, so
+        the partition identity is per-dispatch, not per-admit.
+        Observability only — nothing here feeds placement (PR 17)."""
+        cm = self._cache_map
+        m = cm.match(req.prompt)
+        n = len(req.prompt)
+        reused = m.get(rep.replica_id, 0)
+        best_rid, best = rep.replica_id, reused
+        for rid in sorted(m, key=str):
+            if m[rid] > best:
+                best_rid, best = rid, m[rid]
+        missed = best - reused
+        cold = n - best
+        self._reg.counter("prefix_tokens_reused").add(reused)
+        self._reg.counter("prefix_tokens_missed").add(missed)
+        self._reg.counter("prefix_tokens_cold").add(cold)
+        if missed > 0 and self.tracer is not None:
+            # est saved ms: fleet-observed per-token prefill cost x the
+            # tokens about to be recomputed — serve_prefill_ms over the
+            # tokens prefill actually computed so far (missed + cold)
+            computed = (self._reg.counter("prefix_tokens_missed").total
+                        + self._reg.counter("prefix_tokens_cold").total)
+            cost = (self._reg.counter("serve_prefill_ms").total / computed
+                    if computed else 0.0)
+            self.tracer.emit(
+                req.rid, "missed_reuse", t=req.dispatch_t,
+                replica=rep.replica_id, best_replica=best_rid,
+                reused=reused, missed=missed, cold=cold,
+                est_ms_saved=round(missed * cost, 3))
 
     # ---- disaggregated page transfer + handoff (ISSUE 13) ----
 
@@ -1126,6 +1229,8 @@ class Router:
             self.tracer.emit(req.rid, "dispatch", t=req.dispatch_t,
                              replica=tgt.replica_id, eng_rid=eng_rid,
                              failovers=req.failovers, handoff=True)
+        if self._cache_map is not None:
+            self._audit_dispatch(req, tgt)
 
     def _harvest(self, rep, f):
         """Map an engine FinishedRequest back to its router identity."""
@@ -1177,6 +1282,11 @@ class Router:
             if tr.get("target") == rep.replica_id:
                 tr["target"] = None
                 tr["shipped"] = 0
+        if self._cache_map is not None:
+            # BEFORE the idle-corpse early return: a dead replica's
+            # advertised cache content must leave the map even when it
+            # held no work — a corpse must never win best_match
+            self._cache_map.drop(rep.replica_id)
         assigned = self._by_replica[rep.replica_id]
         if not assigned:
             return

@@ -126,7 +126,7 @@ def _serve(stream):
              ("kv_impl", "page_size", "n_pages", "max_pages_per_seq",
               "prefill_chunk", "prefix_sharing", "paged_attn_impl",
               "kv_dtype", "spec_decode", "spec_k", "role",
-              "health_series")
+              "health_series", "chain_topk")
              if ekw.get(k) is not None}
     # request tracing (ISSUE 10): the parent's hello flips this flag;
     # the engine collects lifecycle events in a bounded buffer and every
@@ -240,12 +240,17 @@ def _serve(stream):
                 # merges them into the fleet series exactly like the
                 # counter deltas below (None when the series is off)
                 series = engine.take_series_delta()
+                # prefix-chain summary deltas (ISSUE 16): same wire
+                # pattern — incremental, absent when nothing changed,
+                # merged parent-side into the _EngineProxy mirror
+                chains = engine.take_chain_delta()
                 send({
                     "ok": True,
                     "finished": [_fin_dict(f) for f in finished],
                     "first": first,
                     "hb": hb(),
                     **({"series": series} if series else {}),
+                    **({"chains": chains} if chains else {}),
                     "counters": reg.counters(),
                     # disagg (ISSUE 13): queued page exports stay here
                     # (tensors never ride a JSON reply) — the parent
@@ -308,6 +313,11 @@ def _serve(stream):
                       "counters": reg.counters()})
             elif op == "ping":
                 send({"ok": True, "hb": hb(), "pid": os.getpid()})
+            elif op == "chains":
+                # debug/parity op (ISSUE 16): the DIRECT summary on this
+                # worker's own allocator — the oracle the parent's
+                # delta-merged mirror is pinned against in tests
+                send({"ok": True, "chains": engine.chain_summary()})
             elif op == "arm_fault":
                 # CONSTRUCT (validate) first — a bad spec must become an
                 # error reply, not raise after an ok was already written

@@ -1,0 +1,483 @@
+"""Fleet cache telescope tests (ISSUE 16).
+
+Four layers, mirroring the subsystem:
+
+  1. the allocator's chain telemetry as PURE HOST CODE — summary wire
+     form, digest identity, top-K hotness bound, the delta/merge pin
+     (replaying every `take_chain_delta` onto {} reproduces the direct
+     `chain_summary` EXACTLY through admit/COW/evict/import churn),
+     and the incremental `imported_live` counter vs the audit scan;
+  2. the FleetCacheMap — digest matching, deterministic best_match,
+     staleness, corpse drop;
+  3. the router auditor over the INPROC backend — the token-partition
+     identity (reused + missed + cold == every dispatched prompt
+     token), per-event partition on `missed_reuse`, the weighted
+     `prefix_hit_rate` gauge, and the disabled path pinned to a bare
+     pointer check (micro-pin + relative fleet-step budget);
+  4. the PROCESS backend (slow: real workers) — heartbeat-delta-merged
+     mirrors equal the direct summary RPC, the partition holds across
+     the pipe, and a SIGKILLed replica's summary leaves the map.
+
+`tools/cache_report.py --smoke` runs in tier-1 like the disagg bench
+smoke; the obs_report paging line grows the reuse partition.
+"""
+
+import time
+
+import numpy as np
+import pytest
+from flax import nnx
+
+from avenir_tpu.models.gpt import GPT, GPTConfig
+from avenir_tpu.obs import MetricsRegistry
+from avenir_tpu.obs.trace import Tracer
+from avenir_tpu.serve import PageAllocator, Router
+from avenir_tpu.serve.cache_map import FleetCacheMap, merge_chain_delta
+from avenir_tpu.serve.pages import chain_digest
+
+GPT_TINY = GPTConfig(block_size=64, vocab_size=64, n_layer=1, n_head=2,
+                     n_embd=32, dropout=0.0, bias=True, attn_impl="xla")
+PAGED_KW = dict(kv_impl="paged", page_size=8, n_pages=48,
+                prefill_chunk=8)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return GPT(GPT_TINY, rngs=nnx.Rngs(0))
+
+
+# ---------------------------------------------------------------------
+# 1. allocator chain telemetry (pure host)
+# ---------------------------------------------------------------------
+
+
+def _admit_register(a, rid, prompt, max_new=4):
+    """Admit + cover every FULL prompt page the way the engine's
+    chunked prefill does: alloc an owned page, register it the moment
+    its tokens are fully prompt-covered."""
+    prompt = [int(t) for t in prompt]
+    plan = a.admit(rid, prompt, max_new=max_new)
+    assert plan is not None
+    ps = a.page_size
+    slot = len(a.table(rid))
+    for i in range(len(plan.shared_pages), len(prompt) // ps):
+        a.alloc(rid)
+        a.register(rid, slot, prompt[i * ps:(i + 1) * ps])
+        slot += 1
+    return plan
+
+
+def test_chain_digest_is_stable_and_distinct():
+    d = chain_digest([1, 2, 3])
+    assert d == chain_digest((1, 2, 3))          # type-insensitive
+    assert isinstance(d, str) and len(d) == 16   # blake2b-64 hex: wire-safe
+    assert d != chain_digest([1, 2, 4])
+    assert d != chain_digest([1, 2])
+
+
+def test_chain_summary_wire_form_and_digest_identity():
+    a = PageAllocator(n_pages=8, page_size=4, prefix_sharing=True)
+    prompt = list(range(1, 13))                  # 3 full pages
+    _admit_register(a, 0, prompt)
+    s = a.chain_summary()
+    assert set(s) == {chain_digest(prompt[:4]), chain_digest(prompt[:8]),
+                      chain_digest(prompt[:12])}
+    node = s[chain_digest(prompt[:8])]
+    assert isinstance(node, list) and len(node) == 5
+    n_tok, depth, ref, hits, last = node
+    assert (n_tok, depth) == (8, 2)
+    assert ref == 1 and hits == 0                # live under rid 0, no attach yet
+    # a second request attaching the shared prefix bumps hotness and ref
+    _admit_register(a, 1, prompt[:8] + [90, 91, 92, 93])
+    s2 = a.chain_summary()
+    n2 = s2[chain_digest(prompt[:8])]
+    assert n2[2] == 2 and n2[3] == 1 and n2[4] > last
+    a.free_seq(0)
+    a.free_seq(1)
+    a.audit()
+
+
+def test_chain_summary_topk_keeps_hottest():
+    a = PageAllocator(n_pages=16, page_size=2, prefix_sharing=True)
+    roots = [[10 * i + 1, 10 * i + 2, 10 * i + 3, 10 * i + 4]
+             for i in range(5)]
+    for i, p in enumerate(roots):
+        _admit_register(a, i, p, max_new=1)
+    for i in range(5):
+        a.free_seq(i)
+    # two attaches make chain 0's root the hottest node
+    for j in range(2):
+        _admit_register(a, 10 + j, roots[0][:2] + [70 + j, 80 + j],
+                        max_new=1)
+    top = a.chain_summary(top_k=2)
+    assert len(top) == 2
+    assert chain_digest(roots[0][:2]) in top
+    assert a.chain_summary(top_k=0) == {}
+    full = a.chain_summary(top_k=64)
+    assert len(full) == len(a._node)             # bound, not padding
+    a.audit()
+
+
+def test_take_chain_delta_merge_equals_direct_under_churn():
+    """THE merge pin: replaying every delta in order onto {} equals the
+    direct summary after every churn phase — admits, prefix attach,
+    frees, a cross-allocator import, COW, and pressure eviction."""
+    a = PageAllocator(n_pages=8, page_size=4, prefix_sharing=True)
+    shadow = {}
+    K = 16
+
+    def sync():
+        d = a.take_chain_delta(K)
+        if d is not None:
+            merge_chain_delta(shadow, d)
+        assert shadow == a.chain_summary(K)
+        a.audit()
+
+    sync()                                       # empty start
+    p0 = list(range(1, 13))
+    _admit_register(a, 0, p0)                    # 3 registered nodes
+    sync()
+    _admit_register(a, 1, p0[:8] + [91, 92, 93, 94])   # attach + extend
+    sync()
+    a.free_seq(0)
+    sync()
+    a.import_chain([(70, 71, 72, 73), (74, 75, 76, 77)])
+    sync()
+    a.free_seq(1)
+    sync()
+    # pressure: a big admit must evict LRU cached chains to stage pages
+    plan = a.admit(2, prompt=list(range(200, 216)), max_new=8)
+    assert plan is not None
+    for _ in range(4):
+        a.alloc(2)
+    sync()
+    a.free_seq(2)
+    sync()
+    # quiet allocator: the dirty flag short-circuits to None
+    assert a.take_chain_delta(K) is None
+
+
+def test_imported_live_incremental_vs_audit_scan():
+    a = PageAllocator(n_pages=6, page_size=2, prefix_sharing=True)
+    out = a.import_chain([(1, 2), (3, 4)])
+    assert [fresh for _, fresh in out] == [True, True]
+    page_a = out[0][0]
+    assert a.stats()["imported_live"] == 0       # cached, ref 0
+    a.audit()
+    # attaching both imported pages makes them live
+    plan = a.admit(0, prompt=[1, 2, 3, 4, 9], max_new=1)
+    assert len(plan.shared_pages) == 2
+    assert a.stats()["imported_live"] == 2
+    a.audit()
+    # COW on the root entry: the imported page leaves the live set
+    assert a.ensure_writable(0, 0) is not None
+    assert a.stats()["imported_live"] == 1
+    a.audit()
+    # evicting the now-cached root deregisters its LIVE imported child
+    # — the incremental counter must follow the subtree teardown
+    a._evict(page_a)
+    assert a.stats()["imported_live"] == 0
+    a.audit()
+    a.free_seq(0)
+    assert a.stats()["imported_live"] == 0
+    a.audit()
+
+
+# ---------------------------------------------------------------------
+# 2. FleetCacheMap
+# ---------------------------------------------------------------------
+
+
+def test_cache_map_match_best_match_and_drop():
+    T = list(range(1, 13))
+    m = FleetCacheMap(clock=lambda: 9.0)
+    m.update("A", {chain_digest(T[:4]): [4, 1, 1, 2, 7],
+                   chain_digest(T[:8]): [8, 2, 0, 1, 6]}, now=1.0)
+    m.update("B", {chain_digest(T[:4]): [4, 1, 0, 0, 1]}, now=2.0)
+    assert m.match(T) == {"A": 8, "B": 4}
+    assert m.best_match(T) == ("A", 8)
+    # a depth past the prompt can never match (reused <= len(prompt))
+    assert m.match(T[:6]) == {"A": 4, "B": 4}
+    assert m.best_match(T[:6]) == ("A", 4)       # deterministic tie-break
+    assert m.best_match([99, 98]) == (None, 0)
+    assert m.staleness_s("B") == pytest.approx(7.0)
+    assert m.staleness_s("B", now=5.0) == pytest.approx(3.0)
+    assert m.staleness_s("nope") is None
+    m.drop("A")
+    assert m.best_match(T) == ("B", 4)
+    assert m.replicas() == ["B"]
+    m.update("A", {}, now=3.0)                   # empty advert is fine
+    assert m.match(T)["A"] == 0
+
+
+def test_merge_chain_delta_is_the_one_rule():
+    s = {}
+    merge_chain_delta(s, {"upd": {"d1": [4, 1, 1, 0, 1]}, "gone": []})
+    merge_chain_delta(s, {"upd": {"d2": [8, 2, 0, 0, 2]},
+                          "gone": ["d1", "never_seen"]})
+    assert s == {"d2": [8, 2, 0, 0, 2]}
+
+
+# ---------------------------------------------------------------------
+# 3. the router auditor, inproc
+# ---------------------------------------------------------------------
+
+
+def _shared_prefix_reqs(rng, n, *, n_tenants=2, prefix_len=16,
+                        tail_lo=3, tail_hi=7):
+    prefixes = [[int(t) for t in rng.integers(0, 64, prefix_len)]
+                for _ in range(n_tenants)]
+    out = []
+    for _ in range(n):
+        tenant = int(rng.integers(0, n_tenants))
+        tail = [int(t) for t in rng.integers(
+            0, 64, int(rng.integers(tail_lo, tail_hi + 1)))]
+        out.append(prefixes[tenant] + tail)
+    return out
+
+
+def _drive(router, prompts, *, n_conc=4, max_new=4):
+    rid_prompt = {}
+    submitted, done = 0, []
+    while len(done) < len(prompts):
+        while (submitted < len(prompts)
+               and submitted - len(done) < n_conc):
+            p = prompts[submitted]
+            rid = router.submit(p, max_new_tokens=max_new,
+                                temperature=1.0, top_k=None)
+            rid_prompt[rid] = p
+            submitted += 1
+        done.extend(router.step())
+    router.drain()
+    return done, rid_prompt
+
+
+def test_partition_identity_and_missed_reuse_events_inproc(model):
+    reg = MetricsRegistry()
+    tracer = Tracer(registry=reg)
+    router = Router(model, n_replicas=2, n_slots=2, max_seq_len=64,
+                    registry=reg, seed=0, tracer=tracer,
+                    cache_telescope=True, engine_kwargs=dict(PAGED_KW))
+    prompts = _shared_prefix_reqs(np.random.default_rng(7), 12,
+                                  n_tenants=3, prefix_len=24)
+    done, rid_prompt = _drive(router, prompts, n_conc=3)
+    assert len(done) == len(prompts)
+    assert all(f.finish_reason == "length" for f in done)
+    c = reg.snapshot()["counters"]
+    total = sum(len(p) for p in prompts)
+    # THE partition identity: every dispatched prompt token in exactly
+    # one bucket (no failovers here, so dispatches == submissions)
+    assert (c["prefix_tokens_reused"] + c["prefix_tokens_missed"]
+            + c["prefix_tokens_cold"]) == total
+    # affinity-blind placement over 2 replicas sharing tenant prefixes
+    # must both reuse locally and miss cross-replica
+    assert c["prefix_tokens_missed"] > 0
+    assert c["prefix_tokens_reused"] > 0
+    evs = [e for e in tracer.events() if e["ev"] == "missed_reuse"]
+    assert evs and sum(e["missed"] for e in evs) \
+        == c["prefix_tokens_missed"]
+    for e in evs:
+        assert e["missed"] > 0                    # emitted only on a miss
+        assert e["best_replica"] != e["replica"]
+        assert e["reused"] + e["missed"] + e["cold"] \
+            == len(rid_prompt[e["rid"]])
+        assert e["est_ms_saved"] >= 0.0
+    # satellite 1: the fleet gauge is attempt-WEIGHTED across replicas
+    rates = [(r.engine._paged.prefix_hit_rate(),
+              r.engine._paged.prompt_tokens) for r in router.replicas]
+    w = sum(n for _, n in rates)
+    assert w > 0
+    assert reg.snapshot()["gauges"]["prefix_hit_rate"] == pytest.approx(
+        sum(rate * n for rate, n in rates) / w)
+    # the map tracked both replicas' content
+    assert sorted(router._cache_map.replicas()) \
+        == sorted(r.replica_id for r in router.replicas)
+    router.close()
+
+
+def test_telescope_off_router_has_no_map_and_no_counters(model):
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=1, n_slots=2, max_seq_len=64,
+                    registry=reg, seed=0, engine_kwargs=dict(PAGED_KW))
+    assert router._cache_map is None
+    prompts = _shared_prefix_reqs(np.random.default_rng(3), 3)
+    done, _ = _drive(router, prompts, n_conc=2)
+    assert len(done) == 3
+    assert "prefix_tokens_missed" not in reg.snapshot()["counters"]
+    router.close()
+
+
+def test_disabled_telescope_guard_is_nanoseconds():
+    """The per-dispatch cost with the telescope off is ONE attribute
+    load + `is not None` branch — the tracer's micro-pin applied to
+    `self._cache_map` (a real audit behind the guard would blow this
+    by orders of magnitude)."""
+    class _Holder:
+        _cache_map = None
+
+    h = _Holder()
+    n = 200_000
+    t0 = time.perf_counter()
+    acc = 0
+    for _ in range(n):
+        cm = h._cache_map
+        if cm is not None:                        # the exact site shape
+            acc += 1
+    per_op_us = (time.perf_counter() - t0) / n * 1e6
+    assert acc == 0
+    assert per_op_us < 1.0, (
+        f"disabled-telescope guard costs {per_op_us:.3f} us/op — the "
+        "disabled path must stay a bare None check")
+
+
+@pytest.mark.slow
+def test_disabled_telescope_adds_no_measurable_step_overhead(model):
+    """Fleet-step pin, relative like the tracing one: steps with the
+    telescope OFF are not slower than the SAME workload's steps with
+    it ON (which do strictly more work — audits, summary reads, map
+    refresh). Median-of-steps keeps compile spikes out; the budget is
+    3x + 2ms so a loaded CI harness cannot flake it. Slow lane: two
+    full fleet drives (~7s) blow the zz_slow_guard tier-1 budget; the
+    nanoseconds micro-pin above keeps the disabled path covered in
+    tier-1."""
+    import statistics
+
+    def median_step(telescope):
+        reg = MetricsRegistry()
+        router = Router(model, n_replicas=2, n_slots=2, max_seq_len=64,
+                        registry=reg, seed=0, cache_telescope=telescope,
+                        engine_kwargs=dict(PAGED_KW))
+        prompts = _shared_prefix_reqs(np.random.default_rng(5), 4)
+        rid = 0
+        durs = []
+        done = []
+        while len(done) < len(prompts):
+            while (rid < len(prompts)
+                   and rid - len(done) < 4):
+                router.submit(prompts[rid], max_new_tokens=2,
+                              temperature=1.0, top_k=None)
+                rid += 1
+            t0 = time.perf_counter()
+            done.extend(router.step())
+            durs.append(time.perf_counter() - t0)
+        router.close()
+        return statistics.median(durs)
+
+    on = median_step(True)
+    off = median_step(False)
+    assert off <= 3.0 * on + 2e-3, (
+        f"telescope-off steps ({off * 1e3:.2f} ms) slower than 3x "
+        f"telescope-on ({on * 1e3:.2f} ms) — the disabled path grew "
+        "real work")
+
+
+def test_cache_report_smoke_runs_in_ci():
+    from tools.cache_report import cache_report
+
+    rc = cache_report({"smoke": "1"})
+    assert rc == 0
+
+
+def test_obs_report_paging_line_shows_reuse_partition():
+    from avenir_tpu.obs.report import format_report, summarize
+
+    records = [
+        {"kind": "run_meta", "t": 0.0},
+        {"kind": "request", "t": 1.0, "ttft_ms": 5.0, "tpot_ms": 1.0,
+         "n_out": 4, "finish_reason": "length"},
+        {"kind": "run_end", "t": 2.0,
+         "counters": {"prefix_tokens_reused": 10.0,
+                      "prefix_tokens_missed": 5.0,
+                      "prefix_tokens_cold": 5.0,
+                      "serve_prefill_ms": 100.0,
+                      "prefill_chunks": 3.0}},
+    ]
+    s = summarize(records)
+    sv = s["serve"]
+    assert sv["prefix_tokens_missed"] == 5.0
+    # est saved = missed x (prefill ms / tokens prefill computed)
+    assert sv["est_prefill_ms_saved"] == pytest.approx(50.0)
+    out = format_report(s)
+    assert "reused 10/missed 5/cold 5 tok" in out
+    assert "est saved 50.0 ms" in out
+
+
+# ---------------------------------------------------------------------
+# 4. process backend (slow: real workers)
+# ---------------------------------------------------------------------
+
+
+@pytest.fixture()
+def _close_routers():
+    created = []
+    yield created
+    for router in created:
+        try:
+            router.close()
+        except Exception:
+            pass
+
+
+@pytest.mark.slow
+def test_process_chain_mirror_matches_direct_and_partition(
+        model, _close_routers):
+    """Satellite 3 + the tentpole wire pin over REAL worker processes:
+    the parent-side mirror rebuilt purely from step-reply heartbeat
+    deltas equals the worker allocator's direct `chain_summary()` —
+    after admit / attach / free churn — and the audit partition
+    identity holds across the pipe."""
+    reg = MetricsRegistry()
+    router = Router(model, backend="process", n_replicas=2, n_slots=2,
+                    max_seq_len=64, registry=reg, seed=0,
+                    cache_telescope=True, engine_kwargs=dict(PAGED_KW))
+    _close_routers.append(router)
+    prompts = _shared_prefix_reqs(np.random.default_rng(11), 8)
+    done, _ = _drive(router, prompts)
+    assert len(done) == len(prompts)
+    c = reg.snapshot()["counters"]
+    assert (c["prefix_tokens_reused"] + c["prefix_tokens_missed"]
+            + c["prefix_tokens_cold"]) == sum(len(p) for p in prompts)
+    saw_chains = 0
+    for r in router.replicas:
+        direct = r.chain_summary()               # debug RPC: allocator truth
+        mirror = r.engine.chains or {}
+        assert mirror == direct, (
+            f"replica {r.replica_id}: heartbeat-delta mirror diverged "
+            f"from the direct summary\n mirror {mirror}\n direct {direct}")
+        assert router._cache_map.nodes(r.replica_id) == mirror
+        saw_chains += len(direct)
+    assert saw_chains > 0                        # the telescope saw content
+
+
+@pytest.mark.slow
+def test_process_sigkill_drops_corpse_from_cache_map(
+        model, _close_routers):
+    """A SIGKILLed worker's advertised cache content leaves the
+    FleetCacheMap with it — a corpse must never win best_match — while
+    failover serves every request on the survivor."""
+    import os
+    import signal
+
+    reg = MetricsRegistry()
+    router = Router(model, backend="process", n_replicas=2, n_slots=2,
+                    max_seq_len=64, registry=reg, seed=0,
+                    cache_telescope=True, engine_kwargs=dict(PAGED_KW))
+    _close_routers.append(router)
+    prompts = _shared_prefix_reqs(np.random.default_rng(13), 6)
+    rids = [router.submit(p, max_new_tokens=8, temperature=1.0,
+                          top_k=None) for p in prompts]
+    done = []
+    while len(router._cache_map.replicas()) < 2:
+        done.extend(router.step())
+        assert len(done) < len(rids), "served out before both replicas advertised"
+    victim = next(r for r in router.replicas if r.busy)
+    os.kill(victim.pid, signal.SIGKILL)
+    done.extend(router.drain())
+    assert len(done) == len(prompts)
+    assert all(f.finish_reason == "length" for f in done)
+    assert victim.state == "dead"
+    assert victim.replica_id not in router._cache_map.replicas()
+    survivor = next(r for r in router.replicas if r is not victim)
+    assert router._cache_map.replicas() == [survivor.replica_id]
+    # the corpse's engine mirror was cleared with the rest of its state
+    assert victim.engine.chains is None

@@ -1,0 +1,258 @@
+"""Fleet cache telescope report + bench (ISSUE 16).
+
+Drives an in-process paged Router with the cache telescope and the
+flight recorder armed over a seeded MULTI-TENANT workload — T tenants,
+each with its own shared system prefix, tails random per request — and
+renders what the telescope saw:
+
+- the fleet cache map (per-replica advertised chains + staleness),
+- the hottest shared chains fleet-wide,
+- the dispatch token partition (reused / missed / cold) with the
+  estimated prefill ms the fleet left on the table, and
+- a per-tenant missed-reuse breakdown from the `missed_reuse` trace
+  events (which tenant's prefixes the cache-blind placement scatters).
+
+The router stays AFFINITY-BLIND by design this issue — placement
+maximizes free-slot fraction, ignoring cache content — so a tenant's
+requests land on whichever replica has room and the fleet re-prefills
+prefixes it already holds. That cost is the bench headline:
+
+    missed_reuse_frac = prefix_tokens_missed / all dispatched tokens
+
+written to BENCH_cache_obs.json over three seeds and banded in
+PERF_LEDGER.json as the BASELINE the PR 17 cache-affinity router must
+beat (its whole gain is driving this fraction toward zero).
+
+    python tools/cache_report.py                  # bench, writes JSON
+    python tools/cache_report.py --smoke          # tier-1 CI path
+    python tools/cache_report.py --seed=1 --n_requests=96
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from avenir_tpu.platform import honor_jax_platforms_env  # noqa: E402
+
+honor_jax_platforms_env()
+
+import numpy as np  # noqa: E402
+
+
+def _mk_workload(rng, V, *, n_tenants, prefix_len, n_requests,
+                 tail_lo, tail_hi):
+    """T tenants x one shared system prefix each + per-request random
+    tails: the prefix-cache-friendly shape (agents, RAG preambles)
+    where placement affinity matters most."""
+    prefixes = [[int(t) for t in rng.integers(0, V, prefix_len)]
+                for _ in range(n_tenants)]
+    reqs = []
+    for _ in range(n_requests):
+        tenant = int(rng.integers(0, n_tenants))
+        tail = [int(t) for t in
+                rng.integers(0, V, int(rng.integers(tail_lo, tail_hi + 1)))]
+        reqs.append((tenant, prefixes[tenant] + tail))
+    return prefixes, reqs
+
+
+def _run_telescope(seed, *, n_replicas, n_slots, n_tenants, prefix_len,
+                   tail_lo, tail_hi, n_requests, n_conc, max_new,
+                   page_size, n_pages, prefill_chunk, block_size,
+                   vocab_size=256, n_layer=1, n_embd=32):
+    """One seeded affinity-blind run; returns the telescope's full
+    accounting (counters, per-tenant misses, map view) plus enough to
+    assert the partition identity exactly."""
+    from flax import nnx
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+    from avenir_tpu.obs import MetricsRegistry
+    from avenir_tpu.obs.trace import Tracer
+    from avenir_tpu.serve import Router
+
+    model = GPT(GPTConfig(
+        block_size=block_size, vocab_size=vocab_size, n_layer=n_layer,
+        n_head=2, n_embd=n_embd, dropout=0.0, bias=True,
+        attn_impl="xla"), rngs=nnx.Rngs(seed))
+    reg = MetricsRegistry()
+    tracer = Tracer(registry=reg, capacity=16384)
+    router = Router(
+        model, n_replicas=n_replicas, n_slots=n_slots,
+        max_seq_len=block_size, registry=reg, seed=seed,
+        tracer=tracer, cache_telescope=True,
+        engine_kwargs={"kv_impl": "paged", "page_size": page_size,
+                       "n_pages": n_pages,
+                       "prefill_chunk": prefill_chunk})
+    rng = np.random.default_rng(seed)
+    _, reqs = _mk_workload(
+        rng, vocab_size, n_tenants=n_tenants, prefix_len=prefix_len,
+        n_requests=n_requests, tail_lo=tail_lo, tail_hi=tail_hi)
+    tenant_of = {}
+    dispatched_tokens = 0
+    submitted, done = 0, []
+    while len(done) < n_requests:
+        while submitted < n_requests and submitted - len(done) < n_conc:
+            tenant, prompt = reqs[submitted]
+            rid = router.submit(prompt, max_new_tokens=max_new,
+                                temperature=1.0, top_k=None)
+            tenant_of[rid] = tenant
+            dispatched_tokens += len(prompt)
+            submitted += 1
+        done.extend(router.step())
+    router.drain()
+    snap = reg.snapshot()
+    counters = snap["counters"]
+    reused = counters.get("prefix_tokens_reused", 0.0)
+    missed = counters.get("prefix_tokens_missed", 0.0)
+    cold = counters.get("prefix_tokens_cold", 0.0)
+    total = reused + missed + cold
+    by_tenant = {}
+    est_saved_ms = 0.0
+    for e in tracer.events():
+        if e["ev"] != "missed_reuse":
+            continue
+        t = tenant_of.get(e["rid"])
+        if t is not None:
+            agg = by_tenant.setdefault(t, {"events": 0, "missed": 0})
+            agg["events"] += 1
+            agg["missed"] += e["missed"]
+        est_saved_ms += e.get("est_ms_saved", 0.0)
+    cmap = router._cache_map
+    map_view = {
+        str(rid): {
+            "chains": len(cmap.nodes(rid)),
+            "deepest_tok": max(
+                (int(n[0]) for n in cmap.nodes(rid).values()),
+                default=0),
+        }
+        for rid in cmap.replicas()
+    }
+    # hottest advertised chains fleet-wide: (hits, n_tokens) desc
+    chains = []
+    for rid in cmap.replicas():
+        for dig, n in cmap.nodes(rid).items():
+            chains.append((int(n[3]), int(n[0]), str(rid), dig))
+    chains.sort(reverse=True)
+    router.close()
+    assert len(done) == n_requests
+    assert all(f.finish_reason == "length" for f in done), (
+        [f.finish_reason for f in done])
+    return {
+        "seed": seed,
+        "n_served": len(done),
+        "dispatched_tokens": dispatched_tokens,
+        "reused": reused, "missed": missed, "cold": cold,
+        "audited_tokens": total,
+        "missed_reuse_frac": missed / total if total else 0.0,
+        "reused_frac": reused / total if total else 0.0,
+        "est_prefill_ms_saved": est_saved_ms,
+        "prefill_ms": counters.get("serve_prefill_ms", 0.0),
+        "by_tenant": by_tenant,
+        "map": map_view,
+        "top_chains": chains[:8],
+    }
+
+
+def _print_run(r):
+    print(f"[cache] seed {r['seed']}: {r['n_served']} served, "
+          f"{r['audited_tokens']:.0f} prompt tokens audited")
+    print(f"  partition: reused {r['reused']:.0f}  "
+          f"missed {r['missed']:.0f}  cold {r['cold']:.0f}  "
+          f"(missed frac {r['missed_reuse_frac']:.1%})")
+    print(f"  est prefill ms left on the table: "
+          f"{r['est_prefill_ms_saved']:.1f} "
+          f"(of {r['prefill_ms']:.1f} ms spent)")
+    print("  fleet map: " + "   ".join(
+        f"r{rid}: {v['chains']} chains, deepest {v['deepest_tok']} tok"
+        for rid, v in sorted(r["map"].items())))
+    if r["top_chains"]:
+        print("  hottest chains: " + "   ".join(
+            f"{dig[:8]}@r{rid} {n}tok x{h}"
+            for h, n, rid, dig in r["top_chains"][:4]))
+    for t, agg in sorted(r["by_tenant"].items()):
+        print(f"  tenant {t}: {agg['events']} missed-reuse dispatches, "
+              f"{agg['missed']} tokens recomputed elsewhere")
+
+
+def cache_report(args):
+    """Entry point (dict args — tests call this directly). `--smoke`
+    asserts the mechanics at tiny scale; the default bench runs three
+    seeds and writes BENCH_cache_obs.json."""
+    import json as _json
+
+    smoke = "smoke" in args
+    cfg = dict(
+        n_replicas=int(args.get("n_replicas", 2 if smoke else 3)),
+        n_slots=int(args.get("n_slots", 2)),
+        n_tenants=int(args.get("n_tenants", 2 if smoke else 4)),
+        prefix_len=int(args.get("prefix_len", 24 if smoke else 48)),
+        tail_lo=int(args.get("tail_lo", 4)),
+        tail_hi=int(args.get("tail_hi", 8 if smoke else 16)),
+        n_requests=int(args.get("n_requests", 10 if smoke else 48)),
+        n_conc=int(args.get("n_conc", 4 if smoke else 6)),
+        max_new=int(args.get("max_new_tokens", 4 if smoke else 8)),
+        page_size=int(args.get("page_size", 8)),
+        n_pages=int(args.get("n_pages", 96 if smoke else 192)),
+        prefill_chunk=int(args.get("prefill_chunk", 16)),
+        block_size=int(args.get("block_size", 64 if smoke else 128)),
+    )
+    if smoke:
+        r = _run_telescope(int(args.get("seed", 0)), **cfg)
+        _print_run(r)
+        # the partition identity: every dispatched prompt token landed
+        # in exactly one bucket (no failovers here, so dispatches ==
+        # submissions)
+        assert r["audited_tokens"] == r["dispatched_tokens"], (
+            r["audited_tokens"], r["dispatched_tokens"])
+        # affinity-blind placement over shared-prefix tenants MUST
+        # leave reuse on the table across >= 2 replicas — a zero here
+        # means the telescope went blind, not that routing got smart
+        assert r["missed"] > 0, "no missed reuse observed in smoke"
+        assert r["reused"] > 0, "no local reuse observed in smoke"
+        print("[cache] smoke ok: partition exact, misses visible")
+        return 0
+    seeds = [int(s) for s in str(args.get("seeds", "0,1,2")).split(",")]
+    runs = [_run_telescope(s, **cfg) for s in seeds]
+    for r in runs:
+        _print_run(r)
+    fracs = [r["missed_reuse_frac"] for r in runs]
+    mean = sum(fracs) / len(fracs)
+    spread = (max(fracs) - min(fracs)) / mean if mean else 0.0
+    bench = {
+        "kind": "cache_obs",
+        "config": cfg,
+        "seeds": [
+            {"seed": r["seed"],
+             "missed_reuse_frac": r["missed_reuse_frac"],
+             "reused_frac": r["reused_frac"],
+             "audited_tokens": r["audited_tokens"],
+             "est_prefill_ms_saved": r["est_prefill_ms_saved"]}
+            for r in runs],
+        "missed_reuse_frac": mean,
+        "seed_spread_frac": spread,
+        "note": ("missed-reuse fraction of dispatched prompt tokens "
+                 "under AFFINITY-BLIND routing — the baseline the "
+                 "PR 17 cache-affinity router must beat (direction: "
+                 "lower). Partition identity asserted per seed."),
+        "ok": bool(
+            all(r["audited_tokens"] == r["dispatched_tokens"]
+                for r in runs)
+            and all(f > 0.0 for f in fracs)),
+    }
+    out_path = args.get("out", "BENCH_cache_obs.json")
+    with open(out_path, "w") as f:
+        _json.dump(bench, f, indent=1)
+    print(f"[cache] missed_reuse_frac {mean:.3f} over seeds "
+          f"{seeds} (spread {spread:.2f}) -> {out_path} "
+          f"(ok={bench['ok']})")
+    return 0 if bench["ok"] else 1
+
+
+def main():
+    args = {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
+            for a in sys.argv[1:]}
+    return cache_report(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
