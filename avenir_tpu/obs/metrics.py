@@ -354,6 +354,28 @@ METRIC_SCHEMA = {
         "counter", "tok",
         "prompt tokens no tracked replica held at dispatch — "
         "genuinely new prefill work no placement could have avoided"),
+    # -- fleet KV CDN (ISSUE 17): prefix-affinity routing + peer
+    #    prefix pull (Router(affinity=...) arms it, telescope required) --
+    "affinity_hits": (
+        "counter", "1",
+        "dispatches the affinity router placed on a replica already "
+        "advertising a shared prefix chain of the prompt — the "
+        "placements the telescope's audit counts as reused"),
+    "prefix_pull_pages": (
+        "counter", "1",
+        "KV pages WRITTEN into the chosen replica by brokered peer "
+        "prefix pulls (chain nodes it already held dedupe and are not "
+        "counted)"),
+    "prefix_pull_bytes": (
+        "counter", "bytes",
+        "tensor bytes shipped over PT_KVPAGES frames by peer prefix "
+        "pulls (page K/V data + int8 scale sidecars)"),
+    "prefix_pull_fallbacks": (
+        "counter", "1",
+        "brokered pulls that fell back to local re-prefill — source "
+        "died/evicted the chain, frame CRC trip, RPC timeout, or the "
+        "destination died under the import; pulls are an optimization, "
+        "never a correctness dependency"),
     # -- disaggregated prefill/decode (ISSUE 13) --
     "kv_pages_exported": (
         "counter", "1",

@@ -195,6 +195,13 @@ def summarize(records, *, skipped_lines=()):
                 if (counters.get("prefix_tokens_missed", 0.0)
                     + counters.get("prefix_tokens_cold", 0.0)) > 0
                 else 0.0),
+            # fleet KV CDN (ISSUE 17): affinity placements + the peer
+            # pull ledger (pages/bytes shipped, fallbacks taken)
+            "affinity_hits": counters.get("affinity_hits", 0.0),
+            "prefix_pull_pages": counters.get("prefix_pull_pages", 0.0),
+            "prefix_pull_bytes": counters.get("prefix_pull_bytes", 0.0),
+            "prefix_pull_fallbacks": counters.get(
+                "prefix_pull_fallbacks", 0.0),
         }
     by_detector = {}
     for r in anomalies:
@@ -357,6 +364,14 @@ def format_report(s):
              if sv.get("replica_seconds") else ""),
             (f"prewarm ticks {sv['prewarm_ticks']:.0f}"
              if sv.get("prewarm_ticks") else ""),
+            (f"affinity hits {sv['affinity_hits']:.0f}"
+             if sv.get("affinity_hits") else ""),
+            (f"pulls {sv['prefix_pull_pages']:.0f} pages/"
+             f"{sv['prefix_pull_bytes'] / 1024:.0f} KiB"
+             + (f" ({sv['prefix_pull_fallbacks']:.0f} fallbacks)"
+                if sv.get("prefix_pull_fallbacks") else "")
+             if sv.get("prefix_pull_pages")
+             or sv.get("prefix_pull_fallbacks") else ""),
         ]
         fleet_bits = [b for b in fleet_bits if b]
         if fleet_bits:

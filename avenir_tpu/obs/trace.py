@@ -87,6 +87,12 @@ TRACE_EVENTS = {
                      # the per-request counterfactual behind the
                      # prefix_tokens_missed counter (router-emitted,
                      # only when missed > 0)
+    "prefix_pull",   # the affinity router brokered a peer prefix pull
+                     # (ISSUE 17): attrs src/dst replica, pages written,
+                     # depth (shared tokens at the source), outcome —
+                     # 'ok', or the fallback taken ('src_dead',
+                     # 'src_evicted', 'src_gone', 'dst_dead'); every
+                     # non-ok outcome also bumps prefix_pull_fallbacks
     "anomaly",       # one health-engine detector fire (rid=None):
                      # detector/key/value/threshold + robust-statistic
                      # evidence (obs/anomaly.py, ISSUE 14) — also a

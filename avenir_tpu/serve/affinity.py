@@ -1,0 +1,151 @@
+"""Fleet-wide KV reuse policy — the "KV CDN" (ISSUE 17 tentpole).
+
+Two cooperating layers turn the fleet into one cache:
+
+1. **Prefix-affinity placement.** The Router's placement score gains an
+   affinity term fed by the fleet cache map (`serve/cache_map.py`, the
+   ISSUE 16 content view): route each request toward the replica whose
+   advertised chains share the deepest prefix with the prompt. The
+   bonus is `weight * shared_tokens / prompt_tokens`, CAPPED by the
+   candidate's free-slot fraction — a hot system prompt cannot hotspot
+   one replica, because the bonus decays exactly as fast as the
+   replica's capacity does. Prefixes nobody holds yet get a tiny
+   consistent-hash nudge (`shard_weight`) toward a stable home, so
+   cold prefix families shard across the fleet's aggregate cache
+   capacity instead of herding onto the tie-break winner.
+
+2. **Peer prefix pull (miss path).** When the chosen replica misses but
+   a peer advertises a materially deeper prefix (`pull_min_tokens`
+   threshold), the router brokers a pull: the peer exports the shared
+   chain's pages over the existing PT_KVPAGES frame path, the receiver
+   splices them via `PageAllocator.import_chain`, and the request
+   prefills from the first unshared token.
+
+The failover contract is unchanged: a pull that dies, times out, or
+CRC-trips falls back to local re-prefill from prompt+rng, bit-exact —
+pulls are an optimization, NEVER a correctness dependency. The map's
+depths may overstate the real attach by up to one page (cache_map's
+documented approximation); every consumer here tolerates that because
+`import_chain` dedupes and the engine's own `plan()` re-derives the
+true attach at admission.
+
+This module is pure policy — dataclass knobs plus side-effect-free
+score/plan helpers — so the math is unit-testable without a fleet.
+The wiring (map reads, RPC brokering, counters) lives in
+`serve/router.py`.
+"""
+
+import zlib
+from dataclasses import dataclass
+
+
+@dataclass
+class AffinityPolicy:
+    """Knobs for prefix-affinity placement + peer prefix pull.
+
+    weight           scale on the shared-prefix fraction added to the
+                     placement score (the free-slot cap applies after)
+    staleness_s      ignore a replica's advertised chains older than
+                     this many fleet-clock seconds (None = trust
+                     forever; corpses are dropped by failover anyway)
+    pull             broker peer pulls on a placement miss (False =
+                     placement-only affinity)
+    pull_min_tokens  minimum ADVANTAGE (peer depth minus chosen
+                     replica's depth, tokens) before a pull is worth
+                     brokering; None resolves to 2 x page_size at the
+                     router — shallower wins cost more in frames than
+                     they save in prefill
+    shard_weight     small score nudge toward the prompt's consistent-
+                     hash home replica (CRC of the first KV page of
+                     tokens). Cold prefix families thereby SHARD across
+                     the fleet's aggregate cache instead of herding
+                     onto the tie-break winner and LRU-churning each
+                     other out of one pool; any real observed match
+                     (weight, default 1.0) outbids it, and so does one
+                     free slot of load imbalance — keep it well under
+                     1/n_slots. 0 disables.
+    """
+
+    weight: float = 1.0
+    staleness_s: float = 30.0
+    pull: bool = True
+    pull_min_tokens: int = None
+    shard_weight: float = 0.05
+
+    def __post_init__(self):
+        assert self.weight >= 0.0, "affinity weight must be >= 0"
+        assert self.staleness_s is None or self.staleness_s > 0.0, (
+            "staleness_s must be positive (or None to trust forever)")
+        assert self.pull_min_tokens is None or self.pull_min_tokens > 0, (
+            "pull_min_tokens must be positive (or None for the "
+            "2 x page_size default)")
+        assert self.shard_weight >= 0.0, "shard_weight must be >= 0"
+
+
+def resolve_affinity(affinity):
+    """Normalize the `Router(affinity=)` knob: False/None -> off,
+    True -> defaults, dict -> AffinityPolicy(**dict), an instance
+    passes through."""
+    if affinity is None or affinity is False:
+        return None
+    if affinity is True:
+        return AffinityPolicy()
+    if isinstance(affinity, AffinityPolicy):
+        return affinity
+    if isinstance(affinity, dict):
+        return AffinityPolicy(**affinity)
+    raise TypeError(
+        f"Router(affinity=...) takes bool, dict, or AffinityPolicy, "
+        f"got {type(affinity).__name__}")
+
+
+def affinity_bonus(policy, shared_tokens, prompt_tokens, free_frac):
+    """The placement-score affinity term: `weight * shared/prompt`,
+    capped by the candidate's free-slot fraction (the anti-hotspot
+    trade-off the tentpole specifies — a loaded replica's cache
+    gravity shrinks with its remaining capacity)."""
+    if shared_tokens <= 0 or prompt_tokens <= 0:
+        return 0.0
+    bonus = policy.weight * (shared_tokens / prompt_tokens)
+    return min(bonus, max(0.0, free_frac))
+
+
+def shard_home(policy, prompt, page_size, candidate_ids):
+    """Deterministic cold-start shard: CRC32 of the prompt's first KV
+    page maps every prefix family to a stable home among the (sorted)
+    healthy candidates. Requests sharing a system prompt agree on a
+    home before any replica has ever seen it — the fleet's caches
+    partition the tenant set instead of all competing for the same
+    LRU. Returns a replica id, or None when disabled/no candidates."""
+    if policy.shard_weight <= 0.0 or not candidate_ids:
+        return None
+    head = ",".join(str(int(t)) for t in prompt[:int(page_size)])
+    ids = sorted(candidate_ids, key=str)
+    return ids[zlib.crc32(head.encode()) % len(ids)]
+
+
+def pull_plan(policy, match, chosen_id, page_size):
+    """Decide whether a peer pull is worth brokering for a request
+    placed on `chosen_id`, given the staleness-filtered cache-map
+    `match` ({replica_id: shared tokens}). Returns
+    (src_replica_id, src_tokens, local_tokens) or None.
+
+    The advantage threshold is `pull_min_tokens` (default
+    2 x page_size): below it the frame round-trip costs more than the
+    prefill it saves. Deterministic tie-break on replica id, matching
+    `FleetCacheMap.best_match`."""
+    if not policy.pull:
+        return None
+    local = int(match.get(chosen_id, 0))
+    best_rid, best = None, local
+    for rid in sorted(match, key=str):
+        if rid != chosen_id and int(match[rid]) > best:
+            best_rid, best = rid, int(match[rid])
+    if best_rid is None:
+        return None
+    min_tok = policy.pull_min_tokens
+    if min_tok is None:
+        min_tok = 2 * int(page_size)
+    if best - local < max(int(min_tok), 1):
+        return None
+    return best_rid, best, local

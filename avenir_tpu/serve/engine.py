@@ -1285,6 +1285,49 @@ class Engine:
         out, self._page_exports = self._page_exports, []
         return out
 
+    def export_chain(self, token_pages, n_prefix=0):
+        """Pull-SOURCE side of the fleet KV CDN (ISSUE 17): gather the
+        KV of the registered chain matching `token_pages` (full-page
+        token lists from ROOT), skipping the first `n_prefix` pages the
+        receiver already holds. Returns an export record in the
+        `take_page_exports` shape (eng_rid -1: pulls are request-less),
+        or None when nothing beyond the receiver's own prefix survives
+        locally — the chain was evicted since the map advertised it,
+        and the router just falls back to local prefill.
+
+        The gather walks the allocator's LIVE chain (not the advertised
+        summary), so a stale or overstated map entry degrades to a
+        shorter — still exact — export, never a wrong one."""
+        assert self._paged is not None, "chain export needs kv_impl='paged'"
+        pages = self._paged.alloc.lookup_chain(token_pages)
+        n = len(pages)
+        n_prefix = int(n_prefix)
+        if n <= n_prefix:
+            return None
+        # pad the gather index to a power-of-2 bucket (same rule as the
+        # import scatter) so XLA compiles one gather per bucket, not
+        # one per chain length — page 0 repeats as harmless filler and
+        # the slice below drops it
+        from avenir_tpu.infer.decode import prompt_bucket
+
+        L = n - n_prefix
+        width = prompt_bucket(L, self.max_pages_per_seq, floor=1)
+        phys = np.zeros((width,), np.int32)
+        phys[:L] = pages[n_prefix:]
+        if self.kv_dtype == "int8":
+            arrays = [np.asarray(self.pool.k.data[:, phys])[:, :L],
+                      np.asarray(self.pool.k.scale[:, phys])[:, :L],
+                      np.asarray(self.pool.v.data[:, phys])[:, :L],
+                      np.asarray(self.pool.v.scale[:, phys])[:, :L]]
+        else:
+            arrays = [np.asarray(self.pool.k[:, phys])[:, :L],
+                      np.asarray(self.pool.v[:, phys])[:, :L]]
+        self._paged.alloc.pages_exported += n - n_prefix
+        self._reg.counter("kv_pages_exported").add(n - n_prefix)
+        tokens = [[int(t) for t in token_pages[i]] for i in range(n)]
+        return {"eng_rid": -1, "tokens": tokens, "n_prefix": n_prefix,
+                "kv_dtype": self.kv_dtype, "arrays": arrays}
+
     def import_kv_pages(self, tokens, arrays, kv_dtype="bf16",
                         n_prefix=0):
         """Splice transferred KV pages into this engine's pool +

@@ -401,6 +401,39 @@ class PageAllocator:
             parent = page
         return out
 
+    def lookup_chain(self, token_pages):
+        """Walk the registered prefix chain along `token_pages` (FULL
+        page_size-token pages in chain order from ROOT) and return the
+        physical pages of the longest registered prefix — the pull-
+        SOURCE side of `import_chain` (ISSUE 17 KV CDN). A partial walk
+        is a valid answer: the map that advertised this chain is a
+        bounded, possibly stale summary, and eviction may have raced
+        the pull; the caller exports what survives and the receiver's
+        prefill recomputes the rest (exactness never depends on it).
+
+        Matched nodes get a hit + recency touch (and an LRU
+        `move_to_end` for cached ref-0 nodes): a fleet pull IS reuse,
+        and the LRU must not evict a chain peers are actively pulling."""
+        out = []
+        parent = ROOT
+        for toks in token_pages:
+            toks = tuple(int(t) for t in toks)
+            if len(toks) != self.page_size:
+                break  # only FULL pages have chain identity
+            page = self._children.get(parent, {}).get(toks)
+            if page is None:
+                break
+            meta = self._meta.get(page)
+            if meta is not None:
+                meta[0] += 1
+                meta[1] = self._tick
+                self._chains_dirty = True
+            if page in self._evictable:
+                self._evictable.move_to_end(page)
+            out.append(page)
+            parent = page
+        return out
+
     def register(self, rid, slot_idx, tokens):
         """Register table entry `slot_idx` — a page now fully covered
         by prompt tokens — as a prefix-chain node under `rid`'s current

@@ -99,6 +99,7 @@ OP_TIMEOUT_S = {
     # megabytes, not a control message — so they get the submit budget
     "fetch_pages": 60.0,
     "import_pages": 60.0,
+    "pull_chain": 60.0,
     "chains": 10.0,
 }
 IDEMPOTENT_OPS = frozenset({"ping"})
@@ -542,6 +543,38 @@ class ProcReplica(ReplicaHealth):
             self._die(e)
             raise ReplicaGone(str(e)) from e
         return int(reply.get("written", 0)), nbytes
+
+    def export_chain(self, token_pages, n_prefix=0):
+        """Pull-SOURCE surface of the fleet KV CDN (ISSUE 17): ask the
+        worker for the live KV of the registered chain matching
+        `token_pages`, delivered as one PT_KVPAGES tensor frame.
+        Returns an export record (the take_page_exports shape) or None
+        when the worker no longer holds anything past the receiver's
+        prefix. A dead pipe, timeout, or CRC trip is replica death like
+        any other RPC failure — the broker's fallback contract (local
+        re-prefill) makes that safe."""
+        msg = {"op": "pull_chain",
+               "tokens": [[int(t) for t in p] for p in token_pages],
+               "n_prefix": int(n_prefix)}
+        try:
+            reply = self._rpc(msg, timeout_s=OP_TIMEOUT_S["pull_chain"])
+        except FrameTimeout as e:
+            self._die(e, counter="rpc_timeouts")
+            raise ReplicaGone(str(e)) from e
+        except FrameCRCError as e:
+            self._die(e, counter="frame_crc_errors")
+            raise ReplicaGone(str(e)) from e
+        except (FrameError, WorkerOpError, OSError, ValueError) as e:
+            self._die(e)
+            raise ReplicaGone(str(e)) from e
+        rec = reply.get("record")
+        if not rec:
+            return None
+        return {"eng_rid": int(rec.get("eng_rid", -1)),
+                "tokens": rec["tokens"],
+                "n_prefix": int(rec.get("n_prefix", 0)),
+                "kv_dtype": rec["kv_dtype"],
+                "arrays": list(reply.get("arrays") or [])}
 
     def _read_reply(self, *, timeout_s):
         """Read until the reply matching the current seq (bounded):

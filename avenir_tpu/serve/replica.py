@@ -233,6 +233,17 @@ class Replica(ReplicaHealth):
             off += n
         return written, len(payload)
 
+    def export_chain(self, token_pages, n_prefix=0):
+        """Pull-SOURCE surface of the fleet KV CDN (ISSUE 17): gather
+        the live KV of the registered chain matching `token_pages`
+        (export-record shape, or None when the chain was evicted since
+        the map advertised it). A dead replica exports nothing — raise
+        ReplicaGone so the router's pull broker takes the same
+        src-death fallback path as the process backend."""
+        if self.state == DEAD:
+            raise ReplicaGone(f"replica {self.replica_id} is dead")
+        return self.engine.export_chain(token_pages, n_prefix=n_prefix)
+
     # -- capacity surface the router routes on --
 
     @property

@@ -51,6 +51,8 @@ from typing import Optional, Tuple
 import jax
 
 from avenir_tpu.obs import NullSink, get_registry
+from avenir_tpu.serve.affinity import affinity_bonus, pull_plan, \
+    resolve_affinity, shard_home
 from avenir_tpu.serve.cache_map import FleetCacheMap
 from avenir_tpu.serve.engine import FinishedRequest
 from avenir_tpu.serve.replica import (
@@ -85,6 +87,10 @@ class RoutedRequest:
     # class queue depth the moment this request was enqueued — the
     # wait predictor's feature (ISSUE 12; None when tracing is off)
     depth_at_submit: Optional[int] = None
+    # shared-prefix tokens a peer pull landed on the CHOSEN replica for
+    # THIS dispatch (ISSUE 17) — reset per decision, so the reuse audit
+    # counts pulled tokens as reused (they were shipped, not recomputed)
+    pulled_tokens: int = 0
 
     def expired(self, now):
         return (self.deadline_ms is not None
@@ -126,7 +132,7 @@ class Router:
                  respawn_policy=None, max_respawns=5, proc_kwargs=None,
                  engine_kwargs=None, tracer=None, draft_model=None,
                  n_prefill=0, disagg_min_prompt=None, anomaly=None,
-                 cache_telescope=False):
+                 cache_telescope=False, affinity=False):
         """`weights`: dispatch shares per priority class (default
         interactive 4 : batch 1). `queue_limits`: max queued per class
         before shedding (default 16/64 x fleet slots). `clock` is shared
@@ -204,6 +210,24 @@ class Router:
         default top-K of 32 or an int to set the per-replica summary
         cap (heartbeat growth is bounded at ~60 bytes/node).
 
+        `affinity` (ISSUE 17, the fleet KV CDN): arms prefix-affinity
+        routing + peer prefix pull on top of the telescope's content
+        view. Placement: each candidate's score gains
+        `weight * shared_prefix_frac`, capped by its free-slot fraction
+        (serve/affinity.py — a hot prefix cannot hotspot a loaded
+        replica). Miss path: when the chosen replica misses but a peer
+        advertises a chain deeper by >= `pull_min_tokens` (default
+        2 x page_size), the router brokers a pull — the peer exports
+        the shared chain over the PT_KVPAGES frame path, the receiver
+        splices it via `import_chain`, and prefill starts at the first
+        unshared token. A pull that dies, times out, or CRC-trips
+        falls back to local re-prefill from prompt+rng, bit-exact —
+        pulls are an optimization, NEVER a correctness dependency.
+        Pass True for defaults, a dict of AffinityPolicy fields, or an
+        AffinityPolicy. Requires `cache_telescope` armed (fail-loud:
+        the map IS the affinity signal) and paged KV. False (the
+        default) keeps routing affinity-blind.
+
         `anomaly` (ISSUE 14): an obs/anomaly.py AnomalyEngine — the
         fleet health tier. Each step the router feeds it replica step
         walls, heartbeat age, oldest-queued wait, TTFT/TPOT of finished
@@ -243,6 +267,26 @@ class Router:
             self._reg.counter("prefix_tokens_reused")
             self._reg.counter("prefix_tokens_missed")
             self._reg.counter("prefix_tokens_cold")
+        # fleet KV CDN (ISSUE 17): prefix-affinity placement + peer pull
+        self._affinity = resolve_affinity(affinity)
+        if self._affinity is not None:
+            assert self._cache_map is not None, (
+                "Router(affinity=...) routes on the fleet cache map — "
+                "arm cache_telescope=True (the content view is the "
+                "affinity signal; placement without it would be blind "
+                "guessing, so this fails loud)")
+            assert self._engine_kwargs.get("kv_impl") == "paged", (
+                "affinity routes on prefix-chain identity and pulls "
+                "ship KV PAGES — pass engine_kwargs={'kv_impl': "
+                "'paged', ...}")
+            assert self._engine_kwargs.get("prefix_sharing", True), (
+                "peer pulls splice chains through prefix sharing — "
+                "prefix_sharing must stay on")
+            # pre-create so a zero-pull fleet still exports all four
+            self._reg.counter("affinity_hits")
+            self._reg.counter("prefix_pull_pages")
+            self._reg.counter("prefix_pull_bytes")
+            self._reg.counter("prefix_pull_fallbacks")
         self._draft_model = draft_model
         self._spec = None
         self._pk = {}
@@ -950,13 +994,25 @@ class Router:
                 and r.replica_id not in self._retiring
                 and self._is_prefill(r) == prefill]
 
-    def _pick_replica(self, req, now):
+    def _pick_replica(self, req, now, match=None):
         """SLO-aware placement: free-slot fraction, minus any engine
         queue backlog, minus — for deadline-carrying requests — the
         replica's step time scaled by the inverse of the remaining
         slack (a tight deadline prefers the fastest replica; an
         unhurried one just fills the emptiest). Deterministic tiebreak
         on replica id.
+
+        Affinity (ISSUE 17): when `match` (the staleness-filtered
+        cache-map view, {replica_id: shared tokens}) is passed, each
+        candidate gains `weight * shared/prompt` capped by its OWN
+        free-slot fraction (serve/affinity.py) — cache gravity decays
+        exactly as fast as capacity does, so a hot prefix spills to
+        the next replica instead of hotspotting one. Every candidate is
+        also scored against the prompt's consistent-hash home
+        (`shard_weight` nudge): cold prefix families shard across the
+        fleet's aggregate cache instead of herding onto the tie-break
+        winner. The disagg class filter still dominates: affinity
+        only reorders within the eligible class.
 
         Disagg (ISSUE 13): prompt length routes the CLASS — a long
         prompt (>= disagg_min_prompt, i.e. more than one chunk of
@@ -980,12 +1036,24 @@ class Router:
         if req.deadline_ms is not None:
             slack_s = max(req.deadline_ms / 1e3 - (now - req.submit_t),
                           1e-3)
+        home = None
+        if self._affinity is not None:
+            home = shard_home(
+                self._affinity, req.prompt,
+                int(self._engine_kwargs.get("page_size", 16)),
+                [r.replica_id for r in cands])
 
         def score(r):
             # dispatchable fraction already nets out the engine-queue
             # backlog (replica.dispatchable_slots), so occupancy and
             # queue depth are both in this one term
             s = r.dispatchable_slots / r.n_slots
+            if match:
+                s += affinity_bonus(
+                    self._affinity, match.get(r.replica_id, 0),
+                    len(req.prompt), r.dispatchable_slots / r.n_slots)
+            if r.replica_id == home:
+                s += self._affinity.shard_weight
             if slack_s is not None:
                 s -= r.median_step_secs() / slack_s
             return (s, -r.replica_id)
@@ -998,7 +1066,9 @@ class Router:
             if c is None:
                 return
             req = self._queues[c].popleft()
-            rep = self._pick_replica(req, now)
+            m = (self._affinity_match(req)
+                 if self._affinity is not None else None)
+            rep = self._pick_replica(req, now, match=m)
             if rep is None:
                 # free slots exist only on the wrong disagg class this
                 # tick (e.g. decode slots open while the head wants the
@@ -1007,6 +1077,19 @@ class Router:
                 # too-long-head admission block
                 self._queues[c].appendleft(req)
                 return
+            if m is not None:
+                req.pulled_tokens = 0  # per-DECISION: a failover's new
+                #                        replica holds no pulled pages
+                if m.get(rep.replica_id, 0) > 0:
+                    self._reg.counter("affinity_hits").add(1)
+                if not self._maybe_pull(req, rep, m):
+                    # the CHOSEN replica died under the pull import: the
+                    # request never landed — same recovery as a death
+                    # under submit (front of queue, fail the corpse
+                    # over, re-pick next pass)
+                    self._queues[req.priority].appendleft(req)
+                    self._failover(rep)
+                    continue
             try:
                 eng_rid = rep.engine.submit(
                     req.prompt, max_new_tokens=req.max_new_tokens,
@@ -1053,11 +1136,16 @@ class Router:
         existed. Audits the dispatch DECISION: a failover or disagg
         handoff re-dispatch is a new decision and is re-audited, so
         the partition identity is per-dispatch, not per-admit.
-        Observability only — nothing here feeds placement (PR 17)."""
+
+        With the KV CDN armed (ISSUE 17) a successful peer pull counts
+        its shipped tokens as REUSED — they were transferred, not
+        recomputed, and `missed` must keep meaning "the fleet is about
+        to redo work it already has". The residual missed fraction is
+        exactly what affinity routing could not reclaim."""
         cm = self._cache_map
         m = cm.match(req.prompt)
         n = len(req.prompt)
-        reused = m.get(rep.replica_id, 0)
+        reused = min(max(m.get(rep.replica_id, 0), req.pulled_tokens), n)
         best_rid, best = rep.replica_id, reused
         for rid in sorted(m, key=str):
             if m[rid] > best:
@@ -1080,6 +1168,96 @@ class Router:
                 replica=rep.replica_id, best_replica=best_rid,
                 reused=reused, missed=missed, cold=cold,
                 est_ms_saved=round(missed * cost, 3))
+
+    # ---- fleet KV CDN: affinity placement + peer pull (ISSUE 17) ----
+
+    def _affinity_match(self, req):
+        """The staleness-filtered cache-map view for placement:
+        {replica_id: deepest shared-chain tokens}, dropping zero
+        matches and replicas whose advertised summary is older than
+        the policy's `staleness_s` (a stale advert routes traffic at a
+        cache that may be long evicted — better to fall back to pure
+        load placement than to chase ghosts)."""
+        pol, cm = self._affinity, self._cache_map
+        now = self._clock()
+        out = {}
+        for rid, n in cm.match(req.prompt).items():
+            if n <= 0:
+                continue
+            st = cm.staleness_s(rid, now=now)
+            if (pol.staleness_s is not None and st is not None
+                    and st > pol.staleness_s):
+                continue
+            out[rid] = n
+        return out
+
+    def _maybe_pull(self, req, rep, match):
+        """Peer prefix pull, the KV CDN miss path: when a peer
+        advertises a chain materially deeper than the chosen replica's
+        (`pull_plan` threshold), broker it — the peer exports the
+        shared chain's surviving pages (one PT_KVPAGES frame), the
+        chosen replica splices them via `import_chain`, and the
+        upcoming submit's plan() attaches them so prefill starts at
+        the first unshared token.
+
+        Returns False ONLY when the CHOSEN replica died under the
+        import (the caller requeues + fails it over, exactly the
+        death-under-submit path). Every other failure — source died
+        mid-transfer, source evicted the chain, frame CRC trip, RPC
+        timeout — counts a `prefix_pull_fallbacks`, emits the
+        `prefix_pull` trace outcome, and returns True: the request
+        proceeds to local re-prefill from prompt+rng, bit-exact. Pulls
+        are an optimization, never a correctness dependency."""
+        ps = int(self._engine_kwargs.get("page_size", 16))
+        plan = pull_plan(self._affinity, match, rep.replica_id, ps)
+        if plan is None:
+            return True
+        src_rid, best, local = plan
+        fallbacks = self._reg.counter("prefix_pull_fallbacks")
+
+        def trace(outcome, pages=0):
+            if self.tracer is not None:
+                self.tracer.emit(
+                    req.rid, "prefix_pull", t=self._clock(),
+                    src=src_rid, dst=rep.replica_id, pages=pages,
+                    depth=best, outcome=outcome)
+
+        src = next((r for r in self.replicas
+                    if r.replica_id == src_rid and r.state == HEALTHY),
+                   None)
+        if src is None:
+            # advertised-then-retired/died between map refresh and now
+            fallbacks.add(1)
+            trace("src_gone")
+            return True
+        token_pages = [req.prompt[i * ps:(i + 1) * ps]
+                       for i in range(best // ps)]
+        try:
+            rec = src.export_chain(token_pages, n_prefix=local // ps)
+        except ReplicaGone:
+            # source died mid-transfer: fail IT over; the request's
+            # own placement is intact — local prefill covers it
+            fallbacks.add(1)
+            trace("src_dead")
+            self._failover(src)
+            return True
+        if rec is None:
+            # the chain was evicted since the map advertised it — the
+            # allocator walk found nothing past the receiver's prefix
+            fallbacks.add(1)
+            trace("src_evicted")
+            return True
+        try:
+            written, nbytes = rep.import_pages([rec])
+        except ReplicaGone:
+            fallbacks.add(1)
+            trace("dst_dead")
+            return False
+        self._reg.counter("prefix_pull_pages").add(written)
+        self._reg.counter("prefix_pull_bytes").add(nbytes)
+        req.pulled_tokens = (local // ps + written) * ps
+        trace("ok", pages=written)
+        return True
 
     # ---- disaggregated page transfer + handoff (ISSUE 13) ----
 

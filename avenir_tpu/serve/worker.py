@@ -294,6 +294,26 @@ def _serve(stream):
                                     for r in recs]}
                 flat = [a for r in recs for a in r["arrays"]]
                 stream.write((meta, flat), ptype=PT_KVPAGES)
+            elif op == "pull_chain":
+                # fleet KV CDN pull source (ISSUE 17): gather the
+                # requested chain's surviving pages into ONE PT_KVPAGES
+                # tensor frame. record=None means the chain was evicted
+                # since the map advertised it — the router falls back
+                # to local prefill (pulls are never a correctness
+                # dependency)
+                from avenir_tpu.serve.frames import PT_KVPAGES
+
+                rec = engine.export_chain(
+                    req["tokens"], n_prefix=int(req.get("n_prefix", 0)))
+                meta = {"ok": True, "seq": seq, "record": None}
+                flat = []
+                if rec is not None:
+                    meta["record"] = {"eng_rid": rec["eng_rid"],
+                                      "tokens": rec["tokens"],
+                                      "n_prefix": rec["n_prefix"],
+                                      "kv_dtype": rec["kv_dtype"]}
+                    flat = list(rec["arrays"])
+                stream.write((meta, flat), ptype=PT_KVPAGES)
             elif op == "import_pages":
                 # inbound PT_KVPAGES frame: splice the chains into the
                 # local allocator + pool (decode-class side)

@@ -1,0 +1,465 @@
+"""Fleet KV CDN tests (avenir_tpu/serve/affinity.py + the router/
+engine/proc wiring, ISSUE 17): the policy math is exact (pure, fast),
+affinity placement routes shared prefixes to the replica that holds
+them, peer pulls ship real KV pages and keep bit parity, and the
+fallback contract holds under every pull failure mode — a SIGKILLed
+pull source mid-transfer and a CRC-tripped PT_KVPAGES frame both
+degrade to local re-prefill with outputs bit-identical to one-shot
+generate_cached, zero requests lost, counters telling the truth.
+
+Budget notes: one module-scoped GPT; shared-prefix prompts stay in one
+power-of-2 bucket (len 25..31) and one MAX_NEW so each engine pays one
+prefill-chunk ladder + one decode compile; process cases are slow
+(worker processes pay a jax import + their own compiles).
+"""
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import nnx
+
+from avenir_tpu.infer.decode import generate_cached
+from avenir_tpu.models.gpt import GPT, GPTConfig
+from avenir_tpu.obs import MetricsRegistry
+from avenir_tpu.obs.trace import Tracer
+from avenir_tpu.serve import PageAllocator, Router
+from avenir_tpu.serve.affinity import (
+    AffinityPolicy,
+    affinity_bonus,
+    pull_plan,
+    resolve_affinity,
+)
+from avenir_tpu.utils.faults import FaultInjector, set_injector
+
+GPT_TINY = GPTConfig(block_size=64, vocab_size=64, n_layer=1, n_head=2,
+                     n_embd=32, dropout=0.0, bias=True, attn_impl="xla")
+PAGED_KW = dict(kv_impl="paged", page_size=8, n_pages=48,
+                prefill_chunk=16)
+MAX_NEW = 5
+PS = PAGED_KW["page_size"]
+
+
+@pytest.fixture(scope="module")
+def model():
+    return GPT(GPT_TINY, rngs=nnx.Rngs(0))
+
+
+def _mk_shared_requests(model, rng, n, prefix, key_base=7000):
+    """n requests sharing `prefix` (+ short random tails — one prompt
+    bucket) with one-shot reference streams; explicit rng keys pin the
+    parity oracle across placements, pulls, and failovers."""
+    reqs = []
+    for i in range(n):
+        tail = [int(t) for t in
+                rng.integers(0, 64, int(rng.integers(1, 8)))]
+        prompt = list(prefix) + tail
+        key = jax.random.key(key_base + i)
+        y = np.asarray(generate_cached(
+            model, key, jnp.asarray(prompt, jnp.int32)[None], MAX_NEW,
+            temperature=1.0, top_k=8))[0]
+        reqs.append((dict(prompt=prompt, max_new_tokens=MAX_NEW,
+                          temperature=1.0, top_k=8, rng=key),
+                     [int(t) for t in y]))
+    return reqs
+
+
+def _prefix(seed, n_tokens=3 * PS):
+    rng = np.random.default_rng(seed)
+    return [int(t) for t in rng.integers(0, 64, n_tokens)]
+
+
+def _assert_all_parity(done, refs):
+    assert len(done) == len(refs)
+    for f in done:
+        assert f.finish_reason == "length", f.finish_reason
+        assert f.tokens == refs[f.req_id], (
+            f"request {f.req_id} diverged:\n ref {refs[f.req_id]}\n "
+            f"got {f.tokens}")
+
+
+# ---------------------------------------------------------------------
+# 1. policy math (pure, no fleet)
+# ---------------------------------------------------------------------
+
+
+def test_resolve_affinity_forms():
+    assert resolve_affinity(False) is None
+    assert resolve_affinity(None) is None
+    pol = resolve_affinity(True)
+    assert isinstance(pol, AffinityPolicy) and pol.pull
+    pol = resolve_affinity({"weight": 0.5, "pull": False})
+    assert pol.weight == 0.5 and not pol.pull
+    assert resolve_affinity(pol) is pol
+    with pytest.raises(TypeError):
+        resolve_affinity(3)
+    with pytest.raises(AssertionError):
+        AffinityPolicy(weight=-1.0)
+    with pytest.raises(AssertionError):
+        AffinityPolicy(staleness_s=0.0)
+    with pytest.raises(AssertionError):
+        AffinityPolicy(pull_min_tokens=0)
+
+
+def test_affinity_bonus_capped_by_free_fraction():
+    pol = AffinityPolicy(weight=1.0)
+    # full shared prefix on an empty replica: the full weight
+    assert affinity_bonus(pol, 32, 32, 1.0) == 1.0
+    # the free-slot cap: a loaded replica's cache gravity shrinks
+    assert affinity_bonus(pol, 32, 32, 0.25) == 0.25
+    assert affinity_bonus(pol, 32, 32, 0.0) == 0.0
+    # partial share scales linearly below the cap
+    assert affinity_bonus(pol, 8, 32, 1.0) == pytest.approx(0.25)
+    # no share, no bonus — and never negative
+    assert affinity_bonus(pol, 0, 32, 1.0) == 0.0
+    assert affinity_bonus(pol, 8, 32, -0.5) == 0.0
+    assert affinity_bonus(AffinityPolicy(weight=2.0), 8, 32, 1.0) \
+        == pytest.approx(0.5)
+
+
+def test_shard_home_is_stable_and_spreads():
+    from avenir_tpu.serve.affinity import shard_home
+
+    pol = AffinityPolicy()
+    prompts = [[t] * 16 + [99] for t in range(32)]
+    homes = [shard_home(pol, p, 16, [0, 1, 2]) for p in prompts]
+    # deterministic: same first page -> same home, tail irrelevant
+    assert homes == [shard_home(pol, p[:16] + [7], 16, [0, 1, 2])
+                     for p in prompts]
+    # spreads: 32 distinct prefix families do not herd on one replica
+    assert len(set(homes)) == 3
+    # candidate-set dependent, still deterministic after a death
+    assert all(shard_home(pol, p, 16, [0, 2]) in (0, 2)
+               for p in prompts)
+    assert shard_home(pol, prompts[0], 16, []) is None
+    assert shard_home(AffinityPolicy(shard_weight=0.0), prompts[0], 16,
+                      [0, 1]) is None
+    with pytest.raises(AssertionError):
+        AffinityPolicy(shard_weight=-0.1)
+
+
+def test_pull_plan_threshold_and_tiebreak():
+    pol = AffinityPolicy()  # pull_min_tokens None -> 2 x page_size
+    # peer 24 tokens deeper than chosen's 0, threshold 16: pull from 1
+    assert pull_plan(pol, {0: 0, 1: 24}, 0, 8) == (1, 24, 0)
+    # advantage below threshold: no pull
+    assert pull_plan(pol, {0: 16, 1: 24}, 0, 8) is None
+    # chosen already fleet-best: no pull
+    assert pull_plan(pol, {0: 24, 1: 8}, 0, 8) is None
+    # local anchors ride the plan: pull only the delta beyond 8
+    assert pull_plan(pol, {0: 8, 1: 32}, 0, 8) == (1, 32, 8)
+    # deterministic tie-break on replica id (str sort, cache_map rule)
+    assert pull_plan(pol, {1: 24, 2: 24}, 0, 8) == (1, 24, 0)
+    # pull disabled -> placement-only affinity
+    assert pull_plan(AffinityPolicy(pull=False), {0: 0, 1: 64}, 0, 8) \
+        is None
+    # explicit threshold overrides the page-size default
+    tight = AffinityPolicy(pull_min_tokens=25)
+    assert pull_plan(tight, {0: 0, 1: 24}, 0, 8) is None
+    assert pull_plan(tight, {0: 0, 1: 32}, 0, 8) == (1, 32, 0)
+
+
+def test_lookup_chain_walks_registered_prefix():
+    a = PageAllocator(n_pages=8, page_size=4, prefix_sharing=True)
+    chain = [[1, 2, 3, 4], [5, 6, 7, 8], [9, 10, 11, 12]]
+    pairs = a.import_chain(chain)
+    assert [is_new for _, is_new in pairs] == [True] * 3
+    pages = [p for p, _ in pairs]
+    assert a.lookup_chain(chain) == pages
+    # partial walk: diverging tail stops the match (a valid answer)
+    assert a.lookup_chain(chain[:2] + [[0, 0, 0, 0]]) == pages[:2]
+    assert a.lookup_chain([[0, 0, 0, 0]]) == []
+    # a short page has no chain identity
+    assert a.lookup_chain([[1, 2, 3]]) == []
+    # the walk touched hits + recency (pull reuse feeds the summary)
+    assert a._meta[pages[0]][0] > 0
+
+
+def test_affinity_requires_telescope_and_paged(model):
+    with pytest.raises(AssertionError, match="cache_telescope"):
+        Router(model, n_replicas=2, affinity=True,
+               engine_kwargs=dict(PAGED_KW))
+    with pytest.raises(AssertionError, match="paged"):
+        Router(model, n_replicas=2, affinity=True, cache_telescope=True)
+
+
+# ---------------------------------------------------------------------
+# 2. inproc fleet: placement, pulls, parity
+# ---------------------------------------------------------------------
+
+
+def test_affinity_places_on_warm_replica(model):
+    """A second request sharing the first's prefix routes to the
+    replica already holding the chain — and the audit now counts those
+    tokens reused instead of missed."""
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=2, n_slots=2, registry=reg,
+                    seed=0, cache_telescope=True, affinity=True,
+                    engine_kwargs=dict(PAGED_KW))
+    prefix = _prefix(0)
+    reqs = _mk_shared_requests(model, np.random.default_rng(1), 3,
+                               prefix)
+    done = []
+    refs = {}
+    for kw, ref in reqs:
+        refs[router.submit(**kw)] = ref
+        done.extend(router.drain())  # serialize: each placement sees
+        #                              the previous request's chain
+    assert len(done) == len(reqs)
+    for f in done:
+        assert f.finish_reason == "length"
+        assert f.tokens == refs[f.req_id], f"request {f.req_id} diverged"
+    assert len({f.replica for f in done}) == 1  # cache gravity held
+    snap = reg.snapshot()["counters"]
+    assert snap["affinity_hits"] >= 2, snap.get("affinity_hits")
+    # all three landed on ONE replica (cache gravity) with reuse
+    assert snap["prefix_tokens_reused"] >= 2 * len(prefix)
+    assert snap.get("prefix_pull_fallbacks", 0) == 0
+    router.close()
+
+
+def test_peer_pull_ships_pages_with_parity(model):
+    """The miss path: the warm replica is out of slots, so placement
+    lands on the cold one and the router brokers a pull — real pages
+    move, prefill starts beyond them, output stays bit-identical."""
+    reg = MetricsRegistry()
+    tracer = Tracer(capacity=2048)
+    router = Router(model, n_replicas=2, n_slots=2, registry=reg,
+                    seed=0, cache_telescope=True, affinity=True,
+                    tracer=tracer, engine_kwargs=dict(PAGED_KW))
+    prefix = _prefix(2)
+    reqs = _mk_shared_requests(model, np.random.default_rng(3), 4,
+                               prefix, key_base=7100)
+    refs = {}
+    done = []
+    # request 0 primes a replica with the chain
+    kw, ref = reqs[0]
+    refs[router.submit(**kw)] = ref
+    done.extend(router.drain())
+    warm = max(router._cache_map.match(prefix).items(),
+               key=lambda kv: kv[1])[0]
+    # two long-running requests fill the warm replica's slots
+    for kw, _ in reqs[1:3]:
+        long_kw = dict(kw, max_new_tokens=30)
+        rid = router.submit(**long_kw)
+        key = long_kw["rng"]
+        refs[rid] = [int(t) for t in np.asarray(generate_cached(
+            model, key, jnp.asarray(long_kw["prompt"], jnp.int32)[None],
+            30, temperature=1.0, top_k=8))[0]]
+    router.step()
+    warm_rep = next(r for r in router.replicas if r.replica_id == warm)
+    assert warm_rep.dispatchable_slots == 0
+    # the shared-prefix request must go COLD -> pull brokered
+    kw, ref = reqs[3]
+    refs[router.submit(**kw)] = ref
+    done.extend(router.drain())
+    assert len(done) == len(refs)
+    for f in done:
+        assert f.finish_reason == "length"
+        assert f.tokens == refs[f.req_id], f"request {f.req_id} diverged"
+    snap = reg.snapshot()["counters"]
+    assert snap["prefix_pull_pages"] >= len(prefix) // PS
+    assert snap["prefix_pull_bytes"] > 0
+    assert snap.get("prefix_pull_fallbacks", 0) == 0
+    pulls = [e for e in tracer.events() if e["ev"] == "prefix_pull"]
+    assert len(pulls) == 1 and pulls[0]["outcome"] == "ok"
+    assert pulls[0]["src"] == warm and pulls[0]["dst"] != warm
+    assert pulls[0]["pages"] == snap["prefix_pull_pages"]
+    router.close()
+
+
+def test_randomized_parity_oracle_with_death_inproc(model):
+    """The acceptance oracle, inproc half: randomized multi-tenant
+    arrivals with affinity+pull on and a replica killed mid-run —
+    every completed stream is bit-identical to one-shot generation."""
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=3, n_slots=2, registry=reg,
+                    seed=0, cache_telescope=True, affinity=True,
+                    engine_kwargs=dict(PAGED_KW))
+    rng = np.random.default_rng(9)
+    tenants = [_prefix(10), _prefix(11)]
+    reqs = []
+    for i in range(8):
+        reqs.extend(_mk_shared_requests(
+            model, rng, 1, tenants[i % 2], key_base=7200 + 10 * i))
+    refs = {}
+    submitted = 0
+    # the 3rd fleet step kills whichever replica steps 8th — mid-run,
+    # with shared chains already advertised and pulls in flight
+    prev = set_injector(FaultInjector("serve_step_fail:after=7:n=1"))
+    try:
+        done = []
+        while len(done) < len(reqs):
+            while submitted < len(reqs) and submitted - len(done) < 4:
+                kw, ref = reqs[submitted]
+                refs[router.submit(**kw)] = ref
+                submitted += 1
+            done.extend(router.step())
+    finally:
+        set_injector(prev)
+    assert len(done) == len(reqs)
+    for f in done:
+        assert f.finish_reason == "length"
+        assert f.tokens == refs[f.req_id], f"request {f.req_id} diverged"
+    snap = reg.snapshot()["counters"]
+    assert snap["serve_failovers"] >= 1  # the death actually happened
+    assert snap["affinity_hits"] >= 1
+    router.close()
+
+
+def test_stale_map_entries_are_ignored(model):
+    """An advertised chain older than `staleness_s` stops feeding
+    placement: the affinity match drops it and routing falls back to
+    pure load placement (no hits, no pulls, no errors)."""
+    clock = [0.0]
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=2, n_slots=2, registry=reg,
+                    seed=0, clock=lambda: clock[0],
+                    cache_telescope=True,
+                    affinity={"staleness_s": 5.0},
+                    engine_kwargs=dict(PAGED_KW))
+    prefix = _prefix(4)
+    reqs = _mk_shared_requests(model, np.random.default_rng(5), 1,
+                               prefix, key_base=7300)
+    kw, ref = reqs[0]
+    rid = router.submit(**kw)
+    done = router.drain()
+    assert done[0].req_id == rid and done[0].tokens == ref
+    probe = type("R", (), {"prompt": kw["prompt"]})()
+    assert max(router._affinity_match(probe).values()) >= len(prefix)
+    clock[0] += 60.0  # every advertised summary is now stale
+    assert router._affinity_match(probe) == {}
+    router.close()
+
+
+# ---------------------------------------------------------------------
+# 3. process fleet: the fallback contract (slow — real workers)
+# ---------------------------------------------------------------------
+
+
+def _proc_router(created, model, reg, **kw):
+    router = Router(model, backend="process", registry=reg, seed=0,
+                    cache_telescope=True, affinity=True,
+                    engine_kwargs=dict(PAGED_KW), **kw)
+    created.append(router)
+    return router
+
+
+@pytest.fixture()
+def _close_routers():
+    created = []
+    yield created
+    for router in created:
+        try:
+            router.close()
+        except Exception:
+            pass
+
+
+def _prime_and_occupy(router, model, reqs, refs, done):
+    """Land the chain on one replica, then fill its slots with two
+    long-running requests so the NEXT shared-prefix dispatch must go
+    to the other replica and broker a pull from the warm (busy) one."""
+    kw, ref = reqs[0]
+    refs[router.submit(**kw)] = ref
+    done.extend(router.drain())
+    prefix = kw["prompt"][:3 * PS]
+    warm = max(router._cache_map.match(prefix).items(),
+               key=lambda kv: kv[1])[0]
+    for kw, _ in reqs[1:3]:
+        long_kw = dict(kw, max_new_tokens=30)
+        rid = router.submit(**long_kw)
+        refs[rid] = [int(t) for t in np.asarray(generate_cached(
+            model, long_kw["rng"],
+            jnp.asarray(long_kw["prompt"], jnp.int32)[None],
+            30, temperature=1.0, top_k=8))[0]]
+    for _ in range(2):
+        router.step()
+    warm_rep = next(r for r in router.replicas if r.replica_id == warm)
+    assert warm_rep.dispatchable_slots == 0
+    return warm_rep
+
+
+@pytest.mark.slow
+def test_process_pull_roundtrip_parity(model, _close_routers):
+    """Happy path over REAL worker processes: the pull_chain RPC moves
+    a PT_KVPAGES frame peer->parent->peer and the pulled request's
+    output is bit-identical to one-shot generation."""
+    reg = MetricsRegistry()
+    router = _proc_router(_close_routers, model, reg, n_replicas=2,
+                          n_slots=2)
+    prefix = _prefix(20)
+    reqs = _mk_shared_requests(model, np.random.default_rng(21), 4,
+                               prefix, key_base=7400)
+    refs = {}
+    done = []
+    _prime_and_occupy(router, model, reqs, refs, done)
+    kw, ref = reqs[3]
+    refs[router.submit(**kw)] = ref
+    done.extend(router.drain())
+    _assert_all_parity(done, refs)
+    snap = reg.snapshot()["counters"]
+    assert snap["prefix_pull_pages"] >= len(prefix) // PS
+    assert snap.get("prefix_pull_fallbacks", 0) == 0
+    assert snap.get("serve_failovers", 0) == 0
+
+
+@pytest.mark.slow
+def test_process_pull_source_sigkill_falls_back(model, _close_routers):
+    """The fallback contract, death mode: SIGKILL the pull SOURCE so
+    the pull_chain RPC dies mid-transfer (pipe EOF partway through the
+    tensor frame). The pulled request must complete via local
+    re-prefill, bit-identical; the corpse's own work fails over; the
+    fallback counter tells the truth."""
+    reg = MetricsRegistry()
+    router = _proc_router(_close_routers, model, reg, n_replicas=2,
+                          n_slots=2)
+    prefix = _prefix(22)
+    reqs = _mk_shared_requests(model, np.random.default_rng(23), 4,
+                               prefix, key_base=7500)
+    refs = {}
+    done = []
+    warm_rep = _prime_and_occupy(router, model, reqs, refs, done)
+    os.kill(warm_rep.pid, signal.SIGKILL)
+    kw, ref = reqs[3]
+    refs[router.submit(**kw)] = ref
+    done.extend(router.drain())
+    _assert_all_parity(done, refs)
+    assert warm_rep.state == "dead"
+    snap = reg.snapshot()["counters"]
+    assert snap["prefix_pull_fallbacks"] == 1
+    assert snap["prefix_pull_pages"] == 0  # nothing landed
+    assert snap["serve_failovers"] >= 2    # the corpse's two requests
+
+
+@pytest.mark.slow
+def test_process_pull_frame_corrupt_falls_back(model, _close_routers):
+    """The fallback contract, corruption mode: arm frame_corrupt on
+    the pull source so the PT_KVPAGES pull reply CRC-trips (dispatch
+    runs before replica stepping, so the pull reply IS the armed
+    worker's next frame). CRC is death, never retry: the source dies,
+    the pulled request re-prefills locally bit-identical, and both the
+    CRC and fallback counters record it."""
+    reg = MetricsRegistry()
+    router = _proc_router(_close_routers, model, reg, n_replicas=2,
+                          n_slots=2)
+    prefix = _prefix(24)
+    reqs = _mk_shared_requests(model, np.random.default_rng(25), 4,
+                               prefix, key_base=7600)
+    refs = {}
+    done = []
+    warm_rep = _prime_and_occupy(router, model, reqs, refs, done)
+    warm_rep.arm_fault("frame_corrupt:n=1", seed=0)
+    kw, ref = reqs[3]
+    refs[router.submit(**kw)] = ref
+    done.extend(router.drain())
+    _assert_all_parity(done, refs)
+    assert warm_rep.state == "dead"
+    snap = reg.snapshot()["counters"]
+    assert snap["frame_crc_errors"] == 1
+    assert snap["prefix_pull_fallbacks"] == 1
+    assert snap["prefix_pull_pages"] == 0
+    assert snap["serve_failovers"] >= 2

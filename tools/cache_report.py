@@ -12,19 +12,29 @@ renders what the telescope saw:
 - a per-tenant missed-reuse breakdown from the `missed_reuse` trace
   events (which tenant's prefixes the cache-blind placement scatters).
 
-The router stays AFFINITY-BLIND by design this issue — placement
-maximizes free-slot fraction, ignoring cache content — so a tenant's
-requests land on whichever replica has room and the fleet re-prefills
-prefixes it already holds. That cost is the bench headline:
+The default run stays AFFINITY-BLIND — placement maximizes free-slot
+fraction, ignoring cache content — so a tenant's requests land on
+whichever replica has room and the fleet re-prefills prefixes it
+already holds. That cost is the bench headline:
 
     missed_reuse_frac = prefix_tokens_missed / all dispatched tokens
 
 written to BENCH_cache_obs.json over three seeds and banded in
-PERF_LEDGER.json as the BASELINE the PR 17 cache-affinity router must
-beat (its whole gain is driving this fraction toward zero).
+PERF_LEDGER.json as the BASELINE (the affinity band itself rides
+BENCH_kv_cdn.json, tools/serve_bench.py --sweep --kv_cdn).
+
+`--affinity` (ISSUE 17) re-runs the same workload with the KV CDN
+armed (Router(affinity=True): prefix-affinity placement + peer pulls)
+and renders the affinity-effectiveness section — the prefix-hit depth
+histogram, the pull ledger (src->dst, pages, outcome), and the
+residual missed_reuse partition that remains AFTER affinity routing.
+`--smoke` runs blind + affinity back to back and asserts affinity
+strictly reduces the missed fraction — the tier-1 tripwire a silent
+affinity regression cannot ship past.
 
     python tools/cache_report.py                  # bench, writes JSON
     python tools/cache_report.py --smoke          # tier-1 CI path
+    python tools/cache_report.py --affinity       # KV CDN effectiveness
     python tools/cache_report.py --seed=1 --n_requests=96
 """
 
@@ -59,10 +69,12 @@ def _mk_workload(rng, V, *, n_tenants, prefix_len, n_requests,
 def _run_telescope(seed, *, n_replicas, n_slots, n_tenants, prefix_len,
                    tail_lo, tail_hi, n_requests, n_conc, max_new,
                    page_size, n_pages, prefill_chunk, block_size,
-                   vocab_size=256, n_layer=1, n_embd=32):
-    """One seeded affinity-blind run; returns the telescope's full
-    accounting (counters, per-tenant misses, map view) plus enough to
-    assert the partition identity exactly."""
+                   vocab_size=256, n_layer=1, n_embd=32, affinity=False):
+    """One seeded run — affinity-blind by default, the KV CDN armed
+    with `affinity=True` (ISSUE 17) — returning the telescope's full
+    accounting (counters, per-tenant misses, map view, hit-depth
+    histogram, pull ledger) plus enough to assert the partition
+    identity exactly."""
     from flax import nnx
 
     from avenir_tpu.models.gpt import GPT, GPTConfig
@@ -79,7 +91,7 @@ def _run_telescope(seed, *, n_replicas, n_slots, n_tenants, prefix_len,
     router = Router(
         model, n_replicas=n_replicas, n_slots=n_slots,
         max_seq_len=block_size, registry=reg, seed=seed,
-        tracer=tracer, cache_telescope=True,
+        tracer=tracer, cache_telescope=True, affinity=affinity,
         engine_kwargs={"kv_impl": "paged", "page_size": page_size,
                        "n_pages": n_pages,
                        "prefill_chunk": prefill_chunk})
@@ -108,7 +120,19 @@ def _run_telescope(seed, *, n_replicas, n_slots, n_tenants, prefix_len,
     total = reused + missed + cold
     by_tenant = {}
     est_saved_ms = 0.0
+    hit_hist = {}   # shared-prefix depth (tokens) -> prefix_hit count
+    pulls = []      # the pull ledger (ISSUE 17): one row per broker
     for e in tracer.events():
+        if e["ev"] == "prefix_hit":
+            d = int(e["shared_tokens"])
+            hit_hist[d] = hit_hist.get(d, 0) + 1
+            continue
+        if e["ev"] == "prefix_pull":
+            pulls.append({"src": e["src"], "dst": e["dst"],
+                          "pages": int(e["pages"]),
+                          "depth": int(e["depth"]),
+                          "outcome": e["outcome"]})
+            continue
         if e["ev"] != "missed_reuse":
             continue
         t = tenant_of.get(e["rid"])
@@ -139,6 +163,7 @@ def _run_telescope(seed, *, n_replicas, n_slots, n_tenants, prefix_len,
         [f.finish_reason for f in done])
     return {
         "seed": seed,
+        "affinity": bool(affinity),
         "n_served": len(done),
         "dispatched_tokens": dispatched_tokens,
         "reused": reused, "missed": missed, "cold": cold,
@@ -150,6 +175,12 @@ def _run_telescope(seed, *, n_replicas, n_slots, n_tenants, prefix_len,
         "by_tenant": by_tenant,
         "map": map_view,
         "top_chains": chains[:8],
+        "hit_depth_hist": hit_hist,
+        "pulls": pulls,
+        "affinity_hits": counters.get("affinity_hits", 0.0),
+        "pull_pages": counters.get("prefix_pull_pages", 0.0),
+        "pull_bytes": counters.get("prefix_pull_bytes", 0.0),
+        "pull_fallbacks": counters.get("prefix_pull_fallbacks", 0.0),
     }
 
 
@@ -172,6 +203,33 @@ def _print_run(r):
     for t, agg in sorted(r["by_tenant"].items()):
         print(f"  tenant {t}: {agg['events']} missed-reuse dispatches, "
               f"{agg['missed']} tokens recomputed elsewhere")
+
+
+def _print_affinity(r):
+    """The affinity-effectiveness section (ISSUE 17): what the KV CDN
+    actually bought — hit depths, the pull ledger, and the residual
+    missed_reuse partition affinity routing could not reclaim."""
+    print(f"[cache] affinity effectiveness (seed {r['seed']}):")
+    print(f"  affinity hits: {r['affinity_hits']:.0f} of "
+          f"{r['n_served']} dispatches")
+    if r["hit_depth_hist"]:
+        rows = sorted(r["hit_depth_hist"].items())
+        print("  hit depth histogram: " + "   ".join(
+            f"{d}tok x{c}" for d, c in rows))
+    if r["pulls"]:
+        ok = [p for p in r["pulls"] if p["outcome"] == "ok"]
+        print(f"  pull ledger: {len(ok)}/{len(r['pulls'])} ok, "
+              f"{r['pull_pages']:.0f} pages / "
+              f"{r['pull_bytes'] / 1024:.0f} KiB shipped, "
+              f"{r['pull_fallbacks']:.0f} fallbacks")
+        for p in r["pulls"][:8]:
+            print(f"    r{p['src']} -> r{p['dst']}: {p['pages']} pages "
+                  f"(depth {p['depth']} tok, {p['outcome']})")
+    else:
+        print("  pull ledger: no pulls brokered")
+    print(f"  residual partition: reused {r['reused']:.0f}  "
+          f"missed {r['missed']:.0f}  cold {r['cold']:.0f}  "
+          f"(residual missed frac {r['missed_reuse_frac']:.1%})")
 
 
 def cache_report(args):
@@ -197,7 +255,8 @@ def cache_report(args):
         block_size=int(args.get("block_size", 64 if smoke else 128)),
     )
     if smoke:
-        r = _run_telescope(int(args.get("seed", 0)), **cfg)
+        seed = int(args.get("seed", 0))
+        r = _run_telescope(seed, **cfg)
         _print_run(r)
         # the partition identity: every dispatched prompt token landed
         # in exactly one bucket (no failovers here, so dispatches ==
@@ -209,7 +268,26 @@ def cache_report(args):
         # means the telescope went blind, not that routing got smart
         assert r["missed"] > 0, "no missed reuse observed in smoke"
         assert r["reused"] > 0, "no local reuse observed in smoke"
-        print("[cache] smoke ok: partition exact, misses visible")
+        # the affinity tripwire (ISSUE 17): same workload, KV CDN on —
+        # a silent affinity regression cannot leave this green
+        a = _run_telescope(seed, affinity=True, **cfg)
+        _print_affinity(a)
+        assert a["audited_tokens"] == a["dispatched_tokens"], (
+            a["audited_tokens"], a["dispatched_tokens"])
+        assert a["affinity_hits"] > 0, "affinity never placed on cache"
+        assert a["missed_reuse_frac"] < r["missed_reuse_frac"], (
+            "affinity routing did not reduce missed reuse: "
+            f"{a['missed_reuse_frac']:.3f} vs blind "
+            f"{r['missed_reuse_frac']:.3f}")
+        print("[cache] smoke ok: partition exact, misses visible, "
+              f"affinity cuts missed frac {r['missed_reuse_frac']:.1%} "
+              f"-> {a['missed_reuse_frac']:.1%}")
+        return 0
+    if "affinity" in args:
+        r = _run_telescope(int(args.get("seed", 0)), affinity=True,
+                           **cfg)
+        _print_run(r)
+        _print_affinity(r)
         return 0
     seeds = [int(s) for s in str(args.get("seeds", "0,1,2")).split(",")]
     runs = [_run_telescope(s, **cfg) for s in seeds]

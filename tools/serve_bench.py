@@ -67,6 +67,14 @@ queue wait between --min_replicas and max, every decision a traced
 decision log). `--autoscale_bench` runs the ISSUE 12 acceptance
 sweep — autoscale vs every static fleet size on the seeded diurnal
 shape — and writes BENCH_autoscale.json.
+
+`--kv_cdn` (ISSUE 17) runs the fleet KV-reuse acceptance sweep: N
+tenants with per-tenant system prompts arriving on merged seeded
+Poisson schedules, `Router(affinity=...)` on vs off at equal chips,
+under a page pool deliberately too small for every tenant to stay
+cached everywhere. Writes BENCH_kv_cdn.json (max sustainable
+concurrency frontier + open-loop TTFT p99 probe + the reuse-audit
+missed_reuse_frac the PERF ledger bands).
 """
 
 import math
@@ -441,13 +449,21 @@ class _VirtualFleet:
     TTFT/TPOT is stamped from real measured compute. Router host work
     (dispatch, page transfers, trace absorption) is charged to the
     virtual clock SERIALLY — conservative: it bills the disaggregated
-    topology for every byte it ships."""
+    topology for every byte it ships.
 
-    def __init__(self, tick_floor_s=0.002):
+    `transfer_on_replicas=True` (the KV CDN bench) refines that one
+    charge: KV page export/import wall moves onto the PARTICIPATING
+    replica's own timeline instead of the serial router remainder — a
+    transfer is a source<->dest DMA occupying those chips' bandwidth,
+    not a fleet-wide stall. The serial default stays for the disagg
+    bench (conservative against its transfer-heavy topologies)."""
+
+    def __init__(self, tick_floor_s=0.002, transfer_on_replicas=False):
         self.vt = [0.0]
         self.due = {}
         self.tick_floor_s = float(tick_floor_s)
         self._pass_wall = 0.0
+        self.transfer_on_replicas = bool(transfer_on_replicas)
 
     def clock(self):
         return self.vt[0]
@@ -469,6 +485,24 @@ class _VirtualFleet:
                 return fins
 
             rep.step = gated
+            if not self.transfer_on_replicas:
+                continue
+            for op in ("export_chain", "import_pages"):
+                if not hasattr(rep, op):
+                    continue
+
+                def charged(*a, _o=getattr(rep, op), _rep=rep, **kw):
+                    t0 = time.perf_counter()
+                    try:
+                        return _o(*a, **kw)
+                    finally:
+                        w = time.perf_counter() - t0
+                        self._pass_wall += w
+                        self.due[_rep.replica_id] = max(
+                            self.due.get(_rep.replica_id, 0.0),
+                            self.vt[0]) + w
+
+                setattr(rep, op, charged)
         return router
 
     def step(self, router):
@@ -949,9 +983,352 @@ def autoscale_bench(args):
     return 0 if ok else 1
 
 
+def kv_cdn_bench(args):
+    """BENCH_kv_cdn.json (ISSUE 17 acceptance): multi-tenant shared-
+    prefix workload through `Router(affinity=...)` on/off at EQUAL
+    CHIPS. N tenants each own a system prompt (the shared prefix);
+    per-tenant Poisson schedules (gen_arrivals) merge into one global
+    arrival order, so tenants interleave the way N independent
+    customers actually hit a fleet. The page pool is sized so ONE
+    replica cannot hold every tenant's prefix chain at once — blind
+    routing spreads each tenant over all replicas and the LRU churns
+    prefixes out from under their own traffic, while affinity
+    concentrates each tenant where its chain already lives and peer
+    pulls ship the stragglers (the KV CDN).
+
+    Two headline cells, both at identical fleet shape:
+      frontier  closed-loop binary search for max sustainable
+                concurrency at the TTFT/TPOT SLO (same search as the
+                paged/disagg sweeps), per affinity setting
+      probe     OPEN-loop merged-Poisson arrivals at --rate on the
+                virtual clock, per affinity setting — TTFT p99 under
+                real interleaved arrivals, plus the reuse-audit
+                partition (missed_reuse_frac) the PERF ledger bands
+
+    ok requires affinity to beat blind on BOTH headline metrics and
+    the affinity probe's missed_reuse_frac to land materially below
+    the blind baseline band (PERF_LEDGER.json's 0.112 row)."""
+    import json as _json
+
+    from flax import nnx
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+    from avenir_tpu.obs import MetricsRegistry
+    from avenir_tpu.serve import Router
+
+    seed = int(args.get("seed", 0))
+    n_repl = int(args.get("n_replicas", 3))
+    n_slots = int(args.get("n_slots", 3))
+    n_tenants = int(args.get("n_tenants", 6))
+    page_size = int(args.get("page_size", 16))
+    n_pages = int(args.get("n_pages", 132))
+    prefill_chunk = int(args.get("prefill_chunk", 32))
+    block_size = int(args.get("block_size", 512))
+    sys_prompt = int(args.get("system_prompt_tokens", 448))
+    tail_lo = int(args.get("tail_lo", 8))
+    tail_hi = int(args.get("tail_hi", 24))
+    max_new = int(args.get("max_new_tokens", 8))
+    n_requests = int(args.get("bench_requests", 48))
+    max_conc = int(args.get("max_concurrency", 8))
+    rate = float(args.get("rate", 26.0))  # merged offered req/s, probe
+    slo_ttft_ms = float(args.get("slo_ttft_ms", 250.0))
+    slo_tpot_ms = float(args.get("slo_tpot_ms", 60.0))
+    min_att = float(args.get("min_attainment", 0.9))
+    out_path = args.get("out", "BENCH_kv_cdn.json")
+    max_seq = sys_prompt + tail_hi + max_new
+    assert max_seq <= block_size
+    # the contention knob: every tenant's chain cached at once must NOT
+    # fit one replica next to its live working set, or blind routing
+    # never churns and there is nothing for affinity to win
+    pages_per_prefix = sys_prompt // page_size
+    assert n_tenants * pages_per_prefix + n_slots * (
+        max_seq + page_size - 1) // page_size > n_pages, (
+        "pool too large: every tenant fits everywhere, the bench "
+        "would measure nothing")
+
+    model = GPT(GPTConfig(
+        block_size=block_size, vocab_size=int(args.get("vocab_size", 256)),
+        n_layer=int(args.get("n_layer", 4)),
+        n_head=int(args.get("n_head", 2)),
+        n_embd=int(args.get("n_embd", 128)),
+        dropout=0.0, bias=True, attn_impl="xla"), rngs=nnx.Rngs(seed))
+    V = model.config.vocab_size
+
+    mix_rng = np.random.default_rng(seed)
+    prefixes = [[int(t) for t in mix_rng.integers(0, V, sys_prompt)]
+                for _ in range(n_tenants)]
+
+    def compile_warmup():
+        """Pay every XLA compile OUTSIDE the measured cells (the
+        compile cache is process-wide): the prefill chunk ladder, the
+        prefix-attached tail buckets, and the pull path's gather /
+        scatter buckets. Without this, whichever cell FIRST touches a
+        shape eats a multi-second compile straight into its p99 — and
+        the pull shapes only ever fire in the affinity cell, so the
+        comparison would charge compiles to one side."""
+        from avenir_tpu.serve import Engine
+
+        # max_seq_len must MATCH the cells: gather/scatter widths
+        # bucket against max_pages_per_seq, so a mismatch leaves the
+        # cells' shapes uncompiled and the warmup worthless
+        kw = dict(kv_impl="paged", page_size=page_size, n_pages=n_pages,
+                  prefill_chunk=prefill_chunk, max_seq_len=max_seq)
+        a = Engine(model, n_slots=n_slots, registry=MetricsRegistry(),
+                   **kw)
+        b = Engine(model, n_slots=n_slots, registry=MetricsRegistry(),
+                   **kw)
+        rng = np.random.default_rng(seed + 9)
+        w = [int(t) for t in rng.integers(0, V, sys_prompt)]
+        # ladder + warm-attach buckets: first submit computes the
+        # chain cold, the repeats attach it and compute only the tail
+        for tail in sorted({tail_lo, (tail_lo + tail_hi) // 2,
+                            tail_hi}):
+            tl = [int(t) for t in rng.integers(0, V, tail)]
+            a.submit(w + tl, max_new_tokens=max_new, temperature=1.0,
+                     top_k=None)
+            a.drain()
+        # pull path: export/import chains at every power-of-2 bucket a
+        # measured pull can hit (gather and scatter pad to buckets)
+        for L in sorted({1, 2, 4, 8, 16, pages_per_prefix}):
+            c = [int(t) for t in rng.integers(0, V,
+                                              L * page_size + tail_lo)]
+            a.submit(c, max_new_tokens=max_new, temperature=1.0,
+                     top_k=None)
+            a.drain()
+            rec = a.export_chain([c[i * page_size:(i + 1) * page_size]
+                                  for i in range(L)])
+            if rec is not None:
+                b.import_kv_pages(rec["tokens"], rec["arrays"],
+                                  kv_dtype=rec["kv_dtype"])
+        # attach over imported pages (the receiver's post-pull prefill)
+        rec = a.export_chain([w[i * page_size:(i + 1) * page_size]
+                              for i in range(pages_per_prefix)])
+        b.import_kv_pages(rec["tokens"], rec["arrays"],
+                          kv_dtype=rec["kv_dtype"])
+        b.submit(w + [int(t) for t in rng.integers(0, V, tail_lo)],
+                 max_new_tokens=max_new, temperature=1.0, top_k=None)
+        b.drain()
+
+    def tenant_order(n):
+        """Merge per-tenant Poisson schedules into one arrival order
+        (+ times for the open-loop probe) — seeded per tenant."""
+        merged = []
+        for t in range(n_tenants):
+            arr, _ = gen_arrivals(
+                "poisson", np.random.default_rng(seed * 997 + t), n,
+                rate / n_tenants)
+            merged.extend((float(a), t) for a in arr)
+        merged.sort()
+        return ([t for _, t in merged[:n]],
+                [a for a, _ in merged[:n]])
+
+    def mk_prompt(tenant, rng):
+        tail = [int(t) for t in rng.integers(
+            0, V, int(rng.integers(tail_lo, tail_hi + 1)))]
+        return prefixes[tenant] + tail
+
+    def build(affinity):
+        reg = MetricsRegistry()
+        vf = _VirtualFleet(tick_floor_s=float(args.get("tick_floor_ms",
+                                                       2.0)) / 1e3,
+                           transfer_on_replicas=True)
+        router = Router(
+            model, n_replicas=n_repl, n_slots=n_slots,
+            max_seq_len=max_seq, registry=reg, seed=seed,
+            clock=vf.clock, cache_telescope=True,
+            affinity=bool(affinity),
+            engine_kwargs={"kv_impl": "paged", "page_size": page_size,
+                           "n_pages": n_pages,
+                           "prefill_chunk": prefill_chunk})
+        vf.gate(router)
+        rng = np.random.default_rng(seed + 1)
+        # replica warmup with UNIQUE throwaway prompts (the buckets /
+        # chunk ladder on every replica), then a tenant warm pass
+        # routed by the CELL'S OWN policy — the measured window is
+        # steady state, and each cell earns exactly the warmth its
+        # routing can earn: blind leaves every replica churning all
+        # N tenants through one LRU, affinity shards them
+        for _ in range(2 * n_repl):
+            router.submit([int(t) for t in rng.integers(
+                0, V, sys_prompt + tail_lo)], max_new_tokens=max_new,
+                temperature=1.0, top_k=None)
+        while router.open_requests or router._pending:
+            vf.step(router)
+        rngw = np.random.default_rng(seed + 4)
+        for _ in range(2):
+            for t in range(n_tenants):
+                router.submit(mk_prompt(t, rngw),
+                              max_new_tokens=max_new, temperature=1.0,
+                              top_k=None)
+            while router.open_requests or router._pending:
+                vf.step(router)
+        # counter baseline: the measured partition / pull ledger must
+        # cover the window only, not the warm passes
+        base = dict(reg.snapshot()["counters"])
+        return router, reg, vf, base
+
+    def cell_stats(done, reg, base, n_conc=None):
+        att = slo_attainment(done, slo_ttft_ms=slo_ttft_ms,
+                             slo_tpot_ms=slo_tpot_ms)
+        ttfts = [f.ttft_ms for f in done if f.ttft_ms is not None]
+        tpots = [f.tpot_ms for f in done if f.n_out > 1]
+        c = {k: v - base.get(k, 0.0)
+             for k, v in reg.snapshot()["counters"].items()}
+        reused = c.get("prefix_tokens_reused", 0.0)
+        missed = c.get("prefix_tokens_missed", 0.0)
+        cold = c.get("prefix_tokens_cold", 0.0)
+        total = reused + missed + cold
+        st = {"attainment": att,
+              "ttft_p50_ms": _pct(ttfts, 0.50),
+              "ttft_p99_ms": _pct(ttfts, 0.99),
+              "tpot_p50_ms": _pct(tpots, 0.50),
+              "tpot_p99_ms": _pct(tpots, 0.99),
+              "missed_reuse_frac": missed / total if total else 0.0,
+              "prefix_tokens": {"reused": reused, "missed": missed,
+                                "cold": cold},
+              "affinity_hits": c.get("affinity_hits", 0.0),
+              "prefix_pull_pages": c.get("prefix_pull_pages", 0.0),
+              "prefix_pull_bytes": c.get("prefix_pull_bytes", 0.0),
+              "prefix_pull_fallbacks": c.get("prefix_pull_fallbacks",
+                                             0.0)}
+        if n_conc is not None:
+            st["n_conc"] = n_conc
+        return st
+
+    def closed_trial(affinity, n_conc):
+        router, reg, vf, base = build(affinity)
+        order, _ = tenant_order(n_requests)
+        rng = np.random.default_rng(seed + 2)
+        submitted, done = 0, []
+        while len(done) < n_requests:
+            while (submitted < n_requests
+                   and submitted - len(done) < n_conc):
+                router.submit(mk_prompt(order[submitted], rng),
+                              max_new_tokens=max_new, temperature=1.0,
+                              top_k=None)
+                submitted += 1
+            done.extend(vf.step(router))
+        st = cell_stats(done, reg, base, n_conc=n_conc)
+        label = "affinity" if affinity else "blind"
+        print(f"[kv_cdn:{label}] n={n_conc:3d}  attainment "
+              f"{st['attainment']:6.1%}  ttft p99 "
+              f"{st['ttft_p99_ms']:7.1f} ms  missed "
+              f"{st['missed_reuse_frac']:.3f}  pulls "
+              f"{st['prefix_pull_pages']:.0f}p")
+        router.close()
+        ok = st["attainment"] is not None and st["attainment"] >= min_att
+        return ok, st
+
+    def frontier(affinity):
+        trials = []
+        ok1, st = closed_trial(affinity, 1)
+        trials.append(st)
+        if not ok1:
+            return {"max_sustainable_concurrency": 0, "trials": trials}
+        lo, hi = 1, max_conc
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            ok, st = closed_trial(affinity, mid)
+            trials.append(st)
+            if ok:
+                lo = mid
+            else:
+                hi = mid - 1
+        at_max = next((t for t in trials if t["n_conc"] == lo),
+                      trials[0])
+        return {"max_sustainable_concurrency": lo, "trials": trials,
+                "at_max": at_max}
+
+    def probe(affinity, n_req):
+        """Open loop: submit on the merged Poisson schedule against
+        the virtual clock — queue waits count against TTFT the way a
+        real multi-tenant front door would see them."""
+        router, reg, vf, base = build(affinity)
+        order, times = tenant_order(n_req)
+        rng = np.random.default_rng(seed + 3)
+        t0 = vf.vt[0]
+        submitted, done = 0, []
+        while len(done) < n_req:
+            if (submitted < n_req and not router.open_requests
+                    and not router._pending):
+                vf.vt[0] = max(vf.vt[0], t0 + times[submitted])
+            while (submitted < n_req
+                   and t0 + times[submitted] <= vf.vt[0] + 1e-9):
+                router.submit(mk_prompt(order[submitted], rng),
+                              max_new_tokens=max_new, temperature=1.0,
+                              top_k=None)
+                submitted += 1
+            done.extend(vf.step(router))
+        st = cell_stats(done, reg, base)
+        label = "affinity" if affinity else "blind"
+        print(f"[kv_cdn:probe:{label}] rate={rate:.0f}/s  attainment "
+              f"{st['attainment']:6.1%}  ttft p99 "
+              f"{st['ttft_p99_ms']:7.1f} ms  missed "
+              f"{st['missed_reuse_frac']:.3f}  hits "
+              f"{st['affinity_hits']:.0f}  pulls "
+              f"{st['prefix_pull_pages']:.0f}p"
+              f"/{st['prefix_pull_fallbacks']:.0f}fb")
+        router.close()
+        return st
+
+    compile_warmup()
+    results = {"blind": frontier(False), "affinity": frontier(True)}
+    n_probe = 2 * n_requests
+    probes = {"blind": probe(False, n_probe),
+              "affinity": probe(True, n_probe)}
+    blind_max = results["blind"]["max_sustainable_concurrency"]
+    aff_max = results["affinity"]["max_sustainable_concurrency"]
+    blind_p99 = probes["blind"]["ttft_p99_ms"]
+    aff_p99 = probes["affinity"]["ttft_p99_ms"]
+    missed_aff = probes["affinity"]["missed_reuse_frac"]
+    missed_blind = probes["blind"]["missed_reuse_frac"]
+    bench = {
+        "kind": "kv_cdn_sweep",
+        "config": {
+            "seed": seed, "n_replicas": n_repl, "n_slots": n_slots,
+            "n_tenants": n_tenants,
+            "system_prompt_tokens": sys_prompt,
+            "tail_tokens": [tail_lo, tail_hi],
+            "max_new_tokens": max_new, "block_size": block_size,
+            "page_size": page_size, "n_pages": n_pages,
+            "prefill_chunk": prefill_chunk,
+            "n_requests": n_requests, "probe_requests": n_probe,
+            "rate": rate, "slo_ttft_ms": slo_ttft_ms,
+            "slo_tpot_ms": slo_tpot_ms, "min_attainment": min_att,
+            "timing_model": (
+                "virtual-time parallel-fleet replay on one host "
+                "(see BENCH_disagg.json): per-replica measured step "
+                "cost, router host work charged serially"),
+        },
+        **results,
+        "probe": probes,
+        "max_sustainable_concurrency": {"blind": blind_max,
+                                        "affinity": aff_max},
+        "ttft_p99_ms": {"blind": blind_p99, "affinity": aff_p99},
+        "missed_reuse_frac": {"blind": missed_blind,
+                              "affinity": missed_aff},
+        # the acceptance bar (ISSUE 17): affinity beats blind on BOTH
+        # headlines at equal chips, and the residual missed-reuse
+        # fraction lands materially below the blind telescope band
+        # (PERF_LEDGER.json missed_reuse_frac row: 0.112)
+        "ok": bool(aff_max > blind_max and aff_p99 < blind_p99
+                   and missed_aff < 0.112 * 0.5),
+    }
+    with open(out_path, "w") as f:
+        _json.dump(bench, f, indent=1)
+    print(f"[kv_cdn] max sustainable concurrency: blind {blind_max}  "
+          f"affinity {aff_max}; probe ttft p99 blind {blind_p99:.1f} "
+          f"-> affinity {aff_p99:.1f} ms; missed_reuse_frac "
+          f"{missed_blind:.3f} -> {missed_aff:.3f} -> {out_path} "
+          f"(ok={bench['ok']})")
+    return 0 if bench["ok"] else 1
+
+
 def main():
     args = {a.split("=")[0].lstrip("-"): (a.split("=") + ["1"])[1]
             for a in sys.argv[1:]}
+    if "kv_cdn" in args:
+        sys.exit(kv_cdn_bench(args))
     if "sweep" in args:
         sys.exit(sweep(args))
     if "disagg" in args:
