@@ -80,7 +80,8 @@ def masked_probs(logits, temperature, top_k):
     return jax.nn.softmax(l, axis=-1)
 
 
-def spec_accept(keys, p_logits, q_logits, drafts, temperature, top_k):
+def spec_accept(keys, p_logits, q_logits, drafts, temperature, top_k,
+                k_eff=None):
     """One verification round. All shapes fixed; k = drafts.shape[1].
 
     keys:      (B,) typed target keys (each row consumes only its own —
@@ -91,6 +92,15 @@ def spec_accept(keys, p_logits, q_logits, drafts, temperature, top_k):
     q_logits:  (B, k, V) draft logits d_i was sampled from
     drafts:    (B, k) int32 proposed tokens
     temperature/top_k: (B,) per-row sampling params (top_k = V none)
+    k_eff:     (B,) int32 per-row EFFECTIVE k (adaptive spec_k, ISSUE
+               18), or None = k everywhere. Draft positions >= the
+               row's k_eff are force-rejected BEFORE the uniforms are
+               compared, so a row emits at most k_eff+1 tokens and its
+               final token is the bonus p(.|d_1..d_{k_eff}) when every
+               considered draft survived — exactly the distribution a
+               width-k_eff verify would have produced. The rng budget
+               stays k+2 splits per row whatever k_eff is, so adapting
+               k mid-request never skews a fixed-k row's stream.
 
     Returns (new_keys, toks, counts): `toks` (B, k+1) int32 holds the
     emitted tokens left-aligned — positions 0..counts-2 are accepted
@@ -101,6 +111,8 @@ def spec_accept(keys, p_logits, q_logits, drafts, temperature, top_k):
     B, K1, V = p_logits.shape
     K = K1 - 1
     assert drafts.shape == (B, K) and q_logits.shape == (B, K, V)
+    if k_eff is None:
+        k_eff = jnp.full((B,), K, jnp.int32)
     p = masked_probs(p_logits, temperature, top_k)        # (B, K+1, V)
     q = masked_probs(q_logits, temperature, top_k)        # (B, K, V)
 
@@ -114,13 +126,14 @@ def spec_accept(keys, p_logits, q_logits, drafts, temperature, top_k):
 
     p_d = jnp.take_along_axis(p[:, :K], drafts[..., None], -1)[..., 0]
     q_d = jnp.take_along_axis(q, drafts[..., None], -1)[..., 0]
-    # u < p/q, written divide-free (q_d > 0: d was sampled from q)
-    accept = u * q_d < p_d                                 # (B, K)
+    # u < p/q, written divide-free (q_d > 0: d was sampled from q);
+    # positions past the row's effective k are dead by fiat
+    accept = (u * q_d < p_d) & (jnp.arange(K)[None, :] < k_eff[:, None])
     acc = jnp.cumprod(accept.astype(jnp.int32), axis=1)
-    n_acc = acc.sum(axis=1)                                # (B,) 0..K
+    n_acc = acc.sum(axis=1)                                # (B,) 0..k_eff
 
     # the final token's distribution: residual at the first rejection,
-    # the bonus p_k when everything was accepted
+    # the bonus p_{k_eff} when everything considered was accepted
     p_sel = jnp.take_along_axis(p, n_acc[:, None, None], axis=1)[:, 0]
     q_sel = jnp.take_along_axis(
         q, jnp.minimum(n_acc, K - 1)[:, None, None], axis=1)[:, 0]
@@ -131,7 +144,7 @@ def spec_accept(keys, p_logits, q_logits, drafts, temperature, top_k):
     # float underflow only — fall back to the target distribution,
     # which is still exactly correct sampling, just not residual-shaped
     resid = jnp.where(rs > 0, resid / jnp.maximum(rs, 1e-38), p_sel)
-    final_dist = jnp.where((n_acc < K)[:, None], resid, p_sel)
+    final_dist = jnp.where((n_acc < k_eff)[:, None], resid, p_sel)
     final_tok = jax.vmap(
         lambda kk, pr: jax.random.categorical(kk, jnp.log(pr)))(
             ks[:, 1], final_dist).astype(jnp.int32)
@@ -142,6 +155,61 @@ def spec_accept(keys, p_logits, q_logits, drafts, temperature, top_k):
          jnp.zeros((B, 1), jnp.int32)], axis=1)            # (B, K+1)
     toks = toks.at[jnp.arange(B), n_acc].set(final_tok)
     return new_keys, toks, counts
+
+
+# ---------------------------------------------------------------------------
+# Draft-free n-gram self-draft (ISSUE 18): prompt-lookup proposals
+# ---------------------------------------------------------------------------
+
+# longest suffix n-gram the host matcher tries before giving up (3, 2,
+# then 1) — the prompt-lookup-decoding default; longer n-grams buy
+# nothing on the workloads this serves (a 3-gram repeat is already a
+# near-certain continuation match) and cost host scan time per tick
+NGRAM_MAX_N = 3
+
+
+def ngram_propose(ctx, k, max_n=NGRAM_MAX_N):
+    """Prompt-lookup self-draft (`draft_model='ngram'`): propose the k
+    tokens that literally FOLLOW the most recent earlier occurrence of
+    the context's longest matching suffix n-gram. `ctx` is the request's
+    full token context (prompt + everything emitted) — matching over
+    emitted tokens too is what makes extraction/summarization/RAG
+    workloads (and any self-repeating generation) near-free to draft.
+
+    Returns (proposal list of k ints, hit bool). On a miss — no suffix
+    of any tried length recurs — the proposal is the last token repeated
+    (cheap, and on a run-loop workload frequently right anyway); `hit`
+    feeds the `ngram_hits` counter so the obs surface can tell lookup
+    coverage from accept luck. Pure host arithmetic, deterministic in
+    `ctx`: a failed-over request re-proposes identically, so the
+    pure-function-of-(prompt, rng) replay contract survives the draft-
+    free draft too."""
+    L = len(ctx)
+    assert L >= 1 and k >= 1
+    for n in range(min(max_n, L - 1), 0, -1):
+        suffix = ctx[L - n:]
+        # most recent earlier occurrence whose continuation exists
+        for i in range(L - n - 1, -1, -1):
+            if ctx[i:i + n] == suffix:
+                cont = list(ctx[i + n:i + n + k])
+                cont += [ctx[-1]] * (k - len(cont))
+                return cont, True
+    return [ctx[-1]] * k, False
+
+
+def ngram_q_logits(drafts, vocab_size):
+    """Point-mass draft logits for ngram proposals: 0 at the proposed
+    token, -inf elsewhere, so `masked_probs` yields EXACTLY a one-hot q
+    at any temperature/top-k (temperature rescales -inf to -inf; the
+    top-k threshold can never mask the only finite entry). Feeding this
+    q through `spec_accept` reduces rejection sampling to: accept d with
+    probability p(d), resample a rejection from p excluding d — the
+    classic prompt-lookup acceptance rule, with the SAME exactness
+    guarantees (each emitted token is distributed exactly as target-only
+    sampling; greedy is bit-deterministic) because q is a legitimate
+    proposal distribution that happens to be deterministic."""
+    one_hot = jax.nn.one_hot(drafts, vocab_size, dtype=jnp.float32)
+    return jnp.where(one_hot > 0, 0.0, -jnp.inf)
 
 
 def expected_tokens_per_tick(accept_rate, k):

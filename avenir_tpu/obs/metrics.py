@@ -413,6 +413,20 @@ METRIC_SCHEMA = {
         "cumulative spec_accepted / spec_proposed — drives the "
         "effective tokens-per-model-pass: (1 - a^(k+1)) / (1 - a) "
         "(docs/PERFORMANCE.md accept-rate math)"),
+    "ngram_hits": (
+        "counter", "1",
+        "per-slot-tick prompt-lookup matches under draft_model='ngram' "
+        "(a suffix n-gram of the context recurred and its continuation "
+        "was proposed; misses fall back to last-token repeats) — "
+        "registered at engine construction in ngram mode, so presence "
+        "marks the draft source even before the first hit"),
+    "spec_k_effective": (
+        "gauge", "1",
+        "mean per-live-slot effective k at the last speculative tick — "
+        "equals spec_k when fixed; under spec_k='auto' each slot walks "
+        "the k bucket ladder on its accept-rate EWMA (floor k=1), so "
+        "this gauge falling toward 1 is the adaptive-k response the "
+        "accept_rate_collapse runbook row points at"),
     "kv_dtype": (
         "gauge", "bits",
         "KV-cache element width of the serving engine: 16 (bf16, the "

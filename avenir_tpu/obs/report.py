@@ -177,6 +177,16 @@ def summarize(records, *, skipped_lines=()):
             # the gauge snapshot names the KV width the run served at
             "spec_proposed": counters.get("spec_proposed", 0.0),
             "spec_accepted": counters.get("spec_accepted", 0.0),
+            # spec composition (ISSUE 18): the n-gram self-draft's
+            # lookup hit count and the adaptive-k controller's
+            # effective depth at the end of the run
+            "ngram_hits": counters.get("ngram_hits", 0.0),
+            # the counter is registered (at 0) iff the engine ran the
+            # n-gram self-draft, so presence names the draft source
+            "spec_draft_source": ("ngram" if "ngram_hits" in counters
+                                  else "model"),
+            "spec_k_effective": (end.get("gauges")
+                                 or {}).get("spec_k_effective"),
             "kv_dtype_bits": (end.get("gauges") or {}).get("kv_dtype"),
             # fleet cache telescope (ISSUE 16): the reuse audit's token
             # partition; est saved ms derives from the run's own
@@ -410,6 +420,13 @@ def format_report(s):
             rate = sv["spec_accepted"] / sv["spec_proposed"]
             bits = [f"{rate:.0%} of {sv['spec_proposed']:.0f} proposed "
                     "draft tokens"]
+            if sv.get("spec_draft_source") == "ngram":
+                bits.append(f"ngram draft ({sv['ngram_hits']:.0f} "
+                            "lookup hits)")
+            else:
+                bits.append("model draft")
+            if sv.get("spec_k_effective") is not None:
+                bits.append(f"k_eff {sv['spec_k_effective']:.1f}")
             if sv.get("kv_dtype_bits") is not None:
                 bits.append("kv " + ("int8" if sv["kv_dtype_bits"] == 8
                                      else "bf16"))

@@ -61,9 +61,12 @@ from avenir_tpu.infer.decode import (
     _forward_cached,
     _sample_rows,
     _normalize_stop,
+    bucket_ladder,
     init_cache,
+    prompt_bucket,
 )
-from avenir_tpu.infer.spec import draft_key, spec_accept
+from avenir_tpu.infer.spec import draft_key, ngram_propose, \
+    ngram_q_logits, spec_accept
 from avenir_tpu.obs import NullSink, get_registry, span
 from avenir_tpu.ops.kv_quant import init_quant_kv, quant_slab_kv_ops
 from avenir_tpu.serve.pages import PagedHost, PagedPool, \
@@ -142,6 +145,20 @@ class _Live:
         # the slot rng exactly like the first sequential tick) and
         # harvested — prepended — with the slot's first verify tick
         self.pending = []
+        # adaptive spec_k (ISSUE 18): this slot's current effective k
+        # (a rung of the engine's k ladder; the full cap unless
+        # spec_k='auto' walks it) and its accept-rate EWMA
+        self.k_eff = None
+        self.acc_ewma = None
+        # ngram self-draft (ISSUE 18): the request's full host-side
+        # token context — prompt + every token sampled so far — the
+        # suffix-match proposer scans each tick (None = model draft).
+        # `tail` carries the last SAMPLED token separately: it is the
+        # verify block's first input (decode-critical), while ctx only
+        # ever feeds the proposer — a desynced/corrupt lookup context
+        # must cost speed, never correctness
+        self.ctx = None
+        self.tail = None
 
 
 class Engine:
@@ -260,8 +277,25 @@ class Engine:
                     "prefill-class replica never decodes, and the draft "
                     "slab cannot ride a page transfer")
         self.role = role
-        self.spec_k = int(spec_k)
+        # spec_k (ISSUE 18): an int fixes k; 'auto' makes k per-request
+        # ADAPTIVE — each live slot walks the k bucket ladder
+        # (bucket_ladder(cap, floor=1)) on its measured accept-rate
+        # EWMA, so a collapsing draft shrinks its verify width instead
+        # of burning k rejected proposals per tick. The default cap
+        # under 'auto' is the same k=4 the fixed default uses.
+        self.spec_k_auto = spec_k == "auto"
+        self.spec_k = 4 if self.spec_k_auto else int(spec_k)
         assert self.spec_k >= 1
+        # draft-free self-draft (ISSUE 18): draft_model='ngram' swaps
+        # the second model for host-side prompt-lookup proposals
+        # (infer.spec.ngram_propose) verified through the SAME batched
+        # (B, k+1) verify block — no draft pool, no draft weights, no
+        # model in the hello
+        self.ngram = draft_model == "ngram"
+        if isinstance(draft_model, str) and not self.ngram:
+            raise ValueError(
+                f"unknown draft_model {draft_model!r} — pass a model "
+                "or the string 'ngram' (prompt-lookup self-draft)")
         self.draft_model = draft_model
         spec_on = spec_decode == "draft"
         if spec_on:
@@ -271,21 +305,30 @@ class Engine:
             # (docs/OPERATIONS.md failure matrix)
             if draft_model is None:
                 raise ValueError(
-                    "spec_decode='draft' needs a draft_model")
-            dcfg = draft_model.config
-            if dcfg.vocab_size != cfg.vocab_size:
-                raise ValueError(
-                    f"draft/target vocab mismatch: draft "
-                    f"{dcfg.vocab_size} != target {cfg.vocab_size} — "
-                    "speculative verification compares token "
-                    "distributions, the vocabularies must be the same "
-                    "model version (fail-loud at hello)")
-            if dcfg.block_size < self.T_max:
-                raise ValueError(
-                    f"draft block_size {dcfg.block_size} < engine "
-                    f"max_seq_len {self.T_max} — the draft must cover "
-                    "every position the target serves (fail-loud at "
-                    "hello)")
+                    "spec_decode='draft' needs a draft_model (a small "
+                    "same-vocab model, or 'ngram' for the draft-free "
+                    "prompt-lookup self-draft)")
+            if not self.ngram:
+                dcfg = draft_model.config
+                if dcfg.vocab_size != cfg.vocab_size:
+                    raise ValueError(
+                        f"draft/target vocab mismatch: draft "
+                        f"{dcfg.vocab_size} != target {cfg.vocab_size} — "
+                        "speculative verification compares token "
+                        "distributions, the vocabularies must be the same "
+                        "model version (fail-loud at hello)")
+                if dcfg.block_size < self.T_max:
+                    raise ValueError(
+                        f"draft block_size {dcfg.block_size} < engine "
+                        f"max_seq_len {self.T_max} — the draft must cover "
+                        "every position the target serves (fail-loud at "
+                        "hello)")
+        # the verify-width ladder adaptive k rides (ISSUE 18): per-tick
+        # width is the bucket of the largest live k_eff, so steady
+        # state with fixed spec_k stays ONE step trace and 'auto' is
+        # bounded by len(k_ladder) traces ever (asserted each step)
+        self._k_ladder = bucket_ladder(self.spec_k, floor=1) \
+            if spec_on else (1,)
         self.detokenize = detokenize
         self._reg = registry if registry is not None else get_registry()
         self.sink = sink if sink is not None else NullSink()
@@ -303,7 +346,8 @@ class Engine:
         self._tick_n = 0    # decode ticks ever, for trace sampling
         self._next_id = 0
         self._base_rng = jax.random.key(seed)
-        self.traces = {"prefill": [], "step": [], "cow": [], "import": []}
+        self.traces = {"prefill": [], "step": [], "cow": [], "import": [],
+                       "seed": [], "draft_prefill": []}
         # finished-page export queue (role='prefill'): records the
         # router drains each step and streams to the decode class —
         # already-materialized numpy, so a SIGKILL mid-transfer loses
@@ -322,25 +366,24 @@ class Engine:
         # point (the slot-hygiene invariant covers rejected drafts)
         self._spec_pad = self.spec_k if spec_on else 0
         self._reg.gauge("kv_dtype").set(8 if kv_dtype == "int8" else 16)
+        if spec_on and self.ngram:
+            # register at construction so obs_report can tell the
+            # draft source apart even before the first lookup lands
+            self._reg.counter("ngram_hits").add(0)
         if kv_impl == "paged":
-            if spec_on and prefix_sharing:
-                # a prefix HIT skips computing the shared prompt region
-                # entirely — exact for the target (the attached pages
-                # ARE its KV) but the DRAFT has no shared pages: its
-                # slab would keep stale garbage under the prefix, so
-                # proposals q would condition on a previous tenant's
-                # state — collapsing accept rate on exactly the
-                # shared-prefix workload, and (worse) making sampled
-                # output depend on slot history instead of being a pure
-                # function of (prompt, rng), which the bit-identical
-                # failover-replay contract needs. Until the draft gets
-                # its own prefix store, spec decoding computes full
-                # prompts: sharing off, loudly.
-                warnings.warn(
-                    "spec_decode='draft' disables paged prefix sharing: "
-                    "the draft model must forward the full prompt "
-                    "(docs/SERVING.md)", stacklevel=2)
-                prefix_sharing = False
+            # spec × prefix sharing (ISSUE 18, tearing down the PR 10
+            # wall): a prefix HIT skips computing the shared prompt
+            # region for the TARGET (the attached pages ARE its KV) —
+            # and the draft, which has no shared-page store, catches up
+            # with DRAFT-ONLY chunks over the shared region
+            # (`_draft_chunk_fn`, charged to the same prefill budget).
+            # The draft is tiny by construction, so the catch-up costs
+            # a sliver of the shared-region savings; chunk-split
+            # invariance of `_forward_cached` makes its proposals
+            # bit-identical to a full joint prefill, so output stays a
+            # pure function of (prompt, rng) and the failover-replay
+            # contract survives. The ngram self-draft has no draft KV
+            # at all and composes for free.
             self.page_size = int(page_size)
             assert self.page_size >= 1
             # equal-HBM default: the paged pool spends exactly the KV
@@ -395,7 +438,7 @@ class Engine:
 
             self._slab_kv_ops = quant_slab_kv_ops(pool_dtype, attend_fn)
         self._dpool = None
-        if spec_on:
+        if spec_on and not self.ngram:
             dcfg = draft_model.config
             self._dpool = init_draft_pool(
                 n_layer=dcfg.n_layer, n_slots=self.n_slots,
@@ -423,24 +466,55 @@ class Engine:
         # Call refresh_state() after mutating weights in place.
         graphdef, self._state = nnx.split(model)
         self._dgraphdef = self._dstate = None
-        if spec_on:
+        if spec_on and not self.ngram:
             self._dgraphdef, self._dstate = nnx.split(draft_model)
         traces = self.traces
         if kv_impl == "paged":
             self._build_paged_fns(graphdef, traces, paged_attn_impl)
         else:
             self._build_slab_fns(graphdef, traces)
+        if spec_on and self.ngram:
+            # ngram first-token seed: the sequential admit/chunk path
+            # prefills the target, then this tiny pool-only fn samples
+            # the request's first token from the spliced prefill logits
+            # with the slot's own key — the same split the first
+            # sequential tick would consume, so greedy ngram output is
+            # bit-identical from token one. ONE trace ever ("seed"),
+            # shared by both KV layouts; no model forward inside.
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def _seed_tail(pool, slot):
+                traces["seed"].append(True)
+                sl = lambda a: jax.lax.dynamic_slice_in_dim(
+                    a, slot, 1, axis=0)
+                keys1 = jax.random.wrap_key_data(sl(pool.rng))
+                keys1, tail = _sample_rows(
+                    keys1, sl(pool.logits), sl(pool.temperature),
+                    sl(pool.top_k))
+                pool = pool._replace(rng=jax.lax.dynamic_update_slice(
+                    pool.rng, jax.random.key_data(keys1), (slot, 0)))
+                return pool, tail[0]
 
-    def _spec_core(self, m, dm, pool, dpool, active, kv_ops):
+            self._seed_tail = _seed_tail
+
+    def _spec_core(self, m, dm, pool, dpool, active, kv_ops, k_eff,
+                   k_tick):
         """The speculative tick, shared by both KV layouts — runs
         INSIDE the jitted step (one dispatch): draft catch-up on last
         tick's emissions, k autoregressive draft proposals, the ONE
         batched target verify over [tail, d_1..d_k], then rejection-
-        sampling acceptance (infer/spec.py). Returns (toks (B, k+1),
+        sampling acceptance (infer/spec.py). Returns (toks (B, k_cap+1),
         counts (B,), new_pool, new_dpool) — fixed shapes; the variable
-        1..k+1 harvest is host bookkeeping over `counts`."""
+        1..k+1 harvest is host bookkeeping over `counts`.
+
+        `k_tick` (ISSUE 18, adaptive spec_k) is the tick's VERIFY WIDTH
+        — a static rung of the k ladder (trace-time python int), the
+        bucket of the largest live k_eff, so shrinking k genuinely
+        shrinks the draft scan and the verify forward instead of just
+        masking rows. `k_eff` (B,) int32 masks acceptance per row below
+        that (spec_accept force-rejects positions >= k_eff). With fixed
+        spec_k both pin at the cap: one step trace, as ever."""
         K1 = dpool.prev.shape[1]
-        K = K1 - 1
+        K = min(int(k_tick), K1 - 1)
         # 1. draft catch-up: the draft saw only its own proposals last
         # tick — feed it what was actually EMITTED (count-masked width
         # k+1; padding rows land past every query position this tick
@@ -481,9 +555,16 @@ class Engine:
 
         # 4. accept/reject: bit-greedy, distribution-exact otherwise
         tkeys = jax.random.wrap_key_data(pool.rng)
-        tkeys, toks, counts = spec_accept(tkeys, p_logits, q_logits,
-                                          drafts, pool.temperature,
-                                          pool.top_k)
+        tkeys, toks, counts = spec_accept(
+            tkeys, p_logits, q_logits, drafts, pool.temperature,
+            pool.top_k, k_eff=jnp.minimum(k_eff, K))
+        # pad the emission block back to the pool's fixed k_cap+1 width
+        # (dead columns — counts never reaches them) so prev and the
+        # host harvest keep ONE shape across k_tick rungs
+        B = toks.shape[0]
+        if K < K1 - 1:
+            toks = jnp.concatenate(
+                [toks, jnp.zeros((B, K1 - 1 - K), jnp.int32)], axis=1)
         new_pool = pool._replace(
             k=cache.k, v=cache.v,
             rng=jax.random.key_data(tkeys),
@@ -517,9 +598,10 @@ class Engine:
         unchanged: one prefill trace per bucket + ONE step trace."""
         dgraphdef = self._dgraphdef
         spec_on = self.spec_decode == "draft"
+        model_draft = spec_on and not self.ngram
         slab_kv = self._slab_kv_ops
         init_tmp = self._init_tmp_cache
-        dcfg = self.draft_model.config if spec_on else None
+        dcfg = self.draft_model.config if model_draft else None
 
         def _admit_body(state, pool, idx_pad, slot, last_index, key_data,
                         temp, top_k):
@@ -542,7 +624,7 @@ class Engine:
             )
             return pool
 
-        if spec_on:
+        if model_draft:
             # spec admission = the sequential one PLUS: the draft
             # prefills the same prompt into its slab column, and the
             # request's FIRST token (the "tail") is sampled here from
@@ -570,19 +652,49 @@ class Engine:
 
             self._admit = _admit_spec
 
-            @functools.partial(jax.jit, donate_argnums=(2, 3))
-            def _spec_step(state, dstate, pool, dpool, active):
+            @functools.partial(jax.jit, static_argnums=(6,),
+                               donate_argnums=(2, 3))
+            def _spec_step(state, dstate, pool, dpool, active, k_eff,
+                           k_tick):
                 traces["step"].append(True)
                 m = nnx.merge(graphdef, state)
                 dm = nnx.merge(dgraphdef, dstate)
                 return self._spec_core(m, dm, pool, dpool, active,
-                                       slab_kv)
+                                       slab_kv, k_eff, k_tick)
 
             self._step_fn = _spec_step
             return
 
         self._admit = functools.partial(jax.jit, donate_argnums=(1,))(
             _admit_body)
+
+        if spec_on:  # ngram self-draft (ISSUE 18): no draft pool/state
+            # — the host proposes via suffix match, the target verifies
+            # the (B, k_tick+1) block exactly as the model-draft path
+            # does, and q is the point-mass one-hot at the proposals so
+            # spec_accept's exactness guarantees carry over verbatim.
+            # k_tick rides in as the DRAFTS WIDTH (shape-keyed retrace
+            # per k-ladder rung; budget asserted), no static arg needed.
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def _ngram_step(state, pool, active, drafts, tail, k_eff):
+                traces["step"].append(True)
+                m = nnx.merge(graphdef, state)
+                vin = jnp.concatenate([tail[:, None], drafts], axis=1)
+                p_logits, cache = _forward_cached(
+                    m, vin, KVCache(pool.k, pool.v), pool.pos,
+                    kv_ops=slab_kv, return_all=True)
+                q_logits = ngram_q_logits(drafts, p_logits.shape[-1])
+                tkeys = jax.random.wrap_key_data(pool.rng)
+                tkeys, toks, counts = spec_accept(
+                    tkeys, p_logits, q_logits, drafts, pool.temperature,
+                    pool.top_k, k_eff=k_eff)
+                return toks, counts, pool._replace(
+                    k=cache.k, v=cache.v,
+                    rng=jax.random.key_data(tkeys),
+                    pos=jnp.where(active, pool.pos + counts, pool.pos))
+
+            self._step_fn = _ngram_step
+            return
 
         # ONE step variant on purpose: the engine's compile budget
         # (buckets + 1 decode step, asserted) is the contract we keep.
@@ -651,7 +763,8 @@ class Engine:
         n_pg, ps, P = self.n_pages, self.page_size, self.max_pages_per_seq
         dgraphdef = self._dgraphdef
         spec_on = self.spec_decode == "draft"
-        dcfg = self.draft_model.config if spec_on else None
+        model_draft = spec_on and not self.ngram
+        dcfg = self.draft_model.config if model_draft else None
 
         def _kv(tables, **kw):
             return paged_kv_ops(tables, n_pages=n_pg, page_size=ps,
@@ -681,7 +794,7 @@ class Engine:
                 top_k=upd(pool.top_k, top_k[None], (slot,)),
             ), logits
 
-        if spec_on:
+        if model_draft:
             # the chunk fn stays UNIFORM across chunks: the draft
             # forwards the same chunk into its slab column, and the
             # tail/prev/rng splices recompute idempotently from the
@@ -709,9 +822,34 @@ class Engine:
 
             self._chunk_fn = _chunk_spec
 
-            @functools.partial(jax.jit, donate_argnums=(2, 3))
+            # spec × prefix sharing (ISSUE 18): DRAFT-ONLY chunk over a
+            # region the target skipped — a prefix hit attaches the
+            # target's shared pages as-is, and this fn walks the draft
+            # through the same prompt tokens so its proposals condition
+            # on exactly the state a full prefill would have built
+            # (chunk-split invariance of _forward_cached ⇒ bit-equal).
+            # Same chunk-bucket ladder as the combined fn, own trace
+            # key ("draft_prefill", ladder-bounded, asserted).
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def _draft_chunk(dpool, dstate, idx, slot, start, n_real):
+                traces["draft_prefill"].append(idx.shape)
+                dm = nnx.merge(dgraphdef, dstate)
+                dk = jax.lax.dynamic_slice_in_dim(dpool.k, slot, 1,
+                                                  axis=1)
+                dv = jax.lax.dynamic_slice_in_dim(dpool.v, slot, 1,
+                                                  axis=1)
+                _, dtmp = _forward_cached(dm, idx, KVCache(dk, dv), start,
+                                          last_index=n_real - 1)
+                return dpool._replace(
+                    k=_splice_slot(dpool.k, dtmp.k, slot),
+                    v=_splice_slot(dpool.v, dtmp.v, slot))
+
+            self._draft_chunk_fn = _draft_chunk
+
+            @functools.partial(jax.jit, static_argnums=(8,),
+                               donate_argnums=(2, 3))
             def _spec_step(state, dstate, pool, dpool, active, tables,
-                           write_limit):
+                           write_limit, k_eff, k_tick):
                 traces["step"].append(True)
                 m = nnx.merge(graphdef, state)
                 dm = nnx.merge(dgraphdef, dstate)
@@ -722,9 +860,48 @@ class Engine:
                 # queries, so verify reads take the gather reference
                 kv = _kv(tables, write_mask=active,
                          write_limit=write_limit, attend_fn=attend_fn)
-                return self._spec_core(m, dm, pool, dpool, active, kv)
+                return self._spec_core(m, dm, pool, dpool, active, kv,
+                                       k_eff, k_tick)
 
             self._step_fn = _spec_step
+        elif spec_on:
+            # ngram self-draft, paged: the SEQUENTIAL chunk fn prefills
+            # the target (no draft KV exists to keep in lockstep — the
+            # self-draft composes with prefix sharing and page imports
+            # for free), and the verify step mirrors the slab ngram
+            # step over page tables with the multi-token write_limit
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def _chunk(state, pool, idx, table_row, slot, start, n_real,
+                       key_data, temp, top_k):
+                pool, _ = _chunk_body(state, pool, idx, table_row, slot,
+                                      start, n_real, key_data, temp,
+                                      top_k)
+                return pool
+
+            self._chunk_fn = _chunk
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def _ngram_step(state, pool, active, drafts, tail, k_eff,
+                            tables, write_limit):
+                traces["step"].append(True)
+                m = nnx.merge(graphdef, state)
+                vin = jnp.concatenate([tail[:, None], drafts], axis=1)
+                kv = _kv(tables, write_mask=active,
+                         write_limit=write_limit, attend_fn=attend_fn)
+                p_logits, cache = _forward_cached(
+                    m, vin, KVCache(pool.k, pool.v), pool.pos,
+                    kv_ops=kv, return_all=True)
+                q_logits = ngram_q_logits(drafts, p_logits.shape[-1])
+                tkeys = jax.random.wrap_key_data(pool.rng)
+                tkeys, toks, counts = spec_accept(
+                    tkeys, p_logits, q_logits, drafts, pool.temperature,
+                    pool.top_k, k_eff=k_eff)
+                return toks, counts, pool._replace(
+                    k=cache.k, v=cache.v,
+                    rng=jax.random.key_data(tkeys),
+                    pos=jnp.where(active, pool.pos + counts, pool.pos))
+
+            self._step_fn = _ngram_step
         else:
             @functools.partial(jax.jit, donate_argnums=(1,))
             def _chunk(state, pool, idx, table_row, slot, start, n_real,
@@ -986,6 +1163,39 @@ class Engine:
             return {}
         return self._paged.alloc.chain_summary(self.chain_topk)
 
+    def _tick_k(self):
+        """Per-tick adaptive-k inputs (ISSUE 18): the (n_slots,) int32
+        effective-k vector (the cap for empty and non-auto slots) and
+        this tick's VERIFY WIDTH — the k-ladder bucket of the largest
+        live k_eff. Fixed spec_k pins every slot at the cap, so the
+        width never moves and the step stays one trace; under 'auto'
+        the width only shrinks when EVERY live slot has shrunk."""
+        keff = np.full((self.n_slots,), self.spec_k, np.int32)
+        kmax = 1
+        for slot, live in self._live.items():
+            keff[slot] = live.k_eff or self.spec_k
+            kmax = max(kmax, int(keff[slot]))
+        return keff, prompt_bucket(kmax, self.spec_k, floor=1)
+
+    def _ngram_proposals(self, k_tick):
+        """Host-side prompt-lookup proposals for every live slot
+        (ISSUE 18): suffix-match each slot's full context (prompt +
+        emitted so far) and propose the k_tick tokens that literally
+        followed the previous occurrence. Returns ((n_slots, k_tick)
+        drafts, (n_slots,) tails — each slot's last sampled token, the
+        verify block's first input). Pure host arithmetic on ints; the
+        `ngram_hits` counter tallies per-slot-tick lookup hits."""
+        drafts = np.zeros((self.n_slots, k_tick), np.int32)
+        tails = np.zeros((self.n_slots,), np.int32)
+        hits = 0
+        for slot, live in self._live.items():
+            props, hit = ngram_propose(live.ctx, k_tick)
+            drafts[slot] = props
+            tails[slot] = live.tail
+            hits += int(hit)
+        self._reg.counter("ngram_hits").add(hits)
+        return drafts, tails
+
     def _step_slab(self):
         state = self._state
         V = self.pool.logits.shape[-1]
@@ -1013,7 +1223,7 @@ class Engine:
             k_eff = V if req.top_k is None else max(1, min(int(req.top_k), V))
             live = _Live(req)
             with span("serve_prefill", registry=self._reg):
-                if spec_on:
+                if spec_on and not self.ngram:
                     self.pool, self._dpool, tail = self._admit(
                         state, self.pool, self._dpool, self._dstate,
                         jnp.asarray(idx), jnp.int32(slot),
@@ -1023,38 +1233,69 @@ class Engine:
                     )
                     live.pending = [int(tail)]
                     self._stamp_admission_first_token(live, slot)
+                elif spec_on:
+                    # ngram: the SEQUENTIAL admit prefills the target,
+                    # then the pool-only seed fn samples the first
+                    # token (same rng split as the first sequential
+                    # tick — greedy bit-parity from token one)
+                    self.pool = self._admit(
+                        state, self.pool, jnp.asarray(idx), jnp.int32(slot),
+                        jnp.int32(t0 - 1), jax.random.key_data(req.rng),
+                        jnp.float32(req.temperature), jnp.int32(k_eff),
+                    )
+                    self.pool, tail = self._seed_tail(self.pool,
+                                                      jnp.int32(slot))
+                    live.pending = [int(tail)]
+                    live.ctx = list(req.prompt) + live.pending
+                    live.tail = int(tail)
+                    self._stamp_admission_first_token(live, slot)
                 else:
                     self.pool = self._admit(
                         state, self.pool, jnp.asarray(idx), jnp.int32(slot),
                         jnp.int32(t0 - 1), jax.random.key_data(req.rng),
                         jnp.float32(req.temperature), jnp.int32(k_eff),
                     )
+            if spec_on:
+                live.k_eff = self.spec_k
             self._live[slot] = live
 
         if self._live:
             active = np.zeros((self.n_slots,), bool)
             active[list(self._live)] = True
             t_tick = self._clock()
-            counts = None
+            counts = keff_arr = None
             with span("serve_decode", registry=self._reg):
                 if spec_on:
-                    toks, counts, self.pool, self._dpool = self._step_fn(
-                        state, self._dstate, self.pool, self._dpool,
-                        jnp.asarray(active))
+                    keff_arr, k_tick = self._tick_k()
+                    if self.ngram:
+                        drafts, tails = self._ngram_proposals(k_tick)
+                        toks, counts, self.pool = self._step_fn(
+                            state, self.pool, jnp.asarray(active),
+                            jnp.asarray(drafts), jnp.asarray(tails),
+                            jnp.asarray(keff_arr))
+                    else:
+                        toks, counts, self.pool, self._dpool = \
+                            self._step_fn(
+                                state, self._dstate, self.pool,
+                                self._dpool, jnp.asarray(active),
+                                jnp.asarray(keff_arr), k_tick)
                     toks = np.asarray(toks)   # the per-iteration D2H fence
                     counts = np.asarray(counts)
                 else:
                     toks, self.pool = self._step_fn(state, self.pool,
                                                     jnp.asarray(active))
                     toks = np.asarray(toks)  # the per-iteration D2H fence
-            self._harvest_tokens(toks, t_tick, finished, counts=counts)
+            self._harvest_tokens(toks, t_tick, finished, counts=counts,
+                                 k_eff=keff_arr)
         self._set_gauges()
         assert len(self.traces["prefill"]) <= len(self.sched.ladder), (
             "prefill compiles escaped the bucket ladder"
         )
-        assert len(self.traces["step"]) <= 1, (
-            "the decode step retraced — a slot-pool shape leaked"
+        assert len(self.traces["step"]) <= len(self._k_ladder), (
+            "the decode step retraced past the k ladder — a slot-pool "
+            "shape leaked"
         )
+        assert len(self.traces["seed"]) <= 1, "the ngram seed retraced"
         return finished
 
     def _step_paged(self):
@@ -1097,11 +1338,41 @@ class Engine:
         # admission first), so a long prompt spreads over ticks and can
         # never stall the co-tenants' decode dispatch below
         budget = self.prefill_chunk
+        model_draft = self.spec_decode == "draft" and not self.ngram
         for slot in list(pg.prefill):
             if budget <= 0:
                 break
             st = pg.prefill[slot]
             req = st.req
+            # spec × prefix sharing (ISSUE 18): a prefix hit starts the
+            # TARGET at plan.shared_len but the draft owns no shared
+            # pages — walk it through the skipped region with
+            # draft-only chunks first (charged to the same prefill
+            # budget; the draft is tiny, so this is a sliver of the
+            # shared-region savings). Combined chunks resume once the
+            # draft has caught up, keeping both models in lockstep.
+            while (model_draft and st.draft_next < st.next
+                   and budget > 0):
+                d_start = st.draft_next
+                d_n = min(budget, self.prefill_chunk,
+                          st.next - d_start)
+                if self._tr is not None:
+                    self._tr.emit(req.req_id, "prefill_chunk",
+                                  start=d_start, n=d_n, slot=slot,
+                                  draft=True)
+                t_pad = pg.chunk_bucket(d_n)
+                idx = np.zeros((1, t_pad), np.int32)
+                idx[0, :d_n] = req.prompt[d_start:d_start + d_n]
+                with span("serve_prefill", registry=self._reg):
+                    self._dpool = self._draft_chunk_fn(
+                        self._dpool, self._dstate, jnp.asarray(idx),
+                        jnp.int32(slot), jnp.int32(d_start),
+                        jnp.int32(d_n))
+                self._reg.counter("prefill_chunks").add(1)
+                st.draft_next = d_start + d_n
+                budget -= d_n
+            if budget <= 0:
+                break
             start = st.next
             n_real = min(budget, st.n_prompt - start)
             cow = pg.prepare_chunk(req.req_id, start, n_real)
@@ -1122,7 +1393,7 @@ class Engine:
             spec_on = self.spec_decode == "draft"
             tail = None
             with span("serve_prefill", registry=self._reg):
-                if spec_on:
+                if model_draft:
                     self.pool, self._dpool, tail = self._chunk_fn(
                         state, self.pool, self._dpool, self._dstate,
                         jnp.asarray(idx),
@@ -1144,6 +1415,7 @@ class Engine:
                     )
             self._reg.counter("prefill_chunks").add(1)
             st.next = start + n_real
+            st.draft_next = st.next   # combined chunks advance both
             budget -= n_real
             pg.register_progress(slot)
             if self.role == "prefill":
@@ -1161,10 +1433,19 @@ class Engine:
                 pg.finish_prefill(slot)
                 live = _Live(req)
                 if spec_on:
+                    if self.ngram:
+                        # sample the first token from the final chunk's
+                        # spliced logits (pool-only seed fn, one trace)
+                        self.pool, tail = self._seed_tail(
+                            self.pool, jnp.int32(slot))
                     # only the FINAL chunk's tail is real (earlier
                     # chunks' samples were idempotent overwrites) — one
                     # small D2H per finished prefill, never per token
                     live.pending = [int(tail)]
+                    if self.ngram:
+                        live.ctx = list(req.prompt) + live.pending
+                        live.tail = int(tail)
+                    live.k_eff = self.spec_k
                     self._stamp_admission_first_token(live, slot)
                 self._live[slot] = live
         if self._live:
@@ -1188,7 +1469,7 @@ class Engine:
             active = np.zeros((self.n_slots,), bool)
             active[list(self._live)] = True
             t_tick = self._clock()
-            counts = None
+            counts = keff_arr = None
             with span("serve_decode", registry=self._reg):
                 if spec_on:
                     # per-slot allocated token coverage: the write mask
@@ -1197,11 +1478,23 @@ class Engine:
                     for slot, rid in pg.rid_of.items():
                         limit[slot] = (len(pg.alloc.table(rid))
                                        * self.page_size)
-                    toks, counts, self.pool, self._dpool = self._step_fn(
-                        state, self._dstate, self.pool, self._dpool,
-                        jnp.asarray(active),
-                        jnp.asarray(pg.tables_array()),
-                        jnp.asarray(limit))
+                    keff_arr, k_tick = self._tick_k()
+                    if self.ngram:
+                        drafts, tails = self._ngram_proposals(k_tick)
+                        toks, counts, self.pool = self._step_fn(
+                            state, self.pool, jnp.asarray(active),
+                            jnp.asarray(drafts), jnp.asarray(tails),
+                            jnp.asarray(keff_arr),
+                            jnp.asarray(pg.tables_array()),
+                            jnp.asarray(limit))
+                    else:
+                        toks, counts, self.pool, self._dpool = \
+                            self._step_fn(
+                                state, self._dstate, self.pool,
+                                self._dpool, jnp.asarray(active),
+                                jnp.asarray(pg.tables_array()),
+                                jnp.asarray(limit),
+                                jnp.asarray(keff_arr), k_tick)
                     toks = np.asarray(toks)
                     counts = np.asarray(counts)
                 else:
@@ -1209,7 +1502,8 @@ class Engine:
                         state, self.pool, jnp.asarray(active),
                         jnp.asarray(pg.tables_array()))
                     toks = np.asarray(toks)  # the per-iteration D2H fence
-            self._harvest_tokens(toks, t_tick, finished, counts=counts)
+            self._harvest_tokens(toks, t_tick, finished, counts=counts,
+                                 k_eff=keff_arr)
         self._set_gauges()
         a = pg.alloc.stats()
         self._reg.gauge("kv_pages_free").set(a["free"] + a["cached"])
@@ -1218,10 +1512,14 @@ class Engine:
         assert len(self.traces["prefill"]) <= len(pg.chunk_ladder), (
             "prefill-chunk compiles escaped the chunk ladder"
         )
-        assert len(self.traces["step"]) <= 1, (
-            "the paged decode step retraced — a shape leaked (page "
-            "tables must ride as traced arguments)"
+        assert len(self.traces["draft_prefill"]) <= len(pg.chunk_ladder), (
+            "draft-catch-up compiles escaped the chunk ladder"
         )
+        assert len(self.traces["step"]) <= len(self._k_ladder), (
+            "the paged decode step retraced past the k ladder — a "
+            "shape leaked (page tables must ride as traced arguments)"
+        )
+        assert len(self.traces["seed"]) <= 1, "the ngram seed retraced"
         assert len(self.traces["cow"]) <= 1, "the COW copy retraced"
         assert len(self.traces["import"]) <= len(
             getattr(self, "_import_ladder", ())), (
@@ -1417,7 +1715,19 @@ class Engine:
             self._tr.emit(live.req.req_id, "first_token", t=now,
                           slot=slot, admission=True)
 
-    def _harvest_tokens(self, toks, t_tick, finished, counts=None):
+    # adaptive spec_k (ISSUE 18): per-slot accept-rate EWMA weight and
+    # the rung-walk thresholds — shrink a rung when the smoothed accept
+    # rate can't keep the wider verify worthwhile, grow one back when
+    # nearly everything is accepted. The floor is the ladder's first
+    # rung (k=1): speculation never turns OFF, it degrades to the
+    # cheapest width — which is what the accept_rate_collapse runbook
+    # row means by "the adaptive-k floor" (docs/OPERATIONS.md).
+    _K_EWMA = 0.3
+    _K_SHRINK_BELOW = 0.35
+    _K_GROW_ABOVE = 0.8
+
+    def _harvest_tokens(self, toks, t_tick, finished, counts=None,
+                        k_eff=None):
         """Post-decode harvest shared by both KV impls: per-slot token
         append/detokenize, stop/budget checks, then deadline eviction
         AFTER harvest — this iteration's token is kept (the request
@@ -1440,18 +1750,40 @@ class Engine:
             del self._tick_s[:32]
         tr = self._tr
         n_live = len(self._live)
-        spec_accepted = 0
+        spec_accepted = spec_proposed = 0
         if counts is not None:
             # accepted DRAFT tokens this tick (the bonus/correction
-            # token is target-sampled, not a draft acceptance)
+            # token is target-sampled, not a draft acceptance);
+            # proposed = the sum of per-slot EFFECTIVE k (ISSUE 18) —
+            # with fixed spec_k that is spec_k * n_live, as ever
             spec_accepted = int(sum(int(counts[s]) - 1
                                     for s in self._live))
-            self._reg.counter("spec_proposed").add(self.spec_k * n_live)
+            spec_proposed = int(sum(int(k_eff[s]) for s in self._live))
+            self._reg.counter("spec_proposed").add(spec_proposed)
             self._reg.counter("spec_accepted").add(spec_accepted)
             prop = self._reg.counter("spec_proposed").total
             acc = self._reg.counter("spec_accepted").total
             self._reg.gauge("spec_accept_rate").set(
                 acc / prop if prop else 0.0)
+            self._reg.gauge("spec_k_effective").set(
+                spec_proposed / n_live if n_live else 0.0)
+            if self.spec_k_auto:
+                # rung walk BEFORE any slot finishes below: each live
+                # slot smooths its own accept rate and moves one ladder
+                # rung at most per tick (floor k=1, cap spec_k)
+                for s in self._live:
+                    live = self._live[s]
+                    rate = (int(counts[s]) - 1) / max(int(k_eff[s]), 1)
+                    live.acc_ewma = (
+                        rate if live.acc_ewma is None else
+                        (1 - self._K_EWMA) * live.acc_ewma
+                        + self._K_EWMA * rate)
+                    i = self._k_ladder.index(live.k_eff)
+                    if (live.acc_ewma < self._K_SHRINK_BELOW and i > 0):
+                        live.k_eff = self._k_ladder[i - 1]
+                    elif (live.acc_ewma > self._K_GROW_ABOVE
+                          and i + 1 < len(self._k_ladder)):
+                        live.k_eff = self._k_ladder[i + 1]
         # decode ticks ever == batched model passes (the denominator of
         # the effective tokens-per-model-pass headline, tools/
         # bench_decode.py) — counted with or without tracing
@@ -1464,8 +1796,12 @@ class Engine:
                         n_live=n_live, tick=self._tick_n)
                 if counts is not None:
                     tr.emit(None, "spec_verify", t=now,
-                            proposed=self.spec_k * n_live,
-                            accepted=spec_accepted, tick=self._tick_n)
+                            proposed=spec_proposed,
+                            accepted=spec_accepted, tick=self._tick_n,
+                            spec_draft_source=(
+                                "ngram" if self.ngram else "model"),
+                            k_eff=(spec_proposed / n_live
+                                   if n_live else 0.0))
         emitted_total = 0
         for slot in sorted(self._live):
             live = self._live[slot]
@@ -1474,7 +1810,17 @@ class Engine:
             else:
                 seq = list(live.pending)
                 live.pending = []
-                seq += [int(t) for t in toks[slot][:int(counts[slot])]]
+                new = [int(t) for t in toks[slot][:int(counts[slot])]]
+                if live.ctx is not None:
+                    # ngram: the lookup context tracks every sampled
+                    # token (pending tokens are already in it), while
+                    # `tail` — the next verify block's first input —
+                    # advances from the harvest itself, so ctx stays a
+                    # pure proposer hint
+                    live.ctx.extend(new)
+                    if new:
+                        live.tail = new[-1]
+                seq += new
             for tok in seq:
                 live.emitted.append(tok)
                 emitted_total += 1

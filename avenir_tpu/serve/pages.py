@@ -822,6 +822,13 @@ class _PrefillState:
         self.n_prompt = len(req.prompt)
         self.next = plan.shared_len
         self.reg_upto = len(plan.shared_pages)
+        # spec × prefix sharing (ISSUE 18): next prompt position the
+        # DRAFT model has computed. Starts at 0, not shared_len — the
+        # draft has no shared-page store, so on a prefix hit the engine
+        # walks it through the skipped region with draft-only chunks
+        # before combined chunks resume (ngram drafts have no KV and
+        # ignore this cursor entirely)
+        self.draft_next = 0
         # disaggregated export progress (role='prefill' engines): next
         # page slot to SHIP once fully covered by prompt tokens. Starts
         # at 0, not shared_len — locally prefix-hit pages still ship

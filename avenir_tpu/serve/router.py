@@ -306,10 +306,13 @@ class Router:
             assert self._engine_kwargs.get("prefix_sharing", True), (
                 "disaggregation splices transferred pages through the "
                 "prefix chain — prefix_sharing must stay on")
-            assert self._engine_kwargs.get("spec_decode", "off") in (
-                None, "off"), (
-                "spec_decode is incompatible with disaggregation (the "
-                "draft slab cannot ride a page transfer)")
+            # spec × disagg (ISSUE 18): no assertion anymore — the
+            # draft never rides a page transfer. Decode-class replicas
+            # run propose/verify on chains spliced via import_chain,
+            # and the draft seeds from the SHIPPED PROMPT (draft-only
+            # catch-up chunks over the imported prefix, or no draft KV
+            # at all for draft_model='ngram'); prefill-class replicas
+            # get the spec knobs stripped in _make_replica.
         self.disagg_min_prompt = (
             int(disagg_min_prompt) if disagg_min_prompt is not None
             else int(self._engine_kwargs.get("prefill_chunk")
@@ -323,7 +326,12 @@ class Router:
             self._spec = model_spec if model_spec is not None \
                 else model_spec_from_model(model)
             self._pk = dict(proc_kwargs or {})
-            if draft_model is not None and "draft_spec" not in self._pk:
+            # draft_model='ngram' (ISSUE 18) is a string, not a model:
+            # nothing to spec — it rides the engine kwargs instead
+            # (_make_replica), so the hello ships NO second model
+            if (draft_model is not None
+                    and not isinstance(draft_model, str)
+                    and "draft_spec" not in self._pk):
                 self._pk["draft_spec"] = model_spec_from_model(draft_model)
             self.replicas = [
                 self._make_replica(
@@ -397,8 +405,25 @@ class Router:
         the process backend's hello carries it unchanged."""
         ekw = dict(self._engine_kwargs)
         self._role[i] = role
+        pk = self._pk
         if role == "prefill":
             ekw["role"] = "prefill"
+            # spec × disagg (ISSUE 18): speculation is a decode-class
+            # concern — a prefill replica never decodes, so it gets the
+            # spec knobs (and the draft weights, for the process
+            # backend's hello) stripped instead of the whole fleet
+            # being asserted spec-off at construction
+            for k in ("spec_decode", "spec_k", "draft_model"):
+                ekw.pop(k, None)
+            if "draft_spec" in pk:
+                pk = {k: v for k, v in pk.items() if k != "draft_spec"}
+        elif (isinstance(self._draft_model, str)
+              and self.backend == "process"):
+            # the draft-free self-draft is a knob, not a model: ride
+            # the engine kwargs so the process worker's Engine ctor
+            # sees it without a model spec in the hello (the in-process
+            # Replica takes the string through its draft_model param)
+            ekw["draft_model"] = self._draft_model
         if prewarm:
             ekw["prewarm"] = True
         trace = (self.tracer.decode_sample
@@ -410,11 +435,12 @@ class Router:
                                sink=self.sink, clock=self._clock,
                                defer_handshake=defer_handshake,
                                engine_kwargs=ekw, trace=trace,
-                               **self._rep_cfg, **self._pk)
+                               **self._rep_cfg, **pk)
+        draft = None if role == "prefill" else self._draft_model
         return Replica(self._model, i, registry=self._reg,
                        sink=self.sink, clock=self._clock,
                        engine_kwargs=ekw, trace=trace,
-                       draft_model=self._draft_model, **self._rep_cfg)
+                       draft_model=draft, **self._rep_cfg)
 
     # ---- fleet elasticity (the autoscaler's actuators, ISSUE 12) ----
 

@@ -146,8 +146,12 @@ def _serve(stream):
     # error REPLY: the parent's handshake fails loud with the reason
     # instead of a pipe EOF (docs/OPERATIONS.md failure matrix)
     try:
+        # draft_model='ngram' (ISSUE 18) is a STRING riding the engine
+        # kwargs — the draft-free self-draft ships no second model in
+        # the hello at all, which is the point
         draft = (_build_model(hello["draft"])
-                 if hello.get("draft") is not None else None)
+                 if hello.get("draft") is not None
+                 else ekw.get("draft_model"))
         engine = Engine(
             _build_model(hello["model"]),
             n_slots=int(ekw.get("n_slots", 4)),

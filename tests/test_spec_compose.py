@@ -1,0 +1,496 @@
+"""Speed-knob composition tests (ISSUE 18): spec decode × prefix
+sharing × disaggregation × the KV CDN all stack, the n-gram self-draft
+serves without a second model, and adaptive spec_k walks the compiled
+k ladder without new traces. The oracle everywhere: greedy engine
+output is BIT-identical to sequential `generate_cached` for ANY draft
+— model or ngram — whatever other knobs are on; a desynced/garbage
+draft costs speed, never correctness.
+
+Budget notes (the test_serve_router discipline): one module-scoped
+tiny GPT + one-shot references; the tier-1 set keeps engines small and
+shares fixtures; full-stack router fleets + the process backend are
+slow-marked."""
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import nnx
+
+from avenir_tpu.infer.decode import generate_cached
+from avenir_tpu.infer.spec import ngram_propose, ngram_q_logits
+from avenir_tpu.models.gpt import GPT, GPTConfig
+from avenir_tpu.obs import MetricsRegistry
+from avenir_tpu.serve import Engine, Router
+
+GPT_TINY = GPTConfig(block_size=128, vocab_size=64, n_layer=1, n_head=2,
+                     n_embd=32, dropout=0.0, bias=True, attn_impl="xla")
+MAX_NEW = 4
+PAGE = 4
+# router fleets use the test_disagg geometry: a "long" prompt is ~2
+# chunks, several exportable pages
+RPAGE, RCHUNK = 8, 16
+REKW = {"kv_impl": "paged", "page_size": RPAGE, "prefill_chunk": RCHUNK}
+
+
+@pytest.fixture(scope="module")
+def models():
+    return (GPT(GPT_TINY, rngs=nnx.Rngs(0)),
+            GPT(GPT_TINY, rngs=nnx.Rngs(5)))
+
+
+def _greedy_reqs(model, rng, n, *, prefix=(), lo=3, hi=10, key_base=3000,
+                 max_new=MAX_NEW):
+    """n top_k=1 requests (optionally sharing `prefix`) with one-shot
+    greedy references."""
+    reqs = []
+    for i in range(n):
+        tail = [int(t) for t in rng.integers(0, 64, (int(rng.integers(
+            lo, hi)),))]
+        prompt = list(prefix) + tail
+        kw = dict(prompt=prompt, max_new_tokens=max_new, temperature=1.0,
+                  top_k=1, rng=jax.random.key(key_base + i))
+        y = np.asarray(generate_cached(
+            model, kw["rng"], jnp.asarray(prompt, jnp.int32)[None],
+            max_new, temperature=1.0, top_k=1))[0]
+        reqs.append((kw, [int(t) for t in y]))
+    return reqs
+
+
+def _run_all(engine, reqs, bursts):
+    ids, results, pending = {}, {}, list(range(len(reqs)))
+    bursts = list(bursts)
+    while pending or engine.open_work:
+        take = bursts.pop(0) if bursts else len(pending)
+        for _ in range(min(take, len(pending))):
+            i = pending.pop(0)
+            kw, _ = reqs[i]
+            ids[engine.submit(**kw)] = i
+        for f in engine.step():
+            results[ids[f.req_id]] = f
+    return results
+
+
+def _assert_parity(results, reqs):
+    assert len(results) == len(reqs)
+    for i, (kw, ref) in enumerate(reqs):
+        got = results[i].tokens
+        assert got == ref, f"request {i} diverged:\n ref {ref}\n got {got}"
+
+
+def _submit_all(router, reqs):
+    return {router.submit(**kw): ref for kw, ref in reqs}
+
+
+def _assert_router_parity(done, refs):
+    for f in done:
+        assert f.tokens == refs[f.req_id], (
+            f"request {f.req_id} diverged:\n ref {refs[f.req_id]}\n "
+            f"got {f.tokens}")
+        assert f.finish_reason == "length"
+
+
+# ---------------------------------------------------------------------------
+# host-side units: the n-gram proposer and its point-mass q
+# ---------------------------------------------------------------------------
+
+
+def test_ngram_propose_lookup_and_fallback():
+    # suffix [1, 2] recurred at position 0 -> propose its continuation
+    drafts, hit = ngram_propose([1, 2, 3, 1, 2], 2)
+    assert (drafts, hit) == ([3, 1], True)
+    # longest n wins: suffix [2, 3] (n=2) beats the n=1 match
+    drafts, hit = ngram_propose([2, 3, 9, 2, 3], 1)
+    assert (drafts, hit) == ([9], True)
+    # most RECENT earlier occurrence wins when the n-gram repeats
+    drafts, hit = ngram_propose([1, 5, 1, 7, 1], 1)
+    assert (drafts, hit) == ([7], True)
+    # a match whose continuation runs off the end pads with ctx[-1]
+    drafts, hit = ngram_propose([4, 8, 4, 8], 3)
+    assert hit is True and drafts == [4, 8, 8]
+    # no recurrence -> last-token repeats, no hit
+    drafts, hit = ngram_propose([1, 2, 3], 2)
+    assert (drafts, hit) == ([3, 3], False)
+
+
+def test_ngram_q_logits_is_point_mass():
+    q = ngram_q_logits(jnp.asarray([[3, 7]], jnp.int32), 16)
+    p = np.asarray(jax.nn.softmax(q, axis=-1))
+    assert p.shape == (1, 2, 16)
+    assert p[0, 0, 3] == pytest.approx(1.0)
+    assert p[0, 1, 7] == pytest.approx(1.0)
+    assert np.count_nonzero(p) == 2
+
+
+def test_unknown_draft_model_string_fails_loud(models):
+    model, _ = models
+    with pytest.raises(ValueError, match="ngram"):
+        Engine(model, n_slots=1, max_seq_len=32,
+               registry=MetricsRegistry(), spec_decode="draft",
+               draft_model="bogus")
+
+
+# ---------------------------------------------------------------------------
+# tier-1 compose smoke: spec × prefix sharing on one paged engine
+# ---------------------------------------------------------------------------
+
+
+def test_compose_smoke_spec_sharing_parity(models):
+    """The CI compose cell: a paged engine with spec AND prefix sharing
+    on serves 8 requests — half sharing a multi-page prefix — with
+    greedy output bit-identical to `generate_cached`. The prefix HITS
+    must actually happen, and the draft's catch-up chunks must fire
+    (the draft-only re-prefill of the shared span)."""
+    model, draft = models
+    reg = MetricsRegistry()
+    engine = Engine(model, n_slots=4, max_seq_len=32, registry=reg,
+                    kv_impl="paged", page_size=PAGE, prefill_chunk=8,
+                    spec_decode="draft", spec_k=2, draft_model=draft)
+    rng = np.random.default_rng(7)
+    prefix = [int(t) for t in rng.integers(0, 64, (9,))]
+    reqs = (_greedy_reqs(model, rng, 4, prefix=prefix, lo=2, hi=5)
+            + _greedy_reqs(model, rng, 4, key_base=3100))
+    results = _run_all(engine, reqs, bursts=[3, 2, 1, 2])
+    _assert_parity(results, reqs)
+    assert engine._paged.alloc.prefix_sharing is True
+    assert engine._paged.alloc.prefix_hits >= 1, "no prefix hit landed"
+    assert len(engine.traces["draft_prefill"]) >= 1, (
+        "a prefix hit with spec on must run the draft-only catch-up "
+        "chunk")
+    assert len(engine.traces["step"]) <= len(engine._k_ladder)
+    engine._paged.audit(expect_empty=True)
+
+
+# ---------------------------------------------------------------------------
+# the n-gram self-draft: parity, zero model-draft state, obs
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kv_impl", ["slab", "paged"])
+def test_ngram_greedy_parity_both_layouts(models, kv_impl):
+    """draft_model='ngram' serves greedy output bit-identical to
+    `generate_cached` on both KV layouts with NO second model: no draft
+    pool, no draft state, zero model-draft traces — and under the paged
+    layout prefix sharing stays on and hits compose for free."""
+    model, _ = models
+    reg = MetricsRegistry()
+    kw = ({"kv_impl": "paged", "page_size": PAGE, "prefill_chunk": 8}
+          if kv_impl == "paged" else {})
+    engine = Engine(model, n_slots=4, max_seq_len=32, registry=reg,
+                    spec_decode="draft", spec_k=3, draft_model="ngram",
+                    **kw)
+    rng = np.random.default_rng(11)
+    prefix = [int(t) for t in rng.integers(0, 64, (9,))]
+    reqs = (_greedy_reqs(model, rng, 3, prefix=prefix, lo=2, hi=5,
+                         key_base=4000)
+            + _greedy_reqs(model, rng, 3, key_base=4100))
+    results = _run_all(engine, reqs, bursts=[2, 2, 1])
+    _assert_parity(results, reqs)
+    # draft-free means draft-free: no pool, no split state, no traces
+    assert engine._dstate is None and engine._dgraphdef is None
+    assert engine._dpool is None
+    assert engine.traces["draft_prefill"] == []
+    assert len(engine.traces["seed"]) == 1
+    assert len(engine.traces["step"]) <= len(engine._k_ladder)
+    snap = reg.snapshot()["counters"]
+    assert "ngram_hits" in snap        # registered at construction
+    if kv_impl == "paged":
+        assert engine._paged.alloc.prefix_sharing is True
+        assert engine._paged.alloc.prefix_hits >= 1
+        engine._paged.audit(expect_empty=True)
+
+
+def test_ngram_sampled_distribution_matches_sequential(models):
+    """Distribution exactness for the point-mass q: ngram-drafted
+    sampled emissions match the sequential engine's frequencies
+    (TV-bounded like the model-draft pin; the first token is
+    bit-identical by construction — seeded from the prefill logits
+    with the sequential rng split)."""
+    model, _ = models
+    V, N, TOPK = 64, 192, 4
+    prompt = [3, 1, 4, 1, 5]
+    seq_eng = Engine(model, n_slots=8, max_seq_len=32,
+                     registry=MetricsRegistry())
+    ng_eng = Engine(model, n_slots=8, max_seq_len=32,
+                    registry=MetricsRegistry(), spec_decode="draft",
+                    spec_k=2, draft_model="ngram")
+
+    def collect(eng):
+        ids = {}
+        for i in range(N):
+            ids[eng.submit(prompt, max_new_tokens=3, temperature=1.0,
+                           top_k=TOPK, rng=jax.random.key(9000 + i))] = i
+        out = {}
+        while eng.open_work:
+            for f in eng.step():
+                out[ids[f.req_id]] = f.tokens[len(prompt):]
+        return [out[i] for i in range(N)]
+
+    seq, ng = collect(seq_eng), collect(ng_eng)
+    # position 0: bit-identical (same key split, same prefill logits)
+    assert [s[0] for s in seq] == [s[0] for s in ng]
+    for pos in (1, 2):
+        a = np.bincount([s[pos] for s in seq], minlength=V) / N
+        b = np.bincount([s[pos] for s in ng], minlength=V) / N
+        assert 0.5 * np.abs(a - b).sum() < 0.2, f"position {pos} drifted"
+
+
+def test_report_accept_line_names_draft_source_and_k_eff():
+    """obs_report's accept: line grows the draft source and the
+    effective depth — `ngram_hits` presence (registered at engine
+    construction) names the source, `spec_k_effective` the depth."""
+    from avenir_tpu.obs.report import format_report, summarize
+
+    def mk(counters, gauges):
+        return [
+            {"kind": "run_meta", "t": 1.0, "model_type": "gpt"},
+            {"kind": "request", "t": 1.5, "id": 0, "n_prompt": 3,
+             "n_out": 4, "finish_reason": "length", "ttft_ms": 1.0,
+             "tpot_ms": 0.5},
+            {"kind": "run_end", "t": 2.0, "counters": counters,
+             "gauges": gauges},
+        ]
+
+    rep = format_report(summarize(mk(
+        {"spec_proposed": 40.0, "spec_accepted": 30.0, "ngram_hits": 7.0},
+        {"spec_k_effective": 2.5})))
+    assert "ngram draft (7 lookup hits)" in rep
+    assert "k_eff 2.5" in rep
+    rep = format_report(summarize(mk(
+        {"spec_proposed": 40.0, "spec_accepted": 30.0},
+        {"spec_k_effective": 4.0})))
+    assert "model draft" in rep and "ngram" not in rep
+
+
+# ---------------------------------------------------------------------------
+# adaptive spec_k: the EWMA rung walk + the no-retrace pin
+# ---------------------------------------------------------------------------
+
+
+def test_adaptive_k_walks_down_and_never_retraces(models):
+    """spec_k='auto' against an adversarial (independent random) draft:
+    greedy accept is near zero, so the per-request EWMA walks every
+    slot down the k ladder to the floor (k=1 — speculation never turns
+    off). Every rung is a pre-declared bucket: the step-trace count is
+    bounded by the ladder, and a SECOND wave of requests compiles
+    NOTHING new (zero steady-state traces)."""
+    model, draft = models
+    reg = MetricsRegistry()
+    engine = Engine(model, n_slots=3, max_seq_len=48, registry=reg,
+                    spec_decode="draft", spec_k="auto",
+                    draft_model=draft)
+    assert engine.spec_k_auto and engine._k_ladder == (1, 2, 4)
+    rng = np.random.default_rng(13)
+    reqs = _greedy_reqs(model, rng, 3, key_base=5000, max_new=12)
+    results = _run_all(engine, reqs, bursts=[3])
+    _assert_parity(results, reqs)
+    n_traces = len(engine.traces["step"])
+    assert n_traces <= len(engine._k_ladder)
+    # the collapsed accept rate walked the fleet down the ladder
+    assert reg.snapshot()["gauges"]["spec_k_effective"] <= 2.0, (
+        "adaptive k never shrank against a draft with ~zero greedy "
+        "accept")
+    # steady state: a fresh wave re-walks the SAME rungs — zero compiles
+    reqs2 = _greedy_reqs(model, rng, 3, key_base=5100, max_new=12)
+    results = _run_all(engine, reqs2, bursts=[3])
+    _assert_parity(results, reqs2)
+    assert len(engine.traces["step"]) == n_traces, (
+        "adaptive k retraced at steady state")
+
+
+def test_spec_k_auto_rides_the_worker_kwarg_filter(models):
+    """spec_k='auto' is a string: it must survive the process worker's
+    hello kwarg filter and the router's engine_kwargs plumbing — pinned
+    cheaply at the Engine ctor (the hello IS the ctor)."""
+    model, draft = models
+    engine = Engine(model, n_slots=1, max_seq_len=32,
+                    registry=MetricsRegistry(), spec_decode="draft",
+                    spec_k="auto", draft_model=draft)
+    assert engine.spec_k == 4 and engine.spec_k_auto
+
+
+# ---------------------------------------------------------------------------
+# draft desync injection: a wrong draft NEVER costs correctness
+# ---------------------------------------------------------------------------
+
+
+def test_draft_desync_injection_keeps_greedy_parity(models):
+    """Mid-flight, scribble garbage over the ENTIRE draft KV slab (the
+    desync a lost page-transfer or stale splice would cause): proposals
+    collapse, greedy output stays bit-identical — the verify step only
+    ever trusts the target."""
+    model, draft = models
+    engine = Engine(model, n_slots=2, max_seq_len=32,
+                    registry=MetricsRegistry(), spec_decode="draft",
+                    spec_k=2, draft_model=draft)
+    rng = np.random.default_rng(17)
+    reqs = _greedy_reqs(model, rng, 2, key_base=6000, max_new=8)
+    ids = {engine.submit(**kw): i for i, (kw, _) in enumerate(reqs)}
+    results = {}
+    for f in engine.step():          # admission + first verify tick
+        results[ids[f.req_id]] = f
+    engine._dpool = engine._dpool._replace(
+        k=jnp.full_like(engine._dpool.k, 3.0),
+        v=jnp.full_like(engine._dpool.v, -3.0))
+    while engine.open_work:
+        for f in engine.step():
+            results[ids[f.req_id]] = f
+    _assert_parity(results, reqs)
+
+
+def test_ngram_ctx_desync_keeps_greedy_parity(models):
+    """Same contract for the self-draft: corrupt every live request's
+    lookup context mid-flight — proposals go garbage, emissions stay
+    bit-identical (the ctx feeds ONLY the proposer, never the output
+    stream)."""
+    model, _ = models
+    engine = Engine(model, n_slots=2, max_seq_len=32,
+                    registry=MetricsRegistry(), spec_decode="draft",
+                    spec_k=2, draft_model="ngram")
+    rng = np.random.default_rng(19)
+    reqs = _greedy_reqs(model, rng, 2, key_base=6100, max_new=8)
+    ids = {engine.submit(**kw): i for i, (kw, _) in enumerate(reqs)}
+    results = {}
+    for f in engine.step():
+        results[ids[f.req_id]] = f
+    for live in engine._live.values():
+        live.ctx[:] = [1] * len(live.ctx)
+    while engine.open_work:
+        for f in engine.step():
+            results[ids[f.req_id]] = f
+    _assert_parity(results, reqs)
+
+
+# ---------------------------------------------------------------------------
+# the full stack: spec × sharing × disagg × affinity, both backends
+# ---------------------------------------------------------------------------
+
+
+def _mk_fleet_reqs(model, rng, n, *, prefix, key_base=7000):
+    """Mixed fleet load: every other request is LONG (>= RCHUNK, so it
+    disagg-handoffs) and shares `prefix` (so affinity/pull engage);
+    the rest are short decode-class requests."""
+    reqs = []
+    for i in range(n):
+        if i % 2 == 0:
+            tail = [int(t) for t in rng.integers(0, 64, (
+                int(rng.integers(3, 8)),))]
+            prompt = list(prefix) + tail
+        else:
+            prompt = [int(t) for t in rng.integers(0, 64, (
+                int(rng.integers(3, 9)),))]
+        key = jax.random.key(key_base + i)
+        y = np.asarray(generate_cached(
+            model, key, jnp.asarray(prompt, jnp.int32)[None], MAX_NEW,
+            temperature=1.0, top_k=1))[0]
+        reqs.append((dict(prompt=prompt, max_new_tokens=MAX_NEW,
+                          temperature=1.0, top_k=1, rng=key),
+                     [int(t) for t in y]))
+    return reqs
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("draft_kind", ["model", "ngram"])
+def test_compose_full_stack_inproc_parity(models, draft_kind):
+    """THE composition oracle: spec decode + prefix sharing + disagg +
+    the KV CDN (affinity routing) ALL on, randomized arrivals, greedy
+    output bit-identical to one-shot generation for BOTH draft kinds.
+    Handoffs must actually happen (long prompts splice prefill-class
+    chains into decode-class pools and the draft seeds from the
+    shipped prompt)."""
+    model, draft = models
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=3, n_slots=2, max_seq_len=64,
+                    registry=reg, seed=0, n_prefill=1,
+                    cache_telescope=True, affinity=True,
+                    draft_model=(draft if draft_kind == "model"
+                                 else "ngram"),
+                    engine_kwargs=dict(REKW, spec_decode="draft",
+                                       spec_k=2))
+    rng = np.random.default_rng(23)
+    prefix = [int(t) for t in rng.integers(0, 64, (34,))]
+    reqs = _mk_fleet_reqs(model, rng, 6, prefix=prefix)
+    refs = {}
+    done = []
+    for i, (kw, ref) in enumerate(reqs):    # randomized arrivals
+        refs[router.submit(**kw)] = ref
+        if i % 2 == 1:
+            done.extend(router.step())
+    done.extend(router.drain())
+    assert len(done) == len(reqs)
+    _assert_router_parity(done, refs)
+    counters = reg.snapshot()["counters"]
+    assert counters["kv_transfers"] >= 1, "no disagg handoff happened"
+    assert counters["spec_proposed"] > 0, "spec never ran on the fleet"
+    # every terminal record comes from a DECODE replica (0 is prefill)
+    assert all(f.replica != 0 for f in done)
+    router.close()
+
+
+@pytest.mark.slow
+def test_compose_sigkill_mid_splice_inproc(models):
+    """A prefill-class replica dies AFTER pages shipped, mid-splice,
+    with spec + sharing + affinity on: the requests requeue, re-prefill
+    from prompt+rng on the decode class, and every output is
+    bit-identical — spec state (draft pool, k_eff EWMA) resets with the
+    re-prefill and re-adapts."""
+    model, draft = models
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=3, n_slots=2, max_seq_len=64,
+                    registry=reg, seed=0, n_prefill=1,
+                    cache_telescope=True, affinity=True,
+                    draft_model=draft,
+                    engine_kwargs=dict(REKW, spec_decode="draft",
+                                       spec_k=2))
+    rng = np.random.default_rng(29)
+    prefix = [int(t) for t in rng.integers(0, 64, (34,))]
+    reqs = _mk_fleet_reqs(model, rng, 4, prefix=prefix, key_base=7500)
+    refs = _submit_all(router, reqs)
+    done = []
+    for _ in range(2):
+        done.extend(router.step())
+    exported = reg.snapshot()["counters"].get("kv_pages_exported", 0)
+    assert exported > 0, "the kill must land MID-transfer"
+    router.kill_replica(0)
+    done.extend(router.drain())
+    assert len(done) == len(reqs)
+    _assert_router_parity(done, refs)
+    assert reg.snapshot()["counters"]["serve_failovers"] >= 1
+    assert not router._transfer, "transfer state leaked past failover"
+    router.close()
+
+
+@pytest.mark.slow
+def test_compose_full_stack_process_backend(models):
+    """The process-backend twin: REAL worker processes with spec +
+    sharing + disagg + affinity on and the n-gram self-draft (no draft
+    weights in any hello), plus a REAL SIGKILL to the prefill-class
+    worker mid-stream — parity holds end to end."""
+    model, _ = models
+    reg = MetricsRegistry()
+    router = Router(model, backend="process", n_replicas=3, n_slots=2,
+                    max_seq_len=64, registry=reg, seed=0, n_prefill=1,
+                    cache_telescope=True, affinity=True,
+                    draft_model="ngram", supervise=False,
+                    engine_kwargs=dict(REKW, spec_decode="draft",
+                                       spec_k=2))
+    try:
+        rng = np.random.default_rng(31)
+        prefix = [int(t) for t in rng.integers(0, 64, (34,))]
+        reqs = _mk_fleet_reqs(model, rng, 4, prefix=prefix,
+                              key_base=7800)
+        refs = _submit_all(router, reqs)
+        done = []
+        for _ in range(2):
+            done.extend(router.step())
+        os.kill(router.replicas[0].pid, signal.SIGKILL)
+        done.extend(router.drain())
+        assert len(done) == len(reqs)
+        _assert_router_parity(done, refs)
+        counters = reg.snapshot()["counters"]
+        assert counters["spec_proposed"] > 0
+    finally:
+        router.close()

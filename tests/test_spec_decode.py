@@ -156,9 +156,10 @@ def test_spec_greedy_bit_parity_paged(gpt_pair, paged_spec):
     results = _run_all(paged_spec, reqs, bursts=[2, 2, 1])
     _assert_parity(results, reqs)
     assert len(paged_spec.traces["step"]) == 1
-    # spec forces prefix sharing OFF (the draft has no shared-page
-    # store — it must forward the full prompt; docs/SERVING.md)
-    assert paged_spec._paged.alloc.prefix_sharing is False
+    # spec now COMPOSES with paged prefix sharing (the draft re-prefills
+    # shared spans through draft-only chunks; docs/SERVING.md) — the old
+    # forced-off wall is gone.
+    assert paged_spec._paged.alloc.prefix_sharing is True
     paged_spec._paged.audit(expect_empty=True)
 
 
