@@ -140,7 +140,7 @@ def _tied(model_family):
 
 def save_checkpoint(out_dir, *, params, opt_state, hyper, model_args,
                     iter_num, best_val_loss, config, model_family="gpt",
-                    keep_checkpoints=2):
+                    keep_checkpoints=2, data_state=None):
     """Write out_dir/ckpt.pt in the torch schema. `params` is the nnx Param
     State; `opt_state` the optax state; `hyper` carries the torch
     param_group hyperparams (lr, betas, eps, weight_decay).
@@ -212,6 +212,10 @@ def save_checkpoint(out_dir, *, params, opt_state, hyper, model_args,
         "config": dict(config),
         "model_family": model_family,
     }
+    if data_state is not None:
+        # streaming-loader consumption counts (DataLoader.resume_state);
+        # key absent in pre-streaming checkpoints, readers use .get
+        ckpt["data_state"] = data_state
     # every process materializes (collective per-leaf gathers); only the
     # coordinator writes the file
     # atomic: stream to .part, then rename — a crash or SIGKILL mid-write
@@ -765,7 +769,7 @@ def _local_replica0_shards(leaf):
 def save_checkpoint_sharded_async(out_dir, *, params, opt_state, hyper,
                                   model_args, iter_num, best_val_loss,
                                   config, model_family="gpt",
-                                  keep_checkpoints=2):
+                                  keep_checkpoints=2, data_state=None):
     """Pod-safe async checkpoint: zero collectives (see section comment).
     Snapshot semantics match save_checkpoint_async: device-side copies are
     taken on the calling thread (the train step donates its buffers), the
@@ -845,6 +849,8 @@ def save_checkpoint_sharded_async(out_dir, *, params, opt_state, hyper,
                 "best_val_loss": float(best_val_loss), "count": count,
                 "hyper": hyper, "model_args": model_args, "config": config,
                 "model_family": model_family,
+                # streaming-loader consumption counts (resume replay)
+                "data_state": data_state,
                 # {tree: {path: [((start, stop) per dim), ...]}} — what
                 # this FILE's body tiles, so a restoring process can skip
                 # files holding none of its addressable index ranges
@@ -1145,6 +1151,9 @@ def load_sharded_checkpoint(out_dir, meta_only=False, local_ranges=None,
     out = {k: headers[0][1][k] for k in
            ("iter_num", "best_val_loss", "count", "hyper", "model_args",
             "config", "model_family")}
+    # .get: sets written before the streaming loader carry no data_state
+    # (resume then derives its fast_forward plan from iter_num)
+    out["data_state"] = headers[0][1].get("data_state")
     if meta_only:
         return out
     # Locality (advisor r5): with `local_ranges` only intersecting files

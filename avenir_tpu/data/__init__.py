@@ -1,1 +1,15 @@
-from avenir_tpu.data.loader import DataLoader
+from avenir_tpu.data.loader import DataLoader, read_wire_format, write_token_file
+from avenir_tpu.data.streaming import (
+    load_manifest,
+    parse_data_mix,
+    write_token_shards,
+)
+
+__all__ = [
+    "DataLoader",
+    "load_manifest",
+    "parse_data_mix",
+    "read_wire_format",
+    "write_token_file",
+    "write_token_shards",
+]

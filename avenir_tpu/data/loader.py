@@ -1,12 +1,20 @@
-"""Multi-host memmap data loader (SURVEY.md §2b T8).
+"""Multi-host memmap data loader (SURVEY.md §2b T8 + ISSUE 19 streaming).
 
 Same on-disk contract as the torch trainer's get_batch (train.py:144-161):
 token memmaps, random crops of block_size+1. Made multi-host aware
-the jax way: every process samples its OWN disjoint stream of crops from
-the full local file (the corpus is replicated on each host's disk), and
-`jax.make_array_from_process_local_data` assembles the per-process shards
-into one global jax.Array laid out by the batch sharding — no host ever
-materializes the global batch.
+the jax way: every process samples its OWN disjoint stream of crops,
+and `jax.make_array_from_process_local_data` assembles the per-process
+shards into one global jax.Array laid out by the batch sharding — no
+host ever materializes the global batch.
+
+Corpus layouts (resolved per split by data/streaming.SplitSource):
+  - legacy single file `<split>.bin` — replicated on every host's disk,
+    every process samples the full file. Byte-identical behavior to the
+    pre-streaming loader (same rng stream, same crops).
+  - sharded directory `<split>.shards/` — many v2-wire shard files plus
+    a MANIFEST.json; process p of P owns the contiguous shard range
+    [p*S/P, (p+1)*S/P) (the checkpoint `local_shard_ranges` locality
+    design), so a pod host never reads a peer's files.
 
 Wire formats (ISSUE 15 satellite — the config ladder's upper rungs):
   - legacy: a raw headerless uint16 memmap (the nanoGPT .bin contract;
@@ -21,10 +29,19 @@ Wire formats (ISSUE 15 satellite — the config ladder's upper rungs):
 Both forms ride the H2D wire in their on-disk dtype; the jit'd step
 widens to int32 on device (train/step.py).
 
-The memmap is re-opened per batch, matching the reference's defense against
+Weighted multi-corpus mixing (`mix='owt:0.7,code:0.3'`, ISSUE 19): each
+crop picks its corpus from a DEDICATED per-process selection stream
+(fixed consumption: n uniform doubles per batch, independent of the
+weights), then draws its position from that corpus's OWN rng — so
+mixture weights can change across a relaunch without desyncing any
+corpus's stream, and kill-resume replays from the checkpointed
+per-corpus draw counts (`resume_state`/`fast_forward_state`).
+
+Files are re-opened per batch, matching the reference's defense against
 the np.memmap leak (train.py:145-147).
 """
 
+import collections
 import os
 import threading
 import time
@@ -53,7 +70,10 @@ def write_token_file(path, tokens, vocab_size=None):
     """Write a token array in the narrowest wire form that fits:
     legacy raw uint16 when the vocab does (bit-compatible with every
     existing .bin consumer incl. the torch trainer), the v2
-    header + uint32 form otherwise. Returns the numpy dtype written."""
+    header + uint32 form otherwise. Returns the numpy dtype written.
+    The sharded twin for streaming corpora is
+    data/streaming.write_token_shards (same dtype policy, one manifest
+    per split directory)."""
     tokens = np.asarray(tokens)
     hi = int(vocab_size) if vocab_size is not None else (
         int(tokens.max()) + 1 if tokens.size else 0)
@@ -104,40 +124,75 @@ def read_wire_format(path):
     return np.dtype(_DTYPE_CODES[code]), WIRE_HEADER_BYTES
 
 
+# a replay chunk bound: fast-forward draws in slices of this many crops
+# so resuming a long run never materializes a giant index array
+_REPLAY_CHUNK = 1 << 20
+
+
 class DataLoader:
     def __init__(self, data_dir, block_size, batch_size, *, sharding=None,
-                 grad_accum=1, seed=0, flat=False, vocab_size=None):
+                 grad_accum=1, seed=0, flat=False, vocab_size=None,
+                 mix=None, prefetch_depth=1):
         """`batch_size` is the GLOBAL batch size in sequences per micro-step;
         each call to get_batch returns (grad_accum, B, T) stacked micro
         batches as a sharded global array (leading accum dim unsharded).
         `flat=True` (eval): grad_accum must be 1 and batches are (B, T).
-        `vocab_size` (when known) is validated against the uint16 wire
-        format — a Llama-3-sized 128k vocab must fail loud HERE instead of
-        silently wrapping ids modulo 65536 (ADVICE r5)."""
+        `vocab_size` (when known) is validated against the wire format of
+        every corpus — a Llama-3-sized 128k vocab must fail loud HERE
+        instead of silently wrapping ids modulo 65536 (ADVICE r5).
+        `mix` ('name:weight,...' or [(name, weight), ...]) blends crops
+        from several corpus dirs, resolved relative to `data_dir`'s
+        parent. `prefetch_depth` >= 2 replaces the depth-1 double buffer
+        with a persistent background pipeline staging up to
+        depth x window batches ahead."""
+        from avenir_tpu.data.streaming import parse_data_mix, resolve_corpus_dir
+
         self.data_dir = data_dir
         self.block_size = block_size
         self.batch_size = batch_size
         self.grad_accum = grad_accum
         self.sharding = sharding
         self.flat = flat
+        self.seed = seed
         self._reg = get_registry()
         assert not (flat and grad_accum != 1)
         self.vocab_size = vocab_size
-        self._wire = {}  # split -> (dtype, byte offset), header-sniffed once
-        if vocab_size is not None:
-            # fail loud HERE, not mid-run: the train file's wire format
-            # must fit the vocab (ADVICE r5). The v2 uint32 form is what
-            # lets Llama-3's 128k vocab pass this gate.
-            train_bin = os.path.join(data_dir, "train.bin")
-            if os.path.exists(train_bin):
-                self._wire_format("train")
+        self.prefetch_depth = int(prefetch_depth)
+        assert self.prefetch_depth >= 1, "prefetch_depth must be >= 1"
+        self._sources = {}  # (corpus name | None, split) -> SplitSource
         n_proc = jax.process_count()
         assert batch_size % n_proc == 0, (
             f"global batch {batch_size} must divide over {n_proc} processes"
         )
         self.local_batch = batch_size // n_proc
-        # disjoint per-process stream
+        # disjoint per-process stream (single-corpus path: UNCHANGED
+        # seeding, the bit-identity anchor for every legacy data/ dir)
         self.rng = np.random.default_rng(seed + 1000 * jax.process_index())
+        if mix:
+            parsed = (parse_data_mix(mix) if isinstance(mix, str)
+                      else [(str(n), float(w)) for n, w in mix])
+            total = sum(w for _, w in parsed)
+            self._mix = [(n, w / total) for n, w in parsed]
+            self._mix_dirs = {n: resolve_corpus_dir(n, data_dir)
+                              for n, _ in self._mix}
+            self._cuts = np.cumsum([w for _, w in self._mix])
+            # the selection stream: ITS consumption is n doubles per
+            # batch whatever the weights, so replay needs only the count
+            self._sel_rng = np.random.default_rng(
+                [seed, jax.process_index(), 0x5E1EC7ED])
+            self._rngs = {}  # (name, split) -> per-corpus sampling rng
+        else:
+            self._mix = None
+        if vocab_size is not None:
+            # fail loud HERE, not mid-run: every corpus's train wire
+            # format must fit the vocab (ADVICE r5). The v2 uint32 form
+            # is what lets Llama-3's 128k vocab pass this gate.
+            if self._mix is not None:
+                for name, _ in self._mix:
+                    self._source("train", name)
+            elif (os.path.exists(os.path.join(data_dir, "train.bin"))
+                  or os.path.isdir(os.path.join(data_dir, "train.shards"))):
+                self._source("train")
         # background prefetch (ISSUE 3 satellite): after each window the
         # loader stages the NEXT window's memmap crops on a daemon
         # thread, so the fancy-indexing overlaps device compute instead
@@ -150,46 +205,73 @@ class DataLoader:
         self._buf_split = None
         self._prefetch_thread = None
         self._prefetch_error = None
+        # deep pipeline (prefetch_depth >= 2): a persistent worker
+        # (data/streaming.Prefetcher), engaged by the first window call
+        self._deep = None
+        self._deep_split = None
+        # pop-time consumption accounting for checkpointed resume
+        # (ISSUE 19): prefetch stages rng draws AHEAD of consumption,
+        # so the resume point is what was POPPED, not the rng position.
+        # _sample_local pushes one stats entry per staged batch; _account
+        # pops one per batch handed to the caller.
+        self._stats_fifo = collections.deque()
+        self._consumed = {"batches": {}, "sel_draws": 0, "crops": {}}
 
-    def _wire_format(self, split):
-        """Header-sniffed (dtype, offset) of one split's token file,
-        cached (the file's layout cannot change mid-run), with the
-        vocab-fits-the-wire fail-loud applied on first sight."""
-        cached = self._wire.get(split)
-        if cached is not None:
-            return cached
-        dtype, offset = read_wire_format(
-            os.path.join(self.data_dir, f"{split}.bin"))
-        cap = int(np.iinfo(dtype).max) + 1
-        assert self.vocab_size is None or self.vocab_size <= cap, (
-            f"vocab_size={self.vocab_size} does not fit {split}.bin's "
-            f"{dtype.name} wire/on-disk token format (max {cap}); token "
-            "ids would wrap silently — regenerate the corpus with "
-            "write_token_file (the v2 uint32 form) before such a vocab "
-            "can run"
-        )
-        self._wire[split] = (dtype, offset)
-        return dtype, offset
+    # ---- sources & rngs ---------------------------------------------------
+
+    def _source(self, split, corpus=None):
+        """SplitSource for (corpus, split), built once (a file's layout
+        cannot change mid-run) with the vocab-fits-the-wire fail-loud
+        applied on first sight."""
+        from avenir_tpu.data.streaming import SplitSource
+
+        key = (corpus, split)
+        src = self._sources.get(key)
+        if src is None:
+            d = self.data_dir if corpus is None else self._mix_dirs[corpus]
+            src = SplitSource(d, split, self.block_size,
+                              vocab_size=self.vocab_size)
+            self._sources[key] = src
+        return src
+
+    def _corpus_rng(self, name, split):
+        """Each corpus split keeps its OWN sampling rng (seeded off the
+        corpus name, not the mix position), so adding/reweighting
+        corpora never desyncs another corpus's stream."""
+        from avenir_tpu.data.streaming import corpus_seed_tag
+
+        key = (name, split)
+        r = self._rngs.get(key)
+        if r is None:
+            r = np.random.default_rng(
+                [self.seed, jax.process_index(),
+                 corpus_seed_tag(name), corpus_seed_tag(split)])
+            self._rngs[key] = r
+        return r
+
+    def _mix_parts(self, split):
+        return [(name, self._source(split, name),
+                 self._corpus_rng(name, split))
+                for name, _ in self._mix]
+
+    # ---- sampling ---------------------------------------------------------
 
     def _sample_local(self, split):
         n = self.grad_accum * self.local_batch
+        if self._mix is not None:
+            return self._sample_mixed(split, n)
+        src = self._source(split)
         # the rng draw happens ONCE, before the (retryable) file reads:
         # a flaky read retried by call_with_retry must re-read the SAME
         # crops, or the consumed rng stream would depend on how flaky
         # the storage was (breaking the deterministic-resume contract)
         ix = None
-        dtype, offset = self._wire_format(split)
 
         def read():
             nonlocal ix
-            get_injector().fail("data_read_fail", what=f"{split}.bin")
-            arr = np.memmap(
-                os.path.join(self.data_dir, f"{split}.bin"),
-                dtype=dtype, mode="r", offset=offset,
-            )
+            get_injector().fail("data_read_fail", what=src.what)
             if ix is None:
-                ix = self.rng.integers(0, len(arr) - self.block_size,
-                                       size=n)
+                ix = self.rng.integers(0, src.n_positions, size=n)
             # tokens stay in the file's narrow dtype ON THE WIRE (uint16
             # legacy, uint32 for >65536 vocabs) — the jit'd step casts to
             # int32 on device (train/step.py), halving H2D bytes per
@@ -197,16 +279,63 @@ class DataLoader:
             # ~230ms of per-window transfer serialization at int32, the
             # dominant loop-vs-step-harness gap; pods pay the same
             # halving on DCN-attached hosts.
-            x = np.stack([arr[i : i + self.block_size] for i in ix])
-            y = np.stack([arr[i + 1 : i + 1 + self.block_size] for i in ix])
-            return x, y
+            return src.gather(ix)
 
-        x, y = call_with_retry(read, what=f"data read {split}.bin")
+        x, y = call_with_retry(read, what=f"data read {src.what}")
+        self._stats_fifo.append((split, None))
+        return self._shape(x, y)
+
+    def _sample_mixed(self, split, n):
+        parts = self._mix_parts(split)
+        drawn = None  # all rng consumption happens ONCE (retry contract)
+
+        def read():
+            nonlocal drawn
+            get_injector().fail("data_read_fail", what=f"{split}[mix]")
+            if drawn is None:
+                # per-CROP corpus selection: thresholding fixed uniform
+                # draws against the cumulative weights. Consumption is n
+                # doubles however the weights are set, so a re-weighted
+                # relaunch replays by COUNT alone.
+                u = self._sel_rng.random(n)
+                assign = np.minimum(
+                    np.searchsorted(self._cuts, u, side="right"),
+                    len(parts) - 1)
+                per = []
+                for c, (name, src, rng_c) in enumerate(parts):
+                    slots = np.nonzero(assign == c)[0]
+                    ixc = (rng_c.integers(0, src.n_positions,
+                                          size=slots.size)
+                           if slots.size else None)
+                    per.append((slots, ixc))
+                drawn = per
+            # widest wire dtype across the mix: one dtype per batch
+            wide = (np.dtype(np.uint32)
+                    if any(src.dtype.itemsize > 2 for _, src, _ in parts)
+                    else np.dtype(np.uint16))
+            x = np.empty((n, self.block_size), dtype=wide)
+            y = np.empty_like(x)
+            counts = {}
+            for (name, src, _), (slots, ixc) in zip(parts, drawn):
+                counts[name] = int(slots.size)
+                if slots.size:
+                    xc, yc = src.gather(ixc)
+                    x[slots] = xc
+                    y[slots] = yc
+            return x, y, counts
+
+        x, y, counts = call_with_retry(read, what=f"data read {split} mix")
+        self._stats_fifo.append((split, counts))
+        return self._shape(x, y)
+
+    def _shape(self, x, y):
         if self.flat:
             shape = (self.local_batch, self.block_size)
         else:
             shape = (self.grad_accum, self.local_batch, self.block_size)
         return x.reshape(shape), y.reshape(shape)
+
+    # ---- deterministic resume --------------------------------------------
 
     def fast_forward(self, plan):
         """Advance the sampling rng as if the draws had already happened:
@@ -217,19 +346,123 @@ class DataLoader:
         run's. The replay must use each split's REAL sampling bound —
         numpy's bounded-integer rejection sampling consumes a
         bound-dependent amount of the bit stream, so a dummy bound
-        would desync it."""
-        assert not self._buf and self._prefetch_thread is None, (
-            "fast_forward must run on a fresh loader (before any draw "
-            "or prefetch)"
-        )
+        would desync it. (Consumption is per-DRAW, independent of how
+        draws are grouped into calls, so the replay batches its calls.)
+        Mixed loaders replay the selection stream and derive per-corpus
+        counts under the CURRENT weights; a relaunch that changed the
+        weights must use fast_forward_state with the checkpointed
+        counts instead."""
+        self._assert_fresh("fast_forward")
         n = self.grad_accum * self.local_batch
         for split, count in plan:
-            dtype, offset = self._wire_format(split)
-            nbytes = os.path.getsize(
-                os.path.join(self.data_dir, f"{split}.bin")) - offset
-            hi = nbytes // dtype.itemsize - self.block_size
-            for _ in range(int(count)):
-                self.rng.integers(0, hi, size=n)
+            count = int(count)
+            if self._mix is not None:
+                self._replay_mixed(split, count, n)
+                continue
+            hi = self._source(split).n_positions
+            total = count * n
+            for start in range(0, total, _REPLAY_CHUNK):
+                self.rng.integers(0, hi,
+                                  size=min(_REPLAY_CHUNK, total - start))
+            b = self._consumed["batches"]
+            b[split] = b.get(split, 0) + count
+
+    def _replay_mixed(self, split, count, n):
+        parts = self._mix_parts(split)
+        crops = self._consumed["crops"].setdefault(split, {})
+        batches_per_chunk = max(1, _REPLAY_CHUNK // max(n, 1))
+        rem = count
+        while rem:
+            b = min(rem, batches_per_chunk)
+            u = self._sel_rng.random(b * n)
+            assign = np.minimum(
+                np.searchsorted(self._cuts, u, side="right"),
+                len(parts) - 1)
+            for c, (name, src, rng_c) in enumerate(parts):
+                kc = int((assign == c).sum())
+                if kc:
+                    rng_c.integers(0, src.n_positions, size=kc)
+                crops[name] = crops.get(name, 0) + kc
+            rem -= b
+        self._consumed["sel_draws"] += count * n
+        bt = self._consumed["batches"]
+        bt[split] = bt.get(split, 0) + count
+
+    def resume_state(self):
+        """Checkpointable consumption record: batches popped per split
+        and, for mixed loaders, selection draws + per-corpus crop counts
+        — tracked at buffer-POP time, because prefetch stages rng draws
+        AHEAD of consumption (a kill loses the staged-but-unconsumed
+        draws, and resume must not replay them). This is what rides the
+        checkpoint as `data_state`; `fast_forward_state` replays it on a
+        fresh loader even if the mixture weights changed in between."""
+        st = {"version": 1, "mixed": self._mix is not None,
+              "batches": {k: int(v)
+                          for k, v in self._consumed["batches"].items()}}
+        if self._mix is not None:
+            st["sel_draws"] = int(self._consumed["sel_draws"])
+            st["crops"] = {s: {k: int(v) for k, v in d.items()}
+                           for s, d in self._consumed["crops"].items()}
+        return st
+
+    def fast_forward_state(self, state):
+        """Replay a `resume_state` record on a fresh loader. For mixed
+        loaders the per-corpus counts come from the CHECKPOINT, not from
+        re-deriving the selection — so the replay stays exact even when
+        the relaunch changed the mixture weights (each corpus's own rng
+        advances by exactly the draws that corpus consumed)."""
+        self._assert_fresh("fast_forward_state")
+        mixed = bool(state.get("mixed"))
+        assert mixed == (self._mix is not None), (
+            f"checkpoint data_state is {'mixed' if mixed else 'unmixed'} "
+            f"but this loader is {'mixed' if self._mix else 'unmixed'} — "
+            "resume with the corpus configuration the run was using"
+        )
+        if not mixed:
+            batches = state.get("batches") or {}
+            assert len(batches) <= 1, (
+                "unmixed data_state covering multiple splits loses draw "
+                "ORDER (one shared rng, split-dependent bounds) — resume "
+                "this loader with an ordered fast_forward plan instead"
+            )
+            for split, count in batches.items():
+                self.fast_forward([(split, int(count))])
+            return
+        rem = int(state.get("sel_draws", 0))
+        while rem:
+            take = min(rem, _REPLAY_CHUNK)
+            self._sel_rng.random(take)
+            rem -= take
+        for split, d in (state.get("crops") or {}).items():
+            for name, kc in d.items():
+                assert name in self._mix_dirs, (
+                    f"checkpoint data_state names corpus {name!r} which "
+                    f"is not in this run's data_mix "
+                    f"({sorted(self._mix_dirs)}) — a removed corpus "
+                    "cannot have its consumed stream replayed"
+                )
+                src = self._source(split, name)
+                rng_c = self._corpus_rng(name, split)
+                kc = int(kc)
+                for start in range(0, kc, _REPLAY_CHUNK):
+                    rng_c.integers(0, src.n_positions,
+                                   size=min(_REPLAY_CHUNK, kc - start))
+        self._consumed = {
+            "batches": {k: int(v)
+                        for k, v in (state.get("batches") or {}).items()},
+            "sel_draws": int(state.get("sel_draws", 0)),
+            "crops": {s: {k: int(v) for k, v in d.items()}
+                      for s, d in (state.get("crops") or {}).items()},
+        }
+
+    def _assert_fresh(self, who):
+        assert (not self._buf and self._prefetch_thread is None
+                and self._deep is None), (
+            f"{who} must run on a fresh loader (before any draw or "
+            "prefetch)"
+        )
+
+    # ---- telemetry & accounting ------------------------------------------
 
     def _count(self, x, t0):
         """Batch-staging telemetry: wall time spent sampling + assembling
@@ -238,16 +471,69 @@ class DataLoader:
         self._reg.counter("data_batches").add(1)
         self._reg.counter("data_tokens").add(int(np.prod(x.shape)))
 
+    def _account(self, split):
+        """Pop-time consumption bookkeeping (resume_state's source of
+        truth): one stats entry per REAL _sample_local batch rides a
+        parallel FIFO, so staged-but-unconsumed draws never count.
+        (Monkeypatched samplers in tests stage no stats — skip.)"""
+        if not self._stats_fifo:
+            return
+        sp, counts = self._stats_fifo.popleft()
+        b = self._consumed["batches"]
+        b[sp] = b.get(sp, 0) + 1
+        if counts is not None:
+            self._consumed["sel_draws"] += self.grad_accum * self.local_batch
+            d = self._consumed["crops"].setdefault(sp, {})
+            for name, k in counts.items():
+                d[name] = d.get(name, 0) + k
+
+    def data_report(self):
+        """Schema-free loader summary for the run_end record (per-corpus
+        draw counts cannot be fixed METRIC_SCHEMA keys): consumed
+        batches, per-corpus crops, and the loader config — what
+        tools/obs_report.py's "data:" line reads."""
+        rep = {"prefetch_depth": self.prefetch_depth,
+               "batches": {k: int(v)
+                           for k, v in self._consumed["batches"].items()}}
+        if self._mix is not None:
+            rep["mix"] = [[n, round(w, 6)] for n, w in self._mix]
+            rep["crops"] = {s: {k: int(v) for k, v in d.items()}
+                            for s, d in self._consumed["crops"].items()}
+        srcs = {}
+        for (corpus, split), src in self._sources.items():
+            label = split if corpus is None else f"{corpus}/{split}"
+            info = {"kind": src.kind, "dtype": src.dtype.name}
+            if src.local_range is not None:
+                info["local_shards"] = list(src.local_range)
+            srcs[label] = info
+        if srcs:
+            rep["sources"] = srcs
+        return rep
+
+    # ---- prefetch ---------------------------------------------------------
+
+    def _poison_check(self):
+        """A stored prefetch failure raises at the NEXT get_batch — and
+        keeps raising (sticky): the background thread already advanced
+        the rng for its partial draws, so every later batch would be
+        silently desynced."""
+        from avenir_tpu.data.streaming import raise_prefetch_error
+
+        err = self._prefetch_error
+        if err is None and self._deep is not None:
+            err = self._deep.error
+        if err is not None:
+            raise_prefetch_error(err)
+
     def _join_prefetch(self):
         """Wait out an in-flight background stage (counting the blocked
         time — a nonzero data_prefetch_wait_ms means the window finished
         before the host did). After the join only the calling thread
-        touches the buffer/rng. A stage() failure re-raises HERE: the
-        thread has already advanced the rng for its partial draws, so
-        continuing would silently desync the bit-identical-stream
-        contract — fail loud instead."""
+        touches the buffer/rng. A stage() failure re-raises HERE (and
+        stays poisoned — see _poison_check)."""
         t = self._prefetch_thread
         if t is None:
+            self._poison_check()
             return
         t0 = time.perf_counter()
         was_running = t.is_alive()
@@ -256,13 +542,7 @@ class DataLoader:
         if was_running:
             self._reg.counter("data_prefetch_wait_ms").add(
                 (time.perf_counter() - t0) * 1e3)
-        if self._prefetch_error is not None:
-            err, self._prefetch_error = self._prefetch_error, None
-            raise RuntimeError(
-                "background batch prefetch failed (rng draws for the "
-                "staged window are already consumed, so the stream "
-                "cannot be resumed consistently)"
-            ) from err
+        self._poison_check()
 
     def _take(self, split, k, count_hit=True):
         """Pop `k` staged batches (topping up synchronously on a miss) in
@@ -270,8 +550,24 @@ class DataLoader:
         DataLoader serves one split once prefetch is engaged (the loop's
         train/eval loaders are separate instances). `count_hit=False` for
         non-window callers: data_prefetch_hit counts whole WINDOWS served
-        from the buffer (the METRIC_SCHEMA contract), not stray
-        single-batch drains."""
+        from the buffer (the METRIC_SCHEMA contract; data_windows is the
+        denominator), not stray single-batch drains."""
+        if self._deep is not None:
+            assert self._deep_split == split, (
+                f"prefetch buffer holds {self._deep_split!r} batches but "
+                f"{split!r} was requested — a prefetching DataLoader "
+                "serves a single split (use a second loader)"
+            )
+            out, hit, waited_ms = self._deep.pop(k)
+            if waited_ms:
+                self._reg.counter("data_prefetch_wait_ms").add(waited_ms)
+            if count_hit:
+                self._reg.counter("data_windows").add(1)
+                if hit:
+                    self._reg.counter("data_prefetch_hit").add(1)
+            for _ in out:
+                self._account(split)
+            return out
         self._join_prefetch()
         if self._buf:
             assert self._buf_split == split, (
@@ -279,19 +575,24 @@ class DataLoader:
                 f"{split!r} was requested — a prefetching DataLoader "
                 "serves a single split (use a second loader)"
             )
-        if count_hit and len(self._buf) >= k:
-            self._reg.counter("data_prefetch_hit").add(1)
+        if count_hit:
+            self._reg.counter("data_windows").add(1)
+            if len(self._buf) >= k:
+                self._reg.counter("data_prefetch_hit").add(1)
         while len(self._buf) < k:
             self._buf.append(self._sample_local(split))
         out, self._buf = self._buf[:k], self._buf[k:]
+        for _ in out:
+            self._account(split)
         return out
 
     def _spawn_prefetch(self, split, k):
         """Stage the next `k` batches in the background (double buffer:
-        at most one window in flight). The thread's sampling time lands
-        in data_stage_ms (thread-safe counter) so the memmap cost stays
-        visible even though it no longer blocks the loop; its exceptions
-        are re-raised by the next _join_prefetch."""
+        at most one window in flight — the prefetch_depth=1 path). The
+        thread's sampling time lands in data_stage_ms (thread-safe
+        counter) so the memmap cost stays visible even though it no
+        longer blocks the loop; its exceptions are re-raised by the next
+        _join_prefetch."""
 
         def stage():
             t0 = time.perf_counter()
@@ -310,14 +611,48 @@ class DataLoader:
             target=stage, name="avenir-data-prefetch", daemon=True)
         self._prefetch_thread.start()
 
+    def _ensure_deep(self, split, k):
+        """Engage (or retarget) the persistent deep pipeline. One
+        Prefetcher per loader, bound to one split — its single worker
+        owns the rng from here on, so the staged stream is exactly the
+        sequence a synchronous loader would draw."""
+        from avenir_tpu.data.streaming import Prefetcher
+
+        if self._deep is None:
+            assert not self._buf and self._prefetch_thread is None
+            self._deep = Prefetcher(lambda: self._sample_local(split),
+                                    self.prefetch_depth)
+            self._deep_split = split
+        assert self._deep_split == split, (
+            f"prefetch buffer holds {self._deep_split!r} batches but "
+            f"{split!r} was requested — a prefetching DataLoader serves "
+            "a single split (use a second loader)"
+        )
+        self._deep.ensure(k)
+
+    def close(self):
+        """Stop background staging (bench/test hygiene; training relies
+        on daemon threads dying with the process)."""
+        if self._deep is not None:
+            self._deep.stop()
+        t = self._prefetch_thread
+        if t is not None:
+            t.join()
+            self._prefetch_thread = None
+
+    # ---- batch API --------------------------------------------------------
+
     def get_batch(self, split):
+        self._poison_check()
         t0 = time.perf_counter()
-        if self._buf or self._prefetch_thread is not None:
+        if (self._deep is not None or self._buf
+                or self._prefetch_thread is not None):
             # a windowed caller left staged batches behind: consume them
             # in order so the stream stays bit-identical
             x, y = self._take(split, 1, count_hit=False)[0]
         else:
             x, y = self._sample_local(split)
+            self._account(split)
         if self.sharding is None:
             out = jax.numpy.asarray(x), jax.numpy.asarray(y)
             self._count(x, t0)
@@ -338,11 +673,17 @@ class DataLoader:
         per-process stream as get_batch, so k window calls and k·1 single
         calls yield the identical batch sequence."""
         assert not self.flat, "windowed batches are a train-path concept"
+        self._poison_check()
         t0 = time.perf_counter()
+        if self.prefetch_depth > 1:
+            # deep pipeline: the persistent worker keeps depth*k batches
+            # staged; this pop usually returns without touching a file
+            self._ensure_deep(split, k)
         xs, ys = zip(*self._take(split, k))
-        # double-buffer: stage the NEXT window on a background thread
-        # while this one's device window runs
-        self._spawn_prefetch(split, k)
+        if self._deep is None:
+            # double-buffer: stage the NEXT window on a background thread
+            # while this one's device window runs
+            self._spawn_prefetch(split, k)
         x, y = np.stack(xs), np.stack(ys)
         if self.sharding is None:
             out = jax.numpy.asarray(x), jax.numpy.asarray(y)

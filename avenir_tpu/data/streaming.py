@@ -1,0 +1,411 @@
+"""Sharded streaming dataset layer (ISSUE 19 tentpole).
+
+The config ladder's upper rungs (GPT-2 1.5B, Llama-3 8B, Mixtral) need
+corpora that no single memmapped `train.bin` can hold or feed. This
+module grows the on-disk contract from "one token file per split" to
+"one DIRECTORY of v2-wire shard files per split plus a small manifest",
+and gives `DataLoader` the three pieces the pod path needs:
+
+  * `write_token_shards` / `load_manifest` — the sharded layout.
+    `<split>.shards/` holds `shard-00000.bin ...` (each a v2
+    header + raw token array, self-describing per file) and a
+    `MANIFEST.json` naming every shard, its token count, and the
+    corpus-wide dtype. The dtype is chosen ONCE for the whole corpus
+    (narrowest that fits the vocab) so every crop leaves the disk in
+    the same wire dtype.
+
+  * `SplitSource` — one corpus split resolved to whichever layout is
+    on disk: the sharded directory, or the legacy single `<split>.bin`
+    (headerless uint16 or v2, unchanged byte-for-byte). Sharded
+    sources are PER-HOST LOCAL: process p of P deterministically owns
+    the contiguous shard range [p*S/P, (p+1)*S/P) — the same
+    arithmetic as the checkpoint restore's `local_shard_ranges`
+    locality filter — so a pod host never reads a peer's files. Crop
+    positions are flat indices into the concatenation of this
+    process's sampleable shard ranges; crops never span a shard
+    boundary.
+
+  * `Prefetcher` — the deep background pipeline behind
+    `--prefetch_depth > 1`. A single persistent daemon worker stages
+    batches into a bounded FIFO (up to depth x window batches ahead),
+    so the consumed rng stream stays bit-identical to the unprefetched
+    loader's (one producer, one consumer, strict FIFO — the same
+    contract the depth-1 double buffer pins in
+    tests/test_loader.py::test_prefetch_preserves_stream_order).
+    Worker failures are stored and re-raised at the NEXT consume, never
+    swallowed: the worker has already advanced the rng for its partial
+    draws, so continuing would silently desync the kill-resume stream.
+
+Weighted multi-corpus mixing (`--data_mix='owt:0.7,code:0.3'`) lives in
+DataLoader itself (avenir_tpu/data/loader.py) on top of SplitSource;
+`parse_data_mix` / `resolve_corpus_dir` here own the spec syntax.
+"""
+
+import collections
+import json
+import os
+import threading
+import time
+import zlib
+
+import numpy as np
+
+MANIFEST_NAME = "MANIFEST.json"
+MANIFEST_KIND = "avenir-token-shards"
+MANIFEST_VERSION = 1
+_SHARD_FMT = "shard-{:05d}.bin"
+
+
+# ---- sharded writer -------------------------------------------------------
+
+def write_token_shards(path, tokens, shard_tokens=1 << 22, vocab_size=None):
+    """Write `tokens` as a directory of v2-wire shard files + MANIFEST.json.
+
+    `path` is the shards directory (convention: `<data_dir>/<split>.shards`).
+    The wire dtype is chosen once for the WHOLE corpus — narrowest that
+    fits `vocab_size` (or max token + 1) — so mixing/streaming never sees
+    a dtype change mid-corpus. Every shard carries the v2 header (magic +
+    dtype code), making each file self-describing on its own.
+
+    Atomicity matches the checkpoint discipline: shard bodies are written
+    first, the manifest last via .part-then-rename — a directory without
+    a committed manifest is not a corpus yet, so a killed prep job can
+    simply be re-run. Returns the numpy dtype written."""
+    from avenir_tpu.data.loader import (
+        WIRE_MAGIC, WIRE_V2, WIRE_VOCAB_CAP, _CODE_FOR_DTYPE)
+
+    tokens = np.asarray(tokens)
+    shard_tokens = int(shard_tokens)
+    assert shard_tokens > 0, "shard_tokens must be positive"
+    hi = int(vocab_size) if vocab_size is not None else (
+        int(tokens.max()) + 1 if tokens.size else 0)
+    assert tokens.size == 0 or (int(tokens.max()) < hi
+                                and int(tokens.min()) >= 0), (
+        f"token ids outside [0, {hi}) — a vocab_size/tokenizer mismatch "
+        "(same gate as write_token_file)")
+    if hi <= WIRE_VOCAB_CAP:
+        dtype = np.dtype(np.uint16)
+    else:
+        assert hi <= int(np.iinfo(np.uint32).max) + 1, (
+            f"vocab_size={hi} does not fit uint32")
+        dtype = np.dtype(np.uint32)
+    os.makedirs(path, exist_ok=True)
+    header = WIRE_MAGIC + bytes([WIRE_V2, _CODE_FOR_DTYPE[dtype], 0, 0])
+    shards = []
+    for s, start in enumerate(range(0, max(len(tokens), 1), shard_tokens)):
+        chunk = tokens[start:start + shard_tokens]
+        fname = _SHARD_FMT.format(s)
+        with open(os.path.join(path, fname), "wb") as f:
+            f.write(header)
+            chunk.astype(dtype).tofile(f)
+        shards.append({"file": fname, "tokens": int(len(chunk))})
+    manifest = {
+        "kind": MANIFEST_KIND, "version": MANIFEST_VERSION,
+        "dtype": dtype.name, "shard_tokens": shard_tokens,
+        "total_tokens": int(len(tokens)), "shards": shards,
+    }
+    mpath = os.path.join(path, MANIFEST_NAME)
+    with open(mpath + ".part", "w") as f:
+        json.dump(manifest, f, indent=1)
+    os.replace(mpath + ".part", mpath)
+    return dtype
+
+
+def load_manifest(shards_dir):
+    """Parse + validate a shard manifest. Fails loud on a foreign or
+    future layout instead of guessing (the wire-format discipline)."""
+    with open(os.path.join(shards_dir, MANIFEST_NAME)) as f:
+        m = json.load(f)
+    assert m.get("kind") == MANIFEST_KIND, (
+        f"{shards_dir}: manifest kind {m.get('kind')!r} is not "
+        f"{MANIFEST_KIND!r}")
+    assert int(m.get("version", -1)) == MANIFEST_VERSION, (
+        f"{shards_dir}: manifest version {m.get('version')} (this build "
+        f"reads v{MANIFEST_VERSION}) — refusing to guess the layout")
+    assert m.get("shards"), f"{shards_dir}: manifest lists no shards"
+    return m
+
+
+# ---- mix spec -------------------------------------------------------------
+
+def parse_data_mix(spec):
+    """'owt:0.7,code:0.3' -> [(name, weight), ...] with weights
+    normalized to sum 1. Weights are parsed off the LAST colon so corpus
+    names may be paths containing colons-free... absolute paths are fine
+    (rsplit)."""
+    out = []
+    for entry in str(spec).split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, _, w = entry.rpartition(":")
+        assert name, (
+            f"data_mix entry {entry!r} has no 'name:weight' form")
+        w = float(w)
+        assert w > 0, f"data_mix weight for {name!r} must be > 0 (got {w})"
+        out.append((name, w))
+    assert len(out) >= 1, f"data_mix spec {spec!r} names no corpora"
+    names = [n for n, _ in out]
+    assert len(set(names)) == len(names), (
+        f"data_mix names a corpus twice: {names}")
+    total = sum(w for _, w in out)
+    return [(n, w / total) for n, w in out]
+
+
+def resolve_corpus_dir(name, base_dir):
+    """A mix entry names a corpus directory: an absolute/relative path
+    that exists, a sibling of `base_dir` (the common `data/owt`,
+    `data/code` layout), or `base_dir` itself by basename."""
+    cands = [name,
+             os.path.join(os.path.dirname(base_dir.rstrip(os.sep)), name)]
+    if os.path.basename(base_dir.rstrip(os.sep)) == name:
+        cands.insert(0, base_dir)
+    for c in cands:
+        if os.path.isdir(c):
+            return c
+    raise FileNotFoundError(
+        f"data_mix corpus {name!r} not found (tried {cands})")
+
+
+def corpus_seed_tag(name):
+    """Stable 32-bit tag for seeding a corpus/split rng stream: part of
+    the SeedSequence entropy, so streams stay decorrelated per corpus
+    without an ordering dependence on the mix spec."""
+    return zlib.crc32(str(name).encode()) & 0xFFFFFFFF
+
+
+# ---- split sources --------------------------------------------------------
+
+class SplitSource:
+    """One corpus split resolved to its on-disk layout.
+
+    Exposes the two things sampling needs — `n_positions` (how many
+    crop start positions THIS PROCESS may draw from; the rng bound) and
+    `gather(ix)` (vectorized crop reads) — identically for both
+    layouts, so the mixing/sharding code above never branches on disk
+    format. The legacy single file is re-opened per gather (the
+    np.memmap leak defense the reference loader always had); shard
+    mappings are CACHED and recycled every _RECYCLE_EVERY gathers —
+    per-batch np.memmap opens across many small shard files would cost
+    more than the fused gather saves, while a periodic full drop keeps
+    the same leak bound (mappings never live unboundedly long)."""
+
+    _RECYCLE_EVERY = 64
+
+    def __init__(self, data_dir, split, block_size, *, vocab_size=None,
+                 process_index=None, process_count=None):
+        from avenir_tpu.data.loader import read_wire_format
+
+        import jax
+
+        self.data_dir = data_dir
+        self.split = split
+        self.block_size = int(block_size)
+        pidx = jax.process_index() if process_index is None else process_index
+        pcnt = jax.process_count() if process_count is None else process_count
+        shards_dir = os.path.join(data_dir, f"{split}.shards")
+        legacy = os.path.join(data_dir, f"{split}.bin")
+        if os.path.isdir(shards_dir):
+            self.kind = "sharded"
+            self.path = shards_dir
+            self.what = f"{split}.shards"
+            m = load_manifest(shards_dir)
+            self.dtype = np.dtype(m["dtype"])
+            all_shards = m["shards"]
+            n_shards = len(all_shards)
+            assert n_shards >= pcnt, (
+                f"{shards_dir}: {n_shards} shard(s) cannot give "
+                f"{pcnt} processes disjoint non-empty shard ranges — "
+                "re-shard the corpus with a smaller shard_tokens"
+            )
+            # per-host locality: process p of P owns the contiguous
+            # shard range [p*S/P, (p+1)*S/P) — the checkpoint restore's
+            # local_shard_ranges arithmetic. Disjoint by construction,
+            # covers every shard, and stable across relaunches at the
+            # same process_count.
+            lo = pidx * n_shards // pcnt
+            hi = (pidx + 1) * n_shards // pcnt
+            self.local_shards = all_shards[lo:hi]
+            self.local_range = (lo, hi)
+            # sampleable crop starts per local shard: a crop reads
+            # block_size+1 tokens and never spans shards, so shard s
+            # contributes max(0, tokens_s - block_size) start positions
+            pos = np.array(
+                [max(0, int(s["tokens"]) - self.block_size)
+                 for s in self.local_shards], dtype=np.int64)
+            self._cum = np.cumsum(pos)
+            self._starts = self._cum - pos  # flat position where shard begins
+            self.n_positions = int(self._cum[-1]) if len(pos) else 0
+            assert self.n_positions > 0, (
+                f"{shards_dir}: shards {lo}..{hi - 1} hold no crop of "
+                f"block_size={self.block_size} for process {pidx} — "
+                "shards must be longer than block_size"
+            )
+            self._offset = None  # per-file, sniffed at open
+            self._maps = {}  # shard idx -> open memmap (recycled)
+            self._gathers = 0
+        elif os.path.exists(legacy):
+            self.kind = "file"
+            self.path = legacy
+            self.what = f"{split}.bin"
+            self.dtype, self._offset = read_wire_format(legacy)
+            nbytes = os.path.getsize(legacy) - self._offset
+            # the LEGACY bound, bit-for-bit: len(arr) - block_size
+            self.n_positions = nbytes // self.dtype.itemsize - self.block_size
+            self.local_shards = None
+            self.local_range = None
+        else:
+            raise FileNotFoundError(
+                f"no {split}.bin or {split}.shards/ under {data_dir}")
+        cap = int(np.iinfo(self.dtype).max) + 1
+        assert vocab_size is None or vocab_size <= cap, (
+            f"vocab_size={vocab_size} does not fit {self.what}'s "
+            f"{self.dtype.name} wire/on-disk token format (max {cap}); "
+            "token ids would wrap silently — regenerate the corpus with "
+            "write_token_file/write_token_shards before such a vocab "
+            "can run"
+        )
+
+    def gather(self, ix):
+        """Vectorized crop reads: (x, y) arrays of shape (len(ix),
+        block_size) in the wire dtype, y shifted one token. One fused
+        (n, block_size+1) gather per file replaces the legacy
+        per-crop python slice loop (~3x less host CPU per staged batch
+        on the bench host — the data_bench headline)."""
+        steps = np.arange(self.block_size + 1)
+        if self.kind == "file":
+            arr = np.memmap(self.path, dtype=self.dtype, mode="r",
+                            offset=self._offset)
+            w = arr[np.asarray(ix)[:, None] + steps]
+            return w[:, :-1], w[:, 1:]
+        from avenir_tpu.data.loader import read_wire_format
+
+        ix = np.asarray(ix)
+        self._gathers += 1
+        if self._gathers % self._RECYCLE_EVERY == 0:
+            self._maps.clear()  # drop mappings; kernel reclaims pages
+        sh = np.searchsorted(self._cum, ix, side="right")
+        off = ix - self._starts[sh]
+        w = np.empty((len(ix), self.block_size + 1), dtype=self.dtype)
+        for s in np.unique(sh):
+            s = int(s)
+            arr = self._maps.get(s)
+            if arr is None:
+                f = os.path.join(self.path, self.local_shards[s]["file"])
+                dtype, offset = read_wire_format(f)
+                assert dtype == self.dtype, (
+                    f"{f}: shard dtype {dtype.name} disagrees with "
+                    f"manifest {self.dtype.name} — the corpus directory "
+                    "is torn")
+                arr = np.memmap(f, dtype=dtype, mode="r", offset=offset)
+                self._maps[s] = arr
+            m = sh == s
+            w[m] = arr[off[m][:, None] + steps]
+        return w[:, :-1], w[:, 1:]
+
+
+# ---- deep prefetch --------------------------------------------------------
+
+class Prefetcher:
+    """Persistent single-worker background stager for prefetch_depth > 1.
+
+    One daemon thread repeatedly calls `sample_fn()` (which owns the rng
+    and appends its own consumption stats) and appends to a bounded FIFO;
+    the consumer pops in order. Exactly ONE producer means the staged
+    stream is the same sequence a synchronous loader would draw, so the
+    bit-identical-stream contract survives any depth. The buffer bound is
+    depth x (latest window size) batches — the host-RAM knob
+    docs/PERFORMANCE.md's "Feeding the pod" section sizes."""
+
+    def __init__(self, sample_fn, depth):
+        assert depth >= 2, "Prefetcher is the deep path (depth >= 2)"
+        self.depth = int(depth)
+        self._sample = sample_fn
+        self._buf = collections.deque()
+        self._cv = threading.Condition()
+        self._target = 0
+        self._stop = False
+        self.error = None
+        self._thread = None
+
+    def ensure(self, k):
+        """(Re)arm the worker with a buffer target of depth*k batches.
+        Window size k may shrink at eval boundaries; the target follows."""
+        with self._cv:
+            self._target = self.depth * int(k)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._work, name="avenir-data-prefetch-deep",
+                    daemon=True)
+                self._thread.start()
+            self._cv.notify_all()
+
+    def _work(self):
+        from avenir_tpu.obs.metrics import get_registry
+
+        reg = get_registry()
+        while True:
+            with self._cv:
+                while not self._stop and len(self._buf) >= self._target:
+                    self._cv.wait()
+                if self._stop:
+                    return
+            t0 = time.perf_counter()
+            try:
+                item = self._sample()
+            except BaseException as e:  # surfaced at the next pop
+                with self._cv:
+                    self.error = e
+                    self._cv.notify_all()
+                return
+            finally:
+                reg.counter("data_stage_ms").add(
+                    (time.perf_counter() - t0) * 1e3)
+            with self._cv:
+                self._buf.append(item)
+                self._cv.notify_all()
+
+    def staged(self):
+        with self._cv:
+            return len(self._buf)
+
+    def pop(self, k):
+        """Pop `k` staged batches in FIFO order. Returns (items, hit,
+        waited_ms): hit means the whole window was already buffered
+        (the data_prefetch_hit contract); waited_ms is the blocked time
+        (input stall — the device outpaced host staging)."""
+        waited = 0.0
+        with self._cv:
+            if self.error is not None:
+                raise_prefetch_error(self.error)
+            hit = len(self._buf) >= k
+            while len(self._buf) < k:
+                if self.error is not None:
+                    raise_prefetch_error(self.error)
+                assert not self._stop, "pop() after stop()"
+                t0 = time.perf_counter()
+                self._cv.wait(timeout=0.5)
+                waited += time.perf_counter() - t0
+            out = [self._buf.popleft() for _ in range(k)]
+            self._cv.notify_all()
+        return out, hit, waited * 1e3
+
+    def stop(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+
+def raise_prefetch_error(err):
+    """The one fail-loud for a dead prefetch stage (satellite: a stored
+    error must raise at the NEXT get_batch, never be joined away): the
+    worker already advanced the rng for its partial draws, so continuing
+    would silently desync the bit-identical kill-resume stream."""
+    raise RuntimeError(
+        "background batch prefetch failed (rng draws for the staged "
+        "window are already consumed, so the stream cannot be resumed "
+        "consistently)"
+    ) from err

@@ -68,6 +68,10 @@ METRIC_SCHEMA = {
         "counter", "ms",
         "time the loop blocked joining an in-flight loader prefetch "
         "thread (nonzero means device windows outpace host staging)"),
+    "data_windows": (
+        "counter", "1",
+        "batch windows requested from the loader (the denominator for "
+        "the data_prefetch_hit rate)"),
     # -- checkpoint io --
     "ckpt_saves": ("counter", "1", "checkpoint saves started"),
     "ckpt_save_ms": (

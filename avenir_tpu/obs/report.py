@@ -217,8 +217,23 @@ def summarize(records, *, skipped_lines=()):
     for r in anomalies:
         d = r.get("detector", "?")
         by_detector[d] = by_detector.get(d, 0) + 1
+    # input pipeline (ISSUE 19): counter totals + the run_end record's
+    # schema-free loader report (per-corpus draw counts keyed by corpus
+    # NAME can't be fixed METRIC_SCHEMA keys, so they ride the record)
+    data_end = end.get("data") or {}
+    data = {
+        "windows": counters.get("data_windows", 0.0),
+        "prefetch_hit": counters.get("data_prefetch_hit", 0.0),
+        "prefetch_wait_ms": counters.get("data_prefetch_wait_ms", 0.0),
+        "stage_ms": counters.get("data_stage_ms", 0.0),
+        "tokens": counters.get("data_tokens", 0.0),
+        "prefetch_depth": data_end.get("prefetch_depth"),
+        "mix": data_end.get("mix"),
+        "crops": data_end.get("crops"),
+    }
     return {
         "serve": serve,
+        "data": data,
         "meta": meta,
         # fleet health engine (ISSUE 14): the early-warning tier's
         # activity — counter totals when the run ended cleanly, the
@@ -295,6 +310,21 @@ def format_report(s):
         tps = s["median_tok_per_sec"]
         lines.append(f"speed:    median {s['median_dt_ms']:.2f} ms/iter"
                      + (f", {tps:,.0f} tok/s global" if tps else ""))
+    d = s.get("data") or {}
+    if d.get("windows") or d.get("crops"):
+        bits = []
+        if d.get("windows"):
+            bits.append(f"prefetch hit {d['prefetch_hit'] / d['windows']:.0%}"
+                        f" of {d['windows']:.0f} windows")
+        bits.append(f"wait {d['prefetch_wait_ms']:.0f} ms")
+        if d.get("prefetch_depth"):
+            bits.append(f"depth {d['prefetch_depth']}")
+        # per-corpus draw counts (mixed runs): the train split's totals
+        crops = (d.get("crops") or {}).get("train") or {}
+        if crops:
+            bits.append("mix " + " ".join(f"{k}:{v:,.0f}"
+                                          for k, v in sorted(crops.items())))
+        lines.append("data:     " + "   ".join(bits))
     lines.append("")
     lines.append("-- goodput (share of loop wall time) --")
     total = s["total_ms"]

@@ -251,6 +251,7 @@ def run_training(cfg):
     sh_meta = None
     hf_init = None
     resume_src = None
+    resume_data_state = None
     if cfg["init_from"] == "scratch":
         model_args["vocab_size"] = meta_vocab_size if meta_vocab_size else 50304
     elif cfg["init_from"] == "resume":
@@ -280,6 +281,10 @@ def run_training(cfg):
         # (the next save overwrites it, invalidating lazy readers)
         iter_num = int(src["iter_num"])
         best_val_loss = float(src["best_val_loss"])
+        # per-corpus draw counts for the streaming loader (ISSUE 19);
+        # absent in pre-streaming checkpoints (.get — resume falls back
+        # to the derived fast_forward plan below)
+        resume_data_state = src.get("data_state")
         if master:
             form = "sharded set" if ckpt is None else "ckpt.pt"
             print(f"resuming from {resume_src['dir']} ({form}) at iter "
@@ -428,15 +433,18 @@ def run_training(cfg):
     # ---- data ----
     batch_sharding = NamedSharding(mesh, batch_pspec())
     eval_sharding = NamedSharding(mesh, batch_pspec(with_accum=False))
+    data_mix = cfg.get("data_mix", "") or None
+    prefetch_depth = int(cfg.get("prefetch_depth", 1) or 1)
     train_loader = DataLoader(
         data_dir, block_size, global_micro_batch,
         sharding=batch_sharding, grad_accum=grad_accum, seed=cfg["seed"],
         vocab_size=model_args["vocab_size"],
+        mix=data_mix, prefetch_depth=prefetch_depth,
     )
     eval_loader = DataLoader(
         data_dir, block_size, global_micro_batch,
         sharding=eval_sharding, grad_accum=1, seed=cfg["seed"] + 1, flat=True,
-        vocab_size=model_args["vocab_size"],
+        vocab_size=model_args["vocab_size"], mix=data_mix,
     )
     if cfg["init_from"] == "resume" and iter_num > 0:
         # deterministic resume (ISSUE 5): a fresh loader's rng starts at
@@ -446,8 +454,16 @@ def run_training(cfg):
         # uninterrupted run's (tools/chaos_train.py asserts the final
         # loss matches exactly). The eval loader likewise skips the
         # draws of every eval that ran at iters < iter_num (the eval AT
-        # iter_num re-runs on resume, so it is not skipped).
-        train_loader.fast_forward([("train", iter_num)])
+        # iter_num re-runs on resume, so it is not skipped — which is
+        # also why ONLY the train loader's state rides the checkpoint:
+        # the eval loader's checkpointed counts would include that
+        # re-run eval's draws).
+        if resume_data_state is not None:
+            # checkpointed per-corpus counts (ISSUE 19): exact replay
+            # even if the relaunch changed the data_mix weights
+            train_loader.fast_forward_state(resume_data_state)
+        else:
+            train_loader.fast_forward([("train", iter_num)])
         n_past_evals = (iter_num - 1) // cfg["eval_interval"] + 1
         eval_loader.fast_forward(
             [("train", cfg["eval_iters"]), ("val", cfg["eval_iters"])]
@@ -536,6 +552,10 @@ def run_training(cfg):
             best_val_loss=best_val_loss, config=cfg,
             model_family=st["model_type"],
             keep_checkpoints=int(cfg.get("keep_checkpoints", 2)),
+            # consumed-draw counts for the streaming loader: what
+            # fast_forward_state replays on resume (per-corpus exact,
+            # robust to a data_mix re-weight across the relaunch)
+            data_state=train_loader.resume_state(),
         )
         # the span counts only LOOP-BLOCKING time: snapshot + enqueue for
         # async saves, the whole write for sync ones (the async writer's
@@ -1000,6 +1020,10 @@ def run_training(cfg):
             sink.write({
                 "kind": "run_end", "t": time.time(), "iter": iter_num,
                 "best_val_loss": float(best_val_loss), **snap,
+                # loader config + per-corpus draw counts (record fields
+                # are schema-free; corpus names can't be METRIC_SCHEMA
+                # keys) — obs_report's "data:" line reads this
+                "data": train_loader.data_report(),
                 **({"series": series} if series else {}),
             })
             set_run_sink(_prev_sink)  # before close: no writes to a

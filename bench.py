@@ -135,6 +135,10 @@ def _loop_form(args, *, attn_impl, on_tpu, block, batch, scan=False,
             compute_dtype=compute_dtype,
             fused_adamw=False, profile=False,
             allow_unsharded_fallback=False,
+            # streaming loader config (ISSUE 19): overridable so the
+            # loop bench can measure mixing/deep-prefetch variants
+            data_mix=str(args.get("data_mix", "")),
+            prefetch_depth=int(args.get("prefetch_depth", 1)),
         )
         if not on_tpu:  # CPU smoke: shrink to harness scale
             cfg.update(n_layer=2, n_head=2, n_embd=64,
@@ -199,6 +203,17 @@ def _loop_form(args, *, attn_impl, on_tpu, block, batch, scan=False,
             "compute_dtype": _resolved_compute(cfg.get("compute_dtype"),
                                                cfg["dtype"]),
             "peak_hbm_bytes": _peak_hbm_bytes(),
+            # loader config the run fed from (ISSUE 19): BENCH artifacts
+            # must say which input pipeline their headline measured
+            "loader": {
+                "layout": "file",  # this form writes single-file splits
+                "data_mix": cfg["data_mix"] or None,
+                "prefetch_depth": cfg["prefetch_depth"],
+                "prefetch_hit": c.get("data_prefetch_hit", 0.0),
+                "windows_requested": c.get("data_windows", 0.0),
+                "prefetch_wait_ms": round(
+                    c.get("data_prefetch_wait_ms", 0.0), 1),
+            },
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -1,0 +1,40 @@
+"""tools/data_bench.py --smoke rides tier-1 (ISSUE 19 satellite): both
+bench arms — the seed loader's per-slice staging and the streaming
+loader's fused sharded gather + deep prefetch — must run end to end on
+every commit, and the committed full artifact must stay in sync with
+the PERF_LEDGER row the perf gate bands."""
+
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_smoke_runs_green(tmp_path, capsys):
+    from tools.data_bench import main
+
+    out = tmp_path / "bench.json"
+    assert main(["--smoke", f"--out={out}"]) == 0
+    capsys.readouterr()
+    rep = json.loads(out.read_text())
+    assert rep["smoke"] is True
+    assert rep["ok"] is True
+    (seed,) = rep["seeds"]
+    assert seed["staged_tok_per_s"]["seed_loader"] > 0
+    assert seed["staged_tok_per_s"]["streaming"] > 0
+    assert 0 <= seed["stall_frac"]["streaming"] <= 1
+    assert "staged_tok_per_s_ratio" in rep["headline"]
+
+
+def test_committed_artifact_carries_the_claims():
+    """BENCH_data.json is the PR's evidence: the acceptance headline and
+    the mixed-corpus kill-resume verdict must both be present and green
+    in the committed artifact (the ledger row pins the exact value)."""
+    with open(os.path.join(REPO, "BENCH_data.json")) as f:
+        art = json.load(f)
+    assert art["smoke"] is False
+    assert art["ok"] is True
+    assert art["headline"]["meets_acceptance"] is True
+    assert art["resume"]["bit_identical"] is True
+    assert art["resume"]["kills"] >= 1
+    assert len(art["config"]["seeds"]) == 3

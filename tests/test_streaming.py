@@ -1,0 +1,427 @@
+"""Streaming dataset subsystem (ISSUE 19): sharded corpora with
+per-host locality, weighted multi-corpus mixing, deep prefetch, and the
+bit-identical kill-resume contract over all of it.
+
+Fast tier-1 coverage here; the SIGKILL-under-mixing soak rides the slow
+marker at the bottom (tools/chaos_train.py --mix=1)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from avenir_tpu.data.loader import DataLoader, read_wire_format
+from avenir_tpu.data.streaming import (
+    MANIFEST_NAME,
+    SplitSource,
+    load_manifest,
+    parse_data_mix,
+    write_token_shards,
+)
+from avenir_tpu.obs.metrics import get_registry, reset_registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tokens(n, seed=0, hi=501):
+    return np.random.default_rng(seed).integers(0, hi, n).astype(np.uint16)
+
+
+def _mk_sharded(dirpath, *, n=40_000, shard_tokens=1_000, seed=0,
+                splits=("train",)):
+    toks = _tokens(n, seed)
+    for split in splits:
+        write_token_shards(os.path.join(str(dirpath), f"{split}.shards"),
+                           toks, shard_tokens=shard_tokens)
+    return toks
+
+
+def _mk_legacy(dirpath, *, n=40_000, seed=0, splits=("train",)):
+    toks = _tokens(n, seed)
+    for split in splits:
+        toks.tofile(os.path.join(str(dirpath), f"{split}.bin"))
+    return toks
+
+
+# ---- sharded writer + manifest ---------------------------------------------
+
+
+def test_shard_writer_roundtrip(tmp_path):
+    toks = _tokens(10_500, seed=3)
+    d = tmp_path / "train.shards"
+    dtype = write_token_shards(d, toks, shard_tokens=4_000)
+    assert dtype == np.dtype(np.uint16)
+    m = load_manifest(str(d))
+    assert m["dtype"] == "uint16"
+    assert [s["tokens"] for s in m["shards"]] == [4000, 4000, 2500]
+    got = []
+    for s in m["shards"]:
+        f = str(d / s["file"])
+        dt, off = read_wire_format(f)
+        assert dt == np.dtype(np.uint16) and off == 8  # v2 header
+        got.append(np.fromfile(f, dtype=dt, offset=off))
+    np.testing.assert_array_equal(np.concatenate(got), toks)
+
+
+def test_shard_writer_u32_for_big_vocab(tmp_path):
+    toks = np.array([0, 70_000, 123, 65_999], dtype=np.uint32)
+    d = tmp_path / "train.shards"
+    dtype = write_token_shards(d, toks, shard_tokens=2, vocab_size=128_256)
+    assert dtype == np.dtype(np.uint32)
+    m = load_manifest(str(d))
+    assert m["dtype"] == "uint32"
+    f = str(d / m["shards"][0]["file"])
+    dt, _ = read_wire_format(f)
+    assert dt == np.dtype(np.uint32)
+
+
+def test_manifest_fails_loud_on_foreign_layout(tmp_path):
+    d = tmp_path / "train.shards"
+    write_token_shards(d, _tokens(100), shard_tokens=50)
+    mpath = d / MANIFEST_NAME
+    m = json.loads(mpath.read_text())
+    m["version"] = 99
+    mpath.write_text(json.dumps(m))
+    with pytest.raises(AssertionError, match="version"):
+        load_manifest(str(d))
+    m["version"] = 1
+    m["kind"] = "something-else"
+    mpath.write_text(json.dumps(m))
+    with pytest.raises(AssertionError, match="kind"):
+        load_manifest(str(d))
+
+
+# ---- mix spec --------------------------------------------------------------
+
+
+def test_parse_data_mix():
+    mix = parse_data_mix("owt:0.7,code:0.3")
+    assert [n for n, _ in mix] == ["owt", "code"]
+    assert sum(w for _, w in mix) == pytest.approx(1.0)
+    assert dict(mix)["owt"] == pytest.approx(0.7)
+    # unnormalized weights normalize
+    mix = parse_data_mix("a:2,b:6")
+    assert dict(mix)["b"] == pytest.approx(0.75)
+    with pytest.raises(AssertionError, match="twice"):
+        parse_data_mix("a:1,a:2")
+    with pytest.raises(AssertionError, match="> 0"):
+        parse_data_mix("a:0")
+
+
+# ---- sharded sources: locality + gather fidelity ---------------------------
+
+
+def test_sharded_gather_matches_token_stream(tmp_path):
+    toks = _mk_sharded(tmp_path, n=9_000, shard_tokens=1_000)
+    src = SplitSource(str(tmp_path), "train", 64,
+                     process_index=0, process_count=1)
+    assert src.kind == "sharded"
+    assert src.n_positions == 9 * (1_000 - 64)
+    rng = np.random.default_rng(0)
+    ix = rng.integers(0, src.n_positions, size=32)
+    x, y = src.gather(ix)
+    # flat position p lives in shard p // (shard_tokens - block) at
+    # offset p % (...); shards are contiguous chunks of the stream
+    per = 1_000 - 64
+    for row, p in enumerate(ix):
+        s, off = divmod(int(p), per)
+        want = toks[s * 1_000 + off:s * 1_000 + off + 65]
+        np.testing.assert_array_equal(x[row], want[:-1])
+        np.testing.assert_array_equal(y[row], want[1:])
+
+
+def test_sharded_locality_disjoint_and_covering(tmp_path):
+    _mk_sharded(tmp_path, n=10_000, shard_tokens=1_000)  # 10 shards
+    ranges = []
+    for p in range(3):
+        src = SplitSource(str(tmp_path), "train", 64,
+                          process_index=p, process_count=3)
+        ranges.append(src.local_range)
+        assert src.n_positions > 0
+    # disjoint, contiguous, covering — the checkpoint local_shard_ranges
+    # arithmetic
+    assert ranges == [(0, 3), (3, 6), (6, 10)]
+
+
+def test_sharded_needs_enough_shards(tmp_path):
+    _mk_sharded(tmp_path, n=2_000, shard_tokens=1_000)  # 2 shards
+    with pytest.raises(AssertionError, match="disjoint"):
+        SplitSource(str(tmp_path), "train", 64,
+                    process_index=0, process_count=4)
+
+
+def test_sharded_vocab_gate_fails_loud(tmp_path):
+    _mk_sharded(tmp_path, n=2_000, shard_tokens=1_000)  # uint16 corpus
+    with pytest.raises(AssertionError, match="wire"):
+        SplitSource(str(tmp_path), "train", 64, vocab_size=128_256,
+                    process_index=0, process_count=1)
+    with pytest.raises(AssertionError, match="wire"):
+        DataLoader(str(tmp_path), 64, 4, grad_accum=1, seed=0,
+                   vocab_size=128_256)
+
+
+def test_legacy_source_bound_is_bit_exact(tmp_path):
+    toks = _mk_legacy(tmp_path, n=5_000)
+    src = SplitSource(str(tmp_path), "train", 64,
+                      process_index=0, process_count=1)
+    assert src.kind == "file"
+    assert src.n_positions == len(toks) - 64  # the legacy rng bound
+
+
+def test_fused_gather_matches_per_slice_reference(tmp_path):
+    """The legacy layout must keep loading byte-identically: the fused
+    fancy-index gather must hand out exactly the crops the seed loader's
+    per-slice loop produced for the same rng stream."""
+    import jax
+
+    toks = _mk_legacy(tmp_path, n=8_000)
+    dl = DataLoader(str(tmp_path), 32, 4, grad_accum=2, seed=11)
+    ref_rng = np.random.default_rng(11 + 1000 * jax.process_index())
+    for _ in range(3):
+        x, y = dl._sample_local("train")
+        ix = ref_rng.integers(0, len(toks) - 32, size=8)
+        rx = np.stack([toks[i:i + 32] for i in ix]).reshape(2, 4, 32)
+        ry = np.stack([toks[i + 1:i + 33] for i in ix]).reshape(2, 4, 32)
+        np.testing.assert_array_equal(np.asarray(x), rx)
+        np.testing.assert_array_equal(np.asarray(y), ry)
+
+
+# ---- deep prefetch ---------------------------------------------------------
+
+
+def test_deep_prefetch_preserves_stream_order(tmp_path):
+    """prefetch_depth > 1 stages ahead on a persistent worker, but the
+    CONSUMED stream must stay bit-identical to an unprefetched loader's
+    (extends test_prefetch_preserves_stream_order to the deep path)."""
+    _mk_sharded(tmp_path, n=20_000, shard_tokens=2_000)
+    deep = DataLoader(str(tmp_path), 32, 4, grad_accum=1, seed=5,
+                      prefetch_depth=4)
+    sync = DataLoader(str(tmp_path), 32, 4, grad_accum=1, seed=5)
+    got = []
+    for _ in range(4):
+        x, y = deep.get_batch_window("train", 2)
+        for j in range(2):
+            got.append((np.asarray(x)[j], np.asarray(y)[j]))
+    deep.close()
+    for gx, gy in got:
+        sx, sy = sync._sample_local("train")
+        np.testing.assert_array_equal(gx, sx)
+        np.testing.assert_array_equal(gy, sy)
+
+
+def test_deep_prefetch_error_raises_at_next_get_batch(tmp_path):
+    """A worker failure must surface at the NEXT consume — and keep
+    raising (sticky): the worker already advanced the rng for its
+    partial draws, so continuing would silently desync the stream."""
+    import time as _time
+
+    _mk_legacy(tmp_path, n=5_000)
+    dl = DataLoader(str(tmp_path), 32, 2, grad_accum=1, seed=0,
+                    prefetch_depth=3)
+    real = dl._sample_local
+    calls = [0]
+
+    def flaky(split):
+        calls[0] += 1
+        if calls[0] > 2:
+            raise OSError("disk pulled mid-run")
+        return real(split)
+
+    dl._sample_local = flaky
+    dl.get_batch_window("train", 1)  # serves batch 1, worker stages on
+    for _ in range(100):  # wait for the worker to hit the failure
+        if dl._deep.error is not None:
+            break
+        _time.sleep(0.02)
+    assert dl._deep.error is not None
+    with pytest.raises(RuntimeError, match="prefetch failed"):
+        dl.get_batch("train")
+    with pytest.raises(RuntimeError, match="prefetch failed"):  # sticky
+        dl.get_batch_window("train", 1)
+    dl.close()
+
+
+def test_deep_prefetch_counts_windows_and_hits(tmp_path):
+    _mk_legacy(tmp_path, n=5_000)
+    reset_registry()
+    try:
+        dl = DataLoader(str(tmp_path), 32, 2, grad_accum=1, seed=0,
+                        prefetch_depth=3)
+        for _ in range(4):
+            dl.get_batch_window("train", 1)
+        dl.close()
+        c = get_registry().snapshot()["counters"]
+        assert c["data_windows"] == 4
+        assert 0 <= c.get("data_prefetch_hit", 0) <= 4
+    finally:
+        reset_registry()
+
+
+def test_resume_state_counts_popped_not_staged(tmp_path):
+    """Prefetch stages rng draws AHEAD of consumption; the checkpointed
+    counts must cover only what the caller actually received (a kill
+    loses the staged tail, and resume must not replay it)."""
+    _mk_sharded(tmp_path, n=20_000, shard_tokens=2_000)
+    dl = DataLoader(str(tmp_path), 32, 2, grad_accum=1, seed=0,
+                    prefetch_depth=4)
+    for _ in range(2):
+        dl.get_batch_window("train", 2)
+    st = dl.resume_state()
+    dl.close()
+    assert st["batches"] == {"train": 4}
+    assert st["mixed"] is False
+
+
+# ---- mixing: determinism + kill-resume -------------------------------------
+
+
+def _mk_mix(tmp_path, *, weights="owt:0.7,code:0.3"):
+    """Two corpora (one sharded, one legacy) + a loader factory."""
+    owt = tmp_path / "owt"
+    code = tmp_path / "code"
+    owt.mkdir()
+    code.mkdir()
+    _mk_sharded(owt, n=20_000, shard_tokens=2_000, seed=1,
+                splits=("train", "val"))
+    _mk_legacy(code, n=12_000, seed=2, splits=("train", "val"))
+
+    def mk(mix=weights, **kw):
+        kw.setdefault("grad_accum", 1)
+        kw.setdefault("seed", 9)
+        return DataLoader(str(owt), 32, 4, mix=mix, **kw)
+
+    return mk
+
+
+def test_mixed_draws_from_both_corpora(tmp_path):
+    mk = _mk_mix(tmp_path)
+    dl = mk()
+    for _ in range(6):
+        dl.get_batch("train")
+    rep = dl.data_report()
+    crops = rep["crops"]["train"]
+    assert set(crops) == {"owt", "code"}
+    assert crops["owt"] + crops["code"] == 6 * 4
+    assert crops["owt"] > crops["code"]  # 0.7 vs 0.3 over 24 draws
+    assert rep["sources"]["owt/train"]["kind"] == "sharded"
+    assert rep["sources"]["code/train"]["kind"] == "file"
+
+
+def test_mixed_fast_forward_state_bit_identical(tmp_path):
+    """The kill-resume contract over a mixture: a fresh loader replayed
+    from resume_state must continue the EXACT batch stream."""
+    mk = _mk_mix(tmp_path)
+    a = mk()
+    for _ in range(5):
+        a.get_batch("train")
+    state = a.resume_state()
+    b = mk()
+    b.fast_forward_state(state)
+    for _ in range(3):
+        ax, ay = a.get_batch("train")
+        bx, by = b.get_batch("train")
+        np.testing.assert_array_equal(np.asarray(ax), np.asarray(bx))
+        np.testing.assert_array_equal(np.asarray(ay), np.asarray(by))
+    # and the replayed consumption is cumulative for the NEXT checkpoint
+    assert b.resume_state()["batches"]["train"] == 8
+
+
+def test_mixed_plan_fast_forward_bit_identical(tmp_path):
+    """The derived (iter-count) replay path — what a pre-data_state
+    checkpoint falls back to — must also land on the same stream when
+    the weights are unchanged."""
+    mk = _mk_mix(tmp_path)
+    a = mk()
+    for _ in range(4):
+        a.get_batch("train")
+    b = mk()
+    b.fast_forward([("train", 4)])
+    ax, _ = a.get_batch("train")
+    bx, _ = b.get_batch("train")
+    np.testing.assert_array_equal(np.asarray(ax), np.asarray(bx))
+
+
+def test_mixed_reweight_resume_keeps_corpus_streams(tmp_path):
+    """Mixture weights may change across a relaunch without desyncing
+    any corpus's stream: replay by checkpointed per-corpus COUNTS must
+    land every rng (selection + per-corpus) in exactly the state the
+    killed run left it."""
+    mk = _mk_mix(tmp_path)
+    a = mk("owt:0.7,code:0.3")
+    for _ in range(5):
+        a.get_batch("train")
+    state = a.resume_state()
+    b = mk("owt:0.5,code:0.5")  # relaunch re-weighted
+    b.fast_forward_state(state)
+    assert (b._sel_rng.bit_generator.state
+            == a._sel_rng.bit_generator.state)
+    for key, rng in a._rngs.items():
+        assert b._rngs[key].bit_generator.state == rng.bit_generator.state
+
+
+def test_mixed_deep_prefetch_stream_order(tmp_path):
+    """Mixing composes with the deep pipeline: consumed stream stays
+    bit-identical to the synchronous mixed loader's."""
+    mk = _mk_mix(tmp_path)
+    deep = mk(prefetch_depth=3)
+    sync = mk()
+    for _ in range(3):
+        x, _ = deep.get_batch_window("train", 2)
+        for j in range(2):
+            sx, _ = sync._sample_local("train")
+            np.testing.assert_array_equal(np.asarray(x)[j], sx)
+    deep.close()
+
+
+def test_mixed_state_shape_guards(tmp_path):
+    mk = _mk_mix(tmp_path)
+    a = mk()
+    a.get_batch("train")
+    state = a.resume_state()
+    # unmixed loader must refuse a mixed state (and vice versa)
+    owt = str(tmp_path / "owt")
+    plain = DataLoader(owt, 32, 4, grad_accum=1, seed=9)
+    with pytest.raises(AssertionError, match="mixed"):
+        plain.fast_forward_state(state)
+    # a corpus missing from the relaunch mix fails loud
+    b = mk("owt:1.0")
+    with pytest.raises(AssertionError, match="code"):
+        b.fast_forward_state(state)
+
+
+def test_unmixed_resume_state_roundtrip(tmp_path):
+    _mk_sharded(tmp_path, n=20_000, shard_tokens=2_000)
+    a = DataLoader(str(tmp_path), 32, 4, grad_accum=1, seed=3)
+    for _ in range(4):
+        a.get_batch("train")
+    b = DataLoader(str(tmp_path), 32, 4, grad_accum=1, seed=3)
+    b.fast_forward_state(a.resume_state())
+    ax, _ = a.get_batch("train")
+    bx, _ = b.get_batch("train")
+    np.testing.assert_array_equal(np.asarray(ax), np.asarray(bx))
+
+
+# ---- chaos soak (subprocess, slow) -----------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_mixed_subprocess(tmp_path):
+    """SIGKILL + resume over a sharded+legacy weighted mixture with deep
+    prefetch: trajectory bit-equality end to end through train.py
+    (tools/chaos_train.py --mix=1)."""
+    report_path = tmp_path / "chaos.json"
+    r = subprocess.run(
+        [sys.executable, "tools/chaos_train.py", "--mix=1", "--seed=2",
+         "--kills=2", "--max_iters=8", "--eval_interval=4",
+         f"--workdir={tmp_path / 'work'}", f"--out={report_path}"],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=1800,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(report_path.read_text())
+    assert report["ok"] is True
+    assert report["bit_identical"] is True
+    assert report["config"]["mix"] is True

@@ -60,6 +60,7 @@ def _cli(data_dir, out_dir, cfg, extra):
         max_iters=cfg["max_iters"], keep_checkpoints=cfg["keep"],
         metrics_log=True, dtype="float32",
     )
+    args.update(cfg.get("extra_args") or {})
     args.update(extra)
     return [sys.executable, "train.py"] + [f"--{k}={v}"
                                            for k, v in args.items()]
@@ -178,6 +179,44 @@ def _log_counters(metrics_path):
     return totals
 
 
+def _build_mixed_corpus(work, *, seed=7):
+    """Two corpora carved from ONE synthetic text (so they share a
+    stoi/vocab): 'owt' in the sharded MANIFEST layout, 'code' as a
+    legacy single-file dir — the kill-resume proof then covers sharded
+    reads, legacy reads, AND per-corpus mixed-stream replay in one run.
+    Returns the dir train.py gets as --dataset ('code' resolves as its
+    sibling via the data_mix name resolution)."""
+    import shutil
+
+    import numpy as np
+
+    from avenir_tpu.data.loader import read_wire_format
+    from avenir_tpu.data.streaming import write_token_shards
+    from avenir_tpu.utils.corpus import synthetic_corpus, write_char_dataset
+
+    base = os.path.join(work, "data-base")
+    owt = os.path.join(work, "owt")
+    code = os.path.join(work, "code")
+    if os.path.isdir(os.path.join(owt, "train.shards")):
+        return owt  # reused workdir
+    write_char_dataset(base, synthetic_corpus(n_chars=60_000, seed=seed))
+    for name, d in (("owt", owt), ("code", code)):
+        os.makedirs(d, exist_ok=True)
+        for split in ("train", "val"):
+            src = os.path.join(base, f"{split}.bin")
+            dt, off = read_wire_format(src)
+            arr = np.fromfile(src, dtype=dt, offset=off)
+            half = len(arr) // 2
+            if name == "owt":
+                write_token_shards(os.path.join(d, f"{split}.shards"),
+                                   arr[:half], shard_tokens=4096)
+            else:
+                arr[half:].tofile(os.path.join(d, f"{split}.bin"))
+        shutil.copy(os.path.join(base, "meta.pkl"),
+                    os.path.join(d, "meta.pkl"))
+    return owt
+
+
 def _flip_byte(path, rng):
     with open(path, "r+b") as f:
         f.seek(0, 2)
@@ -203,22 +242,34 @@ def main():
         "drill": a.get("drill", "kills"),  # kills | corruption | all
         "out": a.get("out", ""),
         "workdir": a.get("workdir", ""),
+        # --mix=1: run the whole drill on a weighted two-corpus mixture
+        # (one sharded, one legacy layout) with deep prefetch — the
+        # ISSUE 19 streaming loader's kill-resume proof
+        "mix": a.get("mix", "") not in ("", "0"),
+        "prefetch_depth": int(a.get("prefetch_depth", 3)),
     }
     rng = random.Random(cfg["seed"])
     import tempfile
 
     work = cfg["workdir"] or tempfile.mkdtemp(prefix="avenir-chaos-")
     os.makedirs(work, exist_ok=True)
-    data_dir = os.path.join(work, "data")
-    if not os.path.exists(os.path.join(data_dir, "train.bin")):
-        from avenir_tpu.utils.corpus import synthetic_corpus, write_char_dataset
+    if cfg["mix"]:
+        data_dir = _build_mixed_corpus(work)
+        cfg["extra_args"] = {"data_mix": "owt:0.65,code:0.35",
+                             "prefetch_depth": cfg["prefetch_depth"]}
+    else:
+        data_dir = os.path.join(work, "data")
+        if not os.path.exists(os.path.join(data_dir, "train.bin")):
+            from avenir_tpu.utils.corpus import (synthetic_corpus,
+                                                 write_char_dataset)
 
-        write_char_dataset(data_dir, synthetic_corpus(n_chars=60_000, seed=7))
+            write_char_dataset(data_dir,
+                               synthetic_corpus(n_chars=60_000, seed=7))
 
     report = {"tool": "chaos_train", "seed": cfg["seed"],
               "config": {k: cfg[k] for k in
                          ("kills", "max_iters", "eval_interval", "keep",
-                          "faults", "drill")},
+                          "faults", "drill", "mix", "prefetch_depth")},
               "kills": [], "ok": True}
 
     if cfg["drill"] in ("kills", "all"):

@@ -47,6 +47,11 @@ dataset = "openwebtext"
 gradient_accumulation_steps = 5 * 8
 batch_size = 12  # micro-batch size per device
 block_size = 1024
+# streaming loader (jax path): blend corpora per-crop ('owt:0.7,code:0.3',
+# names resolved next to `dataset`'s dir) and stage batches deeper than the
+# default double buffer (>=2 keeps prefetch_depth x window batches ahead)
+data_mix = ""
+prefetch_depth = 1
 # model
 model_type = "gpt"  # 'gpt' | 'llama' | 'mixtral' (llama/mixtral are tpu-only)
 n_layer = 12
