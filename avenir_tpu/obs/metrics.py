@@ -318,6 +318,31 @@ METRIC_SCHEMA = {
         "gauge", "1",
         "fraction of KV slots live (decoding or mid-chunked-prefill) "
         "after the last engine step"),
+    # -- live weight lifecycle (serve/rollout.py, ISSUE 20) --
+    "rollouts": (
+        "counter", "1",
+        "rolling weight-swap campaigns started by Router.rollout "
+        "(serve/rollout.py); every stage transition has a matching "
+        "`rollout` trace event carrying the evidence, and a row in "
+        "tools/fleet_report.py"),
+    "rollbacks": (
+        "counter", "1",
+        "rollout campaigns reverted to the previous weight version — "
+        "canary detector fire, mid-rollout anomaly, or mixing-window "
+        "overrun; the `rollout` trace event names the trigger"),
+    "canary_anomalies": (
+        "counter", "1",
+        "drift-detector fires against the canary replica during a "
+        "rollout's canary stage (the RolloutManager's private "
+        "obs/anomaly.py oldest-half detector panel); each fire also "
+        "triggers the automatic rollback"),
+    "weight_version": (
+        "gauge", "1",
+        "numeric weight version the fleet last converged on (trailing "
+        "integer of the version label, e.g. iter-00000120 -> 120; "
+        "ordinal otherwise). Mid-rollout the fleet is version-MIXED "
+        "and this gauge holds the previous converged value until the "
+        "campaign lands"),
     # -- paged KV (serve/pages.py, kv_impl='paged') --
     "kv_pages_free": (
         "gauge", "1",

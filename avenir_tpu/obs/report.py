@@ -205,6 +205,14 @@ def summarize(records, *, skipped_lines=()):
                 if (counters.get("prefix_tokens_missed", 0.0)
                     + counters.get("prefix_tokens_cold", 0.0)) > 0
                 else 0.0),
+            # live weight lifecycle (ISSUE 20): campaign counts + the
+            # version the fleet last CONVERGED on (gauge snapshot —
+            # mid-rollout it still names the previous converged value)
+            "rollouts": counters.get("rollouts", 0.0),
+            "rollbacks": counters.get("rollbacks", 0.0),
+            "canary_anomalies": counters.get("canary_anomalies", 0.0),
+            "weight_version": (end.get("gauges")
+                               or {}).get("weight_version"),
             # fleet KV CDN (ISSUE 17): affinity placements + the peer
             # pull ledger (pages/bytes shipped, fallbacks taken)
             "affinity_hits": counters.get("affinity_hits", 0.0),
@@ -404,6 +412,12 @@ def format_report(s):
              if sv.get("replica_seconds") else ""),
             (f"prewarm ticks {sv['prewarm_ticks']:.0f}"
              if sv.get("prewarm_ticks") else ""),
+            (f"version: {sv['weight_version']:.0f}"
+             + (f" (rollouts {sv['rollouts']:.0f}"
+                + (f", ROLLBACKS {sv['rollbacks']:.0f}"
+                   if sv.get("rollbacks") else "") + ")"
+                if sv.get("rollouts") else "")
+             if sv.get("weight_version") is not None else ""),
             (f"affinity hits {sv['affinity_hits']:.0f}"
              if sv.get("affinity_hits") else ""),
             (f"pulls {sv['prefix_pull_pages']:.0f} pages/"

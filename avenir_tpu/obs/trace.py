@@ -93,6 +93,14 @@ TRACE_EVENTS = {
                      # 'ok', or the fallback taken ('src_dead',
                      # 'src_evicted', 'src_gone', 'dst_dead'); every
                      # non-ok outcome also bumps prefix_pull_fallbacks
+    "rollout",       # one weight-lifecycle decision (rid=None): action
+                     # (begin/canary_start/canary_pass/swap_begin/
+                     # swap_done/rollback_begin/rollback_done/done),
+                     # reason, from/to version, replica, and the
+                     # evidence that drove it (detector z/rel, burn
+                     # rate, mixing-window age) — the auditable rollout
+                     # trail, `scale`-shaped (serve/rollout.py,
+                     # ISSUE 20)
     "anomaly",       # one health-engine detector fire (rid=None):
                      # detector/key/value/threshold + robust-statistic
                      # evidence (obs/anomaly.py, ISSUE 14) — also a

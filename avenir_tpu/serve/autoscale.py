@@ -516,8 +516,17 @@ class Autoscaler:
                 and getattr(r, "_supervisor", None) is None):
             return self._scale_up(now, "replace_dead", evidence)
 
+        # rollout coordination (ISSUE 20): while a weight rollout (or
+        # its rollback) converges the fleet, scale-DOWN decisions are
+        # suppressed — retiring mid-campaign would thrash the version
+        # accounting and could dip attainment exactly when a replica is
+        # out for its swap. Scale-UP stays allowed: extra capacity only
+        # helps the rollout hold the SLO floor
+        rolling = getattr(r, "rollout_active", False)
+
         # 3) scale-to-zero idle retirement (batch-class mode)
-        if self.scale_to_zero and alive > 0 and not has_work:
+        if (self.scale_to_zero and alive > 0 and not has_work
+                and not rolling):
             if self._idle_since is None:
                 self._idle_since = now
             elif (now - self._idle_since >= self.idle_to_zero_s
@@ -544,7 +553,8 @@ class Autoscaler:
 
         # 5) scale down: burn below the band AND the shrunken fleet
         # would still sit below the utilization ceiling — sustained
-        surplus = ((burn is None or burn <= self.down_burn)
+        surplus = (not rolling
+                   and (burn is None or burn <= self.down_burn)
                    and alive > max(1, self.min_replicas)
                    and util_avg * alive / (alive - 1) <= self.down_util)
         if surplus:

@@ -26,6 +26,16 @@ is the consumer that must tolerate exactly this approximation.
 Staleness: each update stamps the fleet clock; a dead replica's summary
 is dropped by the router's failover path, so a corpse's cache content
 never keeps advertising itself (the `_EngineProxy.clear()` rule).
+
+Version keying (ISSUE 20): each update also carries the advertising
+replica's `weight_version`, and match()/best_match() take the fleet's
+current version view — an advertisement recorded under a different
+version than its replica NOW serves never matches, and a consumer can
+restrict matches to its own version. KV is only reusable under the
+exact weights that produced it: attaching (or pulling) a chain across a
+weight-version boundary would decode new weights against old-weights KV
+— silently wrong output, not a perf loss. The pre-ISSUE-20 map was
+version-blind, which made every weight swap a correctness hazard.
 """
 
 import time
@@ -55,19 +65,32 @@ class FleetCacheMap:
         self._nodes = {}   # replica_id -> {digest: [n_tok, depth, ref,
         #                                            hits, last_use]}
         self._stamp = {}   # replica_id -> fleet-clock update time
+        self._ver = {}     # replica_id -> weight_version at update time
 
-    def update(self, replica_id, nodes, now=None):
+    def update(self, replica_id, nodes, now=None, version=None):
         """Replace one replica's summary (inproc replicas hand the
-        direct summary; process replicas hand the delta-merged mirror)."""
+        direct summary; process replicas hand the delta-merged mirror).
+        `version` records the weight version the advertising replica
+        served when the summary was taken — the key match() compares
+        against the fleet's CURRENT version view (ISSUE 20)."""
         self._nodes[replica_id] = dict(nodes or {})
         self._stamp[replica_id] = (self._clock() if now is None
                                    else float(now))
+        self._ver[replica_id] = (None if version is None
+                                 else str(version))
 
     def drop(self, replica_id):
-        """Forget a replica (death/retire): a corpse's cache content
-        must not keep winning best_match."""
+        """Forget a replica (death/retire/weight swap): a corpse's —
+        or a previous weight version's — cache content must not keep
+        winning best_match."""
         self._nodes.pop(replica_id, None)
         self._stamp.pop(replica_id, None)
+        self._ver.pop(replica_id, None)
+
+    def version(self, replica_id):
+        """Weight version this replica's summary was recorded under
+        (None when unversioned — pre-swap updates or tests)."""
+        return self._ver.get(replica_id)
 
     def replicas(self):
         return sorted(self._nodes)
@@ -83,14 +106,27 @@ class FleetCacheMap:
             return None
         return (self._clock() if now is None else float(now)) - t
 
-    def match(self, prompt):
+    def match(self, prompt, versions=None):
         """{replica_id: deepest matching chain depth in TOKENS} for
         `prompt` against every tracked summary. Each distinct advertised
-        depth is digested at most once per call."""
+        depth is digested at most once per call.
+
+        `versions` (ISSUE 20): {replica_id: current weight_version} —
+        the fleet's live view. When given, a replica whose summary was
+        recorded under a DIFFERENT version than it now serves (or whose
+        current version is unknown) scores 0: a post-swap replica's old
+        advertisement must never win placement or source a pull. None
+        preserves the version-blind behavior for single-version fleets
+        and telemetry-only consumers."""
         prompt = [int(t) for t in prompt]
         dig = {}  # depth -> digest of prompt[:depth], computed lazily
         out = {}
         for rid, nodes in self._nodes.items():
+            if versions is not None and (
+                    versions.get(rid) is None
+                    or self._ver.get(rid) != str(versions[rid])):
+                out[rid] = 0
+                continue
             best = 0
             for d, node in nodes.items():
                 n = int(node[0])
@@ -104,11 +140,12 @@ class FleetCacheMap:
             out[rid] = best
         return out
 
-    def best_match(self, prompt):
+    def best_match(self, prompt, versions=None):
         """(replica_id, deepest shared-chain tokens) — the fleet-best
         placement for `prompt`, or (None, 0) when no tracked replica
-        shares any prefix. Deterministic tie-break on replica id."""
-        m = self.match(prompt)
+        shares any prefix. Deterministic tie-break on replica id.
+        `versions` filters exactly as in match()."""
+        m = self.match(prompt, versions=versions)
         best_rid, best_n = None, 0
         for rid in sorted(m, key=str):
             if m[rid] > best_n:

@@ -176,7 +176,8 @@ class Engine:
                  prefill_chunk=None, prefix_sharing=True,
                  paged_attn_impl="auto", tracer=None, kv_dtype="bf16",
                  spec_decode="off", spec_k=4, draft_model=None,
-                 role="both", health_series=False, chain_topk=0):
+                 role="both", health_series=False, chain_topk=0,
+                 weight_version="0"):
         """`kv_impl` (ISSUE 9, the attn_impl/loss_impl pattern):
         'slab' keeps the fixed per-slot KV columns (serve/slots.py);
         'paged' stores KV in a pool of `n_pages` blocks of `page_size`
@@ -277,6 +278,14 @@ class Engine:
                     "prefill-class replica never decodes, and the draft "
                     "slab cannot ride a page transfer")
         self.role = role
+        # weight_version (ISSUE 20): opaque label naming the weights
+        # this engine serves (a checkpoint generation, e.g.
+        # 'iter-00000120'). Pure bookkeeping — the engine never
+        # interprets it; the rollout manager rewrites it at swap time
+        # and it rides every stats() heartbeat so the router can
+        # version-key KV reuse (stale-KV-under-new-weights is a
+        # silent-wrongness bug, not a perf bug).
+        self.weight_version = str(weight_version)
         # spec_k (ISSUE 18): an int fixes k; 'auto' makes k per-request
         # ADAPTIVE — each live slot walks the k bucket ladder
         # (bucket_ladder(cap, floor=1)) on its measured accept-rate
@@ -1028,6 +1037,7 @@ class Engine:
                      for lv in self._live.values()},
             "pending": len(self._pending),
             "tick_s": self.tick_estimate_s(),
+            "weight_version": self.weight_version,
         }
         if self._paged is not None:
             # the heartbeat carries the page budget (ISSUE 9 satellite):

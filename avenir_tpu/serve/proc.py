@@ -158,6 +158,7 @@ class _EngineProxy:
         self._prefilling = 0       # paged: slots mid-chunked-prefill
         self.kv = None             # paged: page-budget heartbeat mirror
         self.chains = None         # paged: chain-summary mirror (ISSUE 16)
+        self.weight_version = "0"  # versioned hello echo (ISSUE 20)
         self._tick_s = 0.0
 
     def tick_estimate_s(self):
@@ -176,6 +177,11 @@ class _EngineProxy:
         self._prefilling = int(hb.get("prefilling", 0))
         if hb.get("kv") is not None:
             self.kv = dict(hb["kv"])  # page budget rides every beat
+        if hb.get("weight_version") is not None:
+            # every heartbeat re-asserts the serving version — the
+            # router's version-keyed cache map reads THIS mirror, so a
+            # swapped worker's first reply already re-keys its chains
+            self.weight_version = str(hb["weight_version"])
         self._tick_s = float(hb.get("tick_s", 0.0))
 
     def apply_chain_delta(self, delta):
@@ -328,6 +334,59 @@ class ProcReplica(ReplicaHealth):
         # worker pre-warms too; `prewarm_ticks` mirrors via the usual
         # counter deltas)
         self.prewarm_ticks = int(reply.get("prewarm_ticks", 0))
+        self.engine.weight_version = str(reply.get("weight_version", "0"))
+        self.last_beat = self._clock()
+        return self
+
+    @property
+    def weight_version(self):
+        """Version label of the weights the worker ACTUALLY serves —
+        the hello echo, re-asserted by every heartbeat (a respawn that
+        landed on a different spec is visible here, not assumed)."""
+        return self.engine.weight_version
+
+    def set_model_spec(self, spec, version=None):
+        """Point every FUTURE hello at `spec` (serve/rollout.py): the
+        next reload() — or a supervisor revive() after a death — will
+        rebuild the worker from it. The rollout manager calls this
+        BEFORE touching the worker, so a SIGKILL mid-swap respawns on
+        the TARGET version instead of resurrecting the old weights
+        (ISSUE 20: respawns route through the CURRENT target)."""
+        self._spec = spec
+        if version is not None:
+            self._ekw["weight_version"] = str(version)
+
+    def reload(self):
+        """Controlled restart onto the current `self._spec` — the
+        process backend's weight swap (drain -> re-hello -> prewarm ->
+        rejoin, serve/rollout.py). revive()'s respawn path WITHOUT a
+        death: a swap is a decision, not a failure, so `deaths` and the
+        supervisor's backoff budget stay untouched. Caller drains
+        first. Raises on spawn/handshake failure — the rollout manager
+        marks the replica dead and the supervisor (aimed at the same
+        spec by set_model_spec) takes over the retry."""
+        assert not self.busy, "weight swap requires a drained replica"
+        self._teardown(kill=True)
+        self._counters_seen = {}
+        self._submit_t = {}
+        self._t_first = {}
+        self._deadline = {}
+        self._export_pending = []
+        self._trace_pending = []
+        self._trace_dropped = 0
+        self._durs = []
+        self._n_busy_steps = 0
+        self._seen_buckets = set()
+        self._grace_steps = 2
+        self._stalled = False
+        self.last_error = None
+        self._spawn()
+        try:
+            self.finish_handshake()
+        except Exception:
+            self._teardown(kill=True)
+            raise
+        self.state = HEALTHY
         self.last_beat = self._clock()
         return self
 
@@ -709,6 +768,13 @@ class ProcReplica(ReplicaHealth):
             return []
         t0 = self._clock()
         had_work = self.busy
+        # serve_step_degrade (ISSUE 20): parent-side like the inproc
+        # consult, so seeded poisoned-canary schedules replay on both
+        # backends; each fire is a PERMANENT +2 ms per busy step
+        if inj.should_fire("serve_step_degrade"):
+            self._degrade_s = getattr(self, "_degrade_s", 0.0) + 0.002
+        if had_work and getattr(self, "_degrade_s", 0.0):
+            time.sleep(self._degrade_s)
         try:
             inj.fail("serve_step_fail", f"replica {self.replica_id}")
         except Exception as e:  # noqa: BLE001 — FaultInjected is OSError

@@ -42,6 +42,7 @@ already rests on.
 """
 
 import statistics
+import time
 
 from avenir_tpu.serve.engine import Engine
 from avenir_tpu.utils.faults import get_injector
@@ -244,6 +245,31 @@ class Replica(ReplicaHealth):
             raise ReplicaGone(f"replica {self.replica_id} is dead")
         return self.engine.export_chain(token_pages, n_prefix=n_prefix)
 
+    # -- live weight lifecycle (ISSUE 20) --
+
+    @property
+    def weight_version(self):
+        """The version label of the weights this replica serves — the
+        router's version-keying input for KV reuse and the rollout
+        manager's convergence check."""
+        return getattr(self.engine, "weight_version", "0")
+
+    def set_weights(self, state, version):
+        """In-place weight swap (serve/rollout.py): load `state` into
+        the model module, re-snapshot the engine's parameter split, and
+        HARD-RESET host state — the previous version's prefix chain,
+        queue, and page refcounts must not survive into the new one
+        (stale-KV-under-new-weights is silent wrongness, which is why
+        this is not optional). Caller drains first: an idle engine is
+        the precondition, exactly like prewarm."""
+        assert not self.busy, "weight swap requires a drained replica"
+        from flax import nnx
+
+        nnx.update(self.engine.model, state)
+        self.engine.refresh_state()
+        self.engine.reset_host_state()
+        self.engine.weight_version = str(version)
+
     # -- capacity surface the router routes on --
 
     @property
@@ -292,6 +318,15 @@ class Replica(ReplicaHealth):
             return []
         t0 = self._clock()
         had_work = self.busy
+        # serve_step_degrade (ISSUE 20): each fire adds a PERMANENT
+        # +2 ms of host latency to every subsequent busy step — the
+        # poisoned canary. The sleep is real wall time so TTFT/TPOT
+        # measured on the engine clock actually inflate; nothing but
+        # the drift detectors can tell (the train_step_degrade idiom)
+        if inj.should_fire("serve_step_degrade"):
+            self._degrade_s = getattr(self, "_degrade_s", 0.0) + 0.002
+        if had_work and getattr(self, "_degrade_s", 0.0):
+            time.sleep(self._degrade_s)
         try:
             inj.fail("serve_step_fail", f"replica {self.replica_id}")
             finished = self.engine.step()

@@ -1,0 +1,648 @@
+"""Live weight lifecycle (ISSUE 20 tentpole): zero-downtime rolling
+rollout, canary analysis, anomaly-triggered auto-rollback.
+
+A production fleet's weights change daily; until this module, ours were
+frozen at spawn. `Router.rollout(version)` arms a RolloutManager that
+Router.step drives one poll per fleet iteration:
+
+    BASELINE      collect fleet TTFT/TPOT windows under the OLD
+                  version — the oldest-half reference the drift
+                  detectors (obs/anomaly.py, ISSUE 14) compare against
+    CANARY_SWAP   drain ONE replica, swap it to the target version
+                  (drain -> re-hello/reload -> prewarm -> rejoin), and
+    CANARY        stream only ITS terminal records into the same
+                  series: the detector's oldest-half baseline is the
+                  fleet, its recent windows are the canary, so a fire
+                  IS "the canary drifted from the fleet"
+    ROLLING       canary passed: swap the remaining replicas one at a
+                  time, each gated on fleet health (every other
+                  non-dead replica HEALTHY) and the SLO burn rate, so
+                  the fleet never dips below attainment for a swap;
+                  target-version replicas keep feeding the detectors
+    ROLLING_BACK  a detector fired (or the version-mixing window blew
+                  its bound): converge every target-version replica
+                  back to the previous generation with the same
+                  drain/swap machinery — no gating, rollback is the
+                  emergency path
+    DONE          converged (forward, or rolled back)
+
+Robustness contract (the chaos drill pins all of these):
+  * a SIGKILL'd replica mid-swap respawns on the TARGET version — its
+    model spec is retargeted BEFORE its drain begins, so the
+    RespawnSupervisor's revive() re-hello cannot resurrect old weights
+    (during ROLLING every replica is retargeted up front, so ANY death
+    lands on target and counts as its swap);
+  * a rollback mid-rollout retargets every spec back first, then
+    converges — deaths during rollback respawn OLD;
+  * 0 accepted requests lost: swaps only ever run on a DRAINED idle
+    replica, and deaths take the router's normal failover/requeue path;
+  * the version-mixing window (first target-serving moment ->
+    convergence) is measured, bounded by `max_mixing_s`, and a breach
+    triggers rollback rather than an indefinitely mixed fleet.
+
+KV safety: a weight swap invalidates the replica's prefix chain
+(reset_host_state / worker reload) and drops its cache-map
+advertisement; the version-keyed FleetCacheMap and the router's
+version-fenced pull/handoff paths (ISSUE 20 satellites) guarantee no
+chain ever crosses a weight-version boundary — stale KV under new
+weights is silently wrong output, not a perf loss.
+
+Every decision is an auditable `rollout` trace event with evidence
+attrs plus a host-side decision log — the autoscaler's `scale`-event
+discipline applied to the weight control plane.
+"""
+
+import os
+import re
+
+from avenir_tpu.obs.anomaly import AnomalyEngine, Detector
+from avenir_tpu.serve.replica import DEAD, DRAINING, HEALTHY
+
+# phases
+BASELINE = "baseline"
+CANARY_SWAP = "canary_swap"
+CANARY = "canary"
+ROLLING = "rolling"
+ROLLING_BACK = "rolling_back"
+DONE = "done"
+
+_ORDINALS = {}  # version label -> ordinal, for labels with no digits
+
+
+def version_number(label):
+    """Numeric value for the weight_version gauge: the label's trailing
+    integer (iter-00000120 -> 120), else a stable order-seen ordinal —
+    gauges need numbers, version labels are strings."""
+    m = re.search(r"(\d+)\s*$", str(label))
+    if m:
+        return int(m.group(1))
+    return _ORDINALS.setdefault(str(label), len(_ORDINALS) + 1)
+
+
+def resolve_generation(version, out_dir):
+    """(label, worker model spec) for a committed checkpoint generation
+    under `out_dir` (checkpoint/io.py generation ring). `version` is
+    'latest'/None (newest), an iteration number, or a generation
+    directory basename — the train->serve promotion path: a committed
+    generation becomes a servable {'kind': 'checkpoint'} hello spec."""
+    from avenir_tpu.checkpoint.io import list_generations
+
+    gens = list_generations(out_dir)
+    if not gens:
+        raise FileNotFoundError(
+            f"no committed checkpoint generations under {out_dir!r}")
+    if version in (None, "latest"):
+        it, form, path = gens[0]
+    else:
+        want = str(version)
+        for it, form, path in gens:
+            if want in (str(it), f"iter-{it:08d}",
+                        os.path.basename(path)):
+                break
+        else:
+            raise KeyError(
+                f"no generation matching {version!r} under {out_dir!r} "
+                f"(have: {[os.path.basename(p) for _, _, p in gens]})")
+    return os.path.basename(path), {"kind": "checkpoint", "out_dir": path}
+
+
+def canary_detectors(params=None):
+    """The canary analysis panel: TTFT/TPOT oldest-half drift plus
+    spec accept-rate collapse (fed only on spec-decoding fleets), with
+    per-detector knob overrides ({name: {knob: value}}). cooldown_s=0
+    on purpose — the first emission triggers the rollback, there is
+    nothing to re-fire after. min_rel is raised to 0.5 over the fleet
+    panel's 0.25: a just-swapped canary rejoins EMPTY, so fair-share
+    dispatch briefly overloads it relative to its still-loaded peers —
+    a few-tenths relative rise is that rebalancing bias (observed live:
+    a clean canary at rel 0.34, z 4.1), while genuinely bad weights
+    show up in multiples, not tenths."""
+    p = dict(params or {})
+
+    def _mk(name, **defaults):
+        return Detector(name, **{**defaults, **p.get(name, {})})
+
+    return [
+        _mk("ttft_drift", cooldown_s=0.0, min_rel=0.5),
+        _mk("tpot_drift", cooldown_s=0.0, min_rel=0.5),
+        _mk("accept_rate_collapse", cooldown_s=0.0),
+    ]
+
+
+class RolloutManager:
+    """One rollout campaign over a Router fleet. Construct via
+    `Router.rollout(...)`; `Router.step` calls `poll()` (state machine)
+    and `observe()` (terminal-record feed) each fleet iteration.
+
+    Knobs (docs/SERVING.md "Weight lifecycle" table):
+      baseline_min_requests  fleet terminal records required before the
+                             canary swap begins (0 skips straight to
+                             the swap — no-load maintenance rollouts)
+      canary_min_requests    canary-served records required for a PASS
+                             verdict (0 = health-gated swap only)
+      baseline_hold_s /      minimum phase durations, in fleet-clock
+      canary_hold_s          seconds — the drift detectors need whole
+                             windows, not just request counts (default
+                             8 x window_s each)
+      window_s               detector window width (obs/series.Series)
+      detector_params        per-detector overrides for the canary
+                             panel ({'ttft_drift': {'sustain': 2}, ...})
+      slo / hold_burn        optional SLOEngine: a forward swap waits
+                             while burn_rate() > hold_burn (rollback
+                             never waits — it IS the mitigation)
+      max_mixing_s           version-mixing bound: first target-serving
+                             moment -> convergence; a breach triggers
+                             rollback with reason
+                             'mixing_window_exceeded'
+      settle_s               detector blackout after every swap lands
+                             (default 6 x window_s): taking a replica
+                             out for its swap is a SELF-INDUCED
+                             capacity transient — requests that queued
+                             while it drained finish with inflated
+                             TTFT, and feeding them would read the
+                             campaign's own mechanics as a regression
+                             of the new weights (observed live: a
+                             clean rollout rolling itself back on z 8.6
+                             'drift' that was just the 2/3-capacity
+                             window). Records produced while a swap is
+                             in flight, or within settle_s after one,
+                             never reach the detectors
+      canary_id              replica id to canary (default: the lowest
+                             healthy id)
+    """
+
+    def __init__(self, router, version, *, state=None, spec=None,
+                 out_dir=None, slo=None, hold_burn=1.0,
+                 baseline_min_requests=8, canary_min_requests=8,
+                 baseline_hold_s=None, canary_hold_s=None,
+                 window_s=0.5, detector_params=None, detectors=None,
+                 max_mixing_s=120.0, settle_s=None, canary_id=None,
+                 echo=print):
+        self.r = router
+        self._reg = router._reg
+        self._clock = router._clock
+        self._echo = echo
+        self.slo = slo
+        self.hold_burn = float(hold_burn)
+        self.baseline_min_requests = int(baseline_min_requests)
+        self.canary_min_requests = int(canary_min_requests)
+        self.window_s = float(window_s)
+        self.baseline_hold_s = (float(baseline_hold_s)
+                                if baseline_hold_s is not None
+                                else 8.0 * self.window_s)
+        self.canary_hold_s = (float(canary_hold_s)
+                              if canary_hold_s is not None
+                              else 8.0 * self.window_s)
+        self.max_mixing_s = float(max_mixing_s)
+        self.settle_s = (float(settle_s) if settle_s is not None
+                         else 6.0 * self.window_s)
+        self._canary_pick = canary_id
+
+        # -- resolve the target (and remember the old world) --
+        if out_dir is not None and spec is None and state is None:
+            label, spec = resolve_generation(version, out_dir)
+            if version in (None, "latest"):
+                version = label
+        self.version = str(version)
+        vers = {getattr(rep, "weight_version", "0")
+                for rep in router.replicas if rep.state != DEAD}
+        assert len(vers) <= 1, (
+            f"fleet is version-mixed at rollout start ({sorted(vers)}) "
+            "— converge (or roll back) the previous campaign first")
+        self.old_version = vers.pop() if vers else "0"
+        assert self.version != self.old_version, (
+            f"fleet already serves {self.version!r}")
+        if router.backend == "process":
+            if spec is None:
+                raise ValueError(
+                    "process-backend rollout needs a worker model spec "
+                    "— pass out_dir=<generation ring> (preferred) or "
+                    "spec=<hello model spec>")
+            self._target_spec, self._target_state = spec, None
+            self._old_spec = router._spec
+        else:
+            if state is None and out_dir is not None:
+                # inproc promotion from the generation ring: rebuild
+                # the generation's model and take its parameter state
+                from flax import nnx
+
+                from avenir_tpu.checkpoint.io import load_checkpoint
+                from avenir_tpu.sampling import model_from_checkpoint
+
+                _, gen_spec = resolve_generation(version, out_dir)
+                m, _ = model_from_checkpoint(
+                    load_checkpoint(gen_spec["out_dir"]))
+                state = nnx.split(m)[1]
+            if state is None:
+                raise ValueError(
+                    "in-process rollout needs the target parameter "
+                    "state — pass state=<nnx state> or out_dir=...")
+            self._target_state, self._target_spec = state, None
+            # numpy snapshot of the OLD weights for rollback: after the
+            # canary swap the shared module holds target arrays, and
+            # jax arrays in the old engines' snapshots are refs we must
+            # not rely on staying alive
+            import numpy as np
+            from flax import nnx
+            import jax
+
+            self._old_state = jax.tree.map(
+                lambda x: np.asarray(x), nnx.split(router._model)[1])
+            self._old_spec = None
+
+        # -- canary analysis engine (ISSUE 14 reused wholesale): same
+        # Series/Detector/emission machinery, private store — BASELINE
+        # feeds the fleet, CANARY feeds only the canary, so the drift
+        # method's oldest-half baseline is by construction the
+        # fleet-vs-canary comparison the verdict needs --
+        self._ae = AnomalyEngine(
+            registry=self._reg, sink=getattr(router, "sink", None),
+            tracer=router.tracer, clock=self._clock,
+            detectors=(detectors if detectors is not None
+                       else canary_detectors(detector_params)),
+            window_s=self.window_s, check_interval_s=self.window_s)
+
+        self.phase = BASELINE
+        self.active = True
+        self.rolled_back = False
+        self.rollback_reason = None
+        self.decisions = []        # host-side audit log (bench artifact)
+        self.canary_replica = None
+        self._swapping = None      # replica_id mid-drain for its swap
+        self._baseline_seen = 0
+        self._canary_seen = 0
+        self._t0 = self._clock()
+        self._t_phase = self._t0
+        self.t_mix_start = None    # first target-serving moment
+        self.mixing_s = None       # measured at convergence
+        self._tripped = None       # anomaly evidence awaiting poll()
+        self._t_settle = None      # detector blackout end (post-swap)
+        self._fired_seen = 0       # len(self._ae.fired) already handled
+        self._retargeted = False   # fleet-wide spec retarget done?
+        # pre-create so a clean campaign still exports all three
+        self._reg.counter("rollouts")
+        self._reg.counter("rollbacks")
+        self._reg.counter("canary_anomalies")
+
+    # -- audit --
+
+    def _decide(self, action, *, reason=None, replica=None, now=None,
+                **evidence):
+        """One auditable lifecycle decision: trace event + host log +
+        echo (counters are bumped by the callers that own them) — the
+        autoscaler `scale` discipline applied to weights."""
+        now = self._clock() if now is None else now
+        rec = {"ts": round(now, 4), "action": action, "reason": reason,
+               "replica": replica, "from_version": self.old_version,
+               "to_version": self.version, "phase": self.phase,
+               **{k: v for k, v in evidence.items() if v is not None}}
+        self.decisions.append(rec)
+        if self.r.tracer is not None:
+            self.r.tracer.emit(
+                None, "rollout", t=now,
+                **{k: v for k, v in rec.items()
+                   if k != "ts" and v is not None})
+        self._echo(f"[rollout] {action}"
+                   + (f" replica={replica}" if replica is not None else "")
+                   + (f" reason={reason}" if reason else "")
+                   + f" ({self.old_version} -> {self.version})")
+        return rec
+
+    def status(self):
+        n_target = sum(
+            1 for rep in self.r.replicas
+            if rep.state != DEAD
+            and getattr(rep, "weight_version", "0") == self.version)
+        return {"phase": self.phase, "active": self.active,
+                "from_version": self.old_version,
+                "to_version": self.version,
+                "rolled_back": self.rolled_back,
+                "rollback_reason": self.rollback_reason,
+                "canary_replica": self.canary_replica,
+                "on_target": n_target,
+                "replicas": len(self.r.replicas),
+                "mixing_s": self.mixing_s,
+                "decisions": len(self.decisions)}
+
+    # -- lifecycle --
+
+    def begin(self):
+        self._reg.counter("rollouts").add(1)
+        self._decide("begin", reason="requested",
+                     baseline_min=self.baseline_min_requests,
+                     canary_min=self.canary_min_requests,
+                     max_mixing_s=self.max_mixing_s)
+        return self
+
+    # -- feeding (Router.step, after harvest) --
+
+    def observe(self, finished, now=None):
+        """Feed this step's terminal records into the canary analysis
+        store. BASELINE feeds every replica (the oldest-half
+        reference); CANARY feeds only the canary; ROLLING feeds every
+        target-version replica (mid-rollout regressions must trip the
+        same detectors). Rollback feeds nothing — the verdict is in."""
+        if not self.active:
+            return
+        now = self._clock() if now is None else now
+        if self.phase == BASELINE:
+            recs = [f for f in finished
+                    if getattr(f, "replica", None) is not None]
+            self._baseline_seen += len(recs)
+            self._ae.observe_finished(recs, t=now)
+            return
+        if self.phase not in (CANARY, ROLLING):
+            # CANARY_SWAP drains old-version work (not the new
+            # weights' records); ROLLING_BACK's verdict is already in
+            return
+        if self._swapping is not None or (
+                self._t_settle is not None and now < self._t_settle):
+            # detector blackout (see the settle_s knob): a swap in
+            # flight — or its queue backlog still draining — is the
+            # campaign's own capacity transient, not evidence about
+            # the new weights
+            return
+        if self.phase == CANARY:
+            recs = [f for f in finished
+                    if getattr(f, "replica", None) == self.canary_replica]
+            self._canary_seen += len(recs)
+        else:  # ROLLING
+            target_ids = {
+                rep.replica_id for rep in self.r.replicas
+                if rep.state != DEAD
+                and getattr(rep, "weight_version", "0") == self.version}
+            recs = [f for f in finished
+                    if getattr(f, "replica", None) in target_ids]
+        self._ae.observe_finished(recs, t=now)
+        self._ae.check(now, context={"phase": self.phase,
+                                     "to_version": self.version})
+        fresh = self._ae.fired[self._fired_seen:]
+        self._fired_seen = len(self._ae.fired)
+        if fresh and self._tripped is None:
+            self._tripped = fresh[0]
+            if self.phase == CANARY:
+                self._reg.counter("canary_anomalies").add(1)
+
+    # -- the state machine (Router.step, before dispatch) --
+
+    def poll(self, now=None):
+        if not self.active:
+            return
+        now = self._clock() if now is None else now
+        if self._tripped is not None and self.phase in (CANARY, ROLLING):
+            self._start_rollback(now, "canary_anomaly"
+                                 if self.phase == CANARY
+                                 else "rollout_anomaly",
+                                 anomaly=self._tripped)
+        if (self.phase == ROLLING and self.t_mix_start is not None
+                and now - self.t_mix_start > self.max_mixing_s):
+            self._start_rollback(now, "mixing_window_exceeded",
+                                 mixing_s=round(now - self.t_mix_start,
+                                                3))
+        if self.phase == BASELINE:
+            self._poll_baseline(now)
+        elif self.phase == CANARY_SWAP:
+            self._poll_swap(now, self.version, on_done=self._canary_up)
+        elif self.phase == CANARY:
+            self._poll_canary(now)
+        elif self.phase == ROLLING:
+            self._poll_rolling(now, self.version, gated=True)
+        elif self.phase == ROLLING_BACK:
+            self._poll_rolling(now, self.old_version, gated=False)
+
+    # -- phase bodies --
+
+    def _poll_baseline(self, now):
+        if (now - self._t_phase < self.baseline_hold_s
+                and self.baseline_min_requests > 0):
+            return
+        if self._baseline_seen < self.baseline_min_requests:
+            return
+        canary = self._pick_canary()
+        if canary is None:
+            return  # no healthy replica right now — wait
+        self.canary_replica = canary.replica_id
+        # satellite: retarget the canary's respawn spec BEFORE its
+        # drain — a SIGKILL anywhere mid-swap now respawns on TARGET
+        self._retarget(canary, self.version)
+        canary.drain()
+        self._swapping = canary.replica_id
+        self.phase = CANARY_SWAP
+        self._t_phase = now
+        self._decide("canary_start", replica=canary.replica_id, now=now,
+                     baseline_requests=self._baseline_seen)
+
+    def _canary_up(self, now):
+        self.phase = CANARY
+        self._t_phase = now
+        if self.t_mix_start is None:
+            self.t_mix_start = now  # first target-serving moment
+
+    def _poll_canary(self, now):
+        if (now - self._t_phase < self.canary_hold_s
+                and self.canary_min_requests > 0):
+            return
+        if self._canary_seen < self.canary_min_requests:
+            return
+        self._decide("canary_pass", now=now, replica=self.canary_replica,
+                     canary_requests=self._canary_seen,
+                     held_s=round(now - self._t_phase, 3))
+        # fleet-wide retarget: from here ANY death respawns on target
+        # (and counts as that replica's swap) — a death mid-rollout can
+        # never resurrect old weights
+        self._retarget_fleet(self.version)
+        self.phase = ROLLING
+        self._t_phase = now
+
+    def _poll_rolling(self, now, target, *, gated):
+        if self._swapping is not None:
+            self._poll_swap(now, target, on_done=None)
+            if self._swapping is not None:
+                return
+        # converged? every non-dead replica on target and none draining
+        pending = [rep for rep in self.r.replicas
+                   if rep.state != DEAD
+                   and getattr(rep, "weight_version", "0") != target]
+        if not pending:
+            if any(rep.state == DEAD and self._respawn_pending(rep)
+                   for rep in self.r.replicas):
+                return  # a respawn is owed; it will land on target
+            self._finish(now)
+            return
+        nxt = self._next_victim(pending)
+        if nxt is None:
+            return
+        if gated and not self._gate_ok(nxt):
+            return
+        self._retarget(nxt, target)
+        nxt.drain()
+        self._swapping = nxt.replica_id
+        self._decide("swap_begin", replica=nxt.replica_id, now=now,
+                     target=target)
+
+    def _poll_swap(self, now, target, *, on_done):
+        """Progress the in-flight swap: wait out the drain, then swap
+        on the idle engine; a death mid-swap hands the replica to the
+        supervisor (its spec is already retargeted) and the swap
+        completes when the respawn reports the target version."""
+        if self._swapping is None:
+            return
+        rep = self.r._rep(self._swapping)
+        if rep.state == DEAD:
+            # failover already requeued its work; the respawn (which
+            # will hello with the retargeted spec) must land on target
+            # before we move on
+            if not self._respawn_pending(rep):
+                # nobody will bring it back (inproc, or the supervisor
+                # exhausted its budget): stop waiting on it — and if
+                # this was the canary swap, fall back to BASELINE so
+                # the next poll picks a fresh canary from the
+                # survivors instead of polling a corpse forever
+                self._decide("swap_dead", replica=rep.replica_id,
+                             now=now, reason="respawn_exhausted")
+                self._swapping = None
+                if self.phase == CANARY_SWAP:
+                    self.phase = BASELINE
+            return
+        if getattr(rep, "weight_version", "0") == target \
+                and rep.state == HEALTHY:
+            # respawned (or reloaded) onto target already
+            self._swap_done(rep, now, on_done)
+            return
+        if rep.state != DRAINING:
+            rep.drain()  # e.g. revived mid-swap: re-drain
+            return
+        if rep.busy:
+            return  # still draining — in-flight work finishes first
+        try:
+            if self.r.backend == "process":
+                rep.reload()
+            else:
+                rep.set_weights(
+                    self._target_state if target == self.version
+                    else self._old_state, target)
+                rep.revive()  # DRAINING -> HEALTHY, work map intact
+        except Exception as e:  # noqa: BLE001 — spawn/handshake refusal
+            # a failed swap is a death: the supervisor (aimed at the
+            # same retargeted spec) owns the retry with backoff
+            self._decide("swap_failed", replica=rep.replica_id, now=now,
+                         reason=repr(e))
+            rep.last_error = e
+            rep.mark_dead()
+            self.r._failover(rep)
+            return
+        self._swap_done(rep, now, on_done)
+
+    def _swap_done(self, rep, now, on_done):
+        if self.r._cache_map is not None:
+            # de-advertise NOW: the old version's chains are gone from
+            # the engine, and the map must not hold them even until the
+            # next refresh (which would re-key them anyway)
+            self.r._cache_map.drop(rep.replica_id)
+        self._swapping = None
+        self._t_settle = now + self.settle_s
+        self._decide("swap_done", replica=rep.replica_id, now=now,
+                     version=getattr(rep, "weight_version", "0"))
+        if self.t_mix_start is None:
+            self.t_mix_start = now
+        if on_done is not None:
+            on_done(now)
+
+    # -- rollback --
+
+    def _start_rollback(self, now, reason, **evidence):
+        if self.phase in (ROLLING_BACK, DONE):
+            return
+        self.rolled_back = True
+        self.rollback_reason = reason
+        self._reg.counter("rollbacks").add(1)
+        self._decide("rollback_begin", reason=reason, now=now,
+                     **{k: v for k, v in evidence.items()})
+        # retarget the whole fleet back FIRST: any death from here
+        # respawns on the old version. The inproc module is restored
+        # immediately too — swapped engines keep serving target via
+        # their own split snapshots until their rollback swap runs
+        self._retarget_fleet(self.old_version)
+        if self.r.backend != "process":
+            from flax import nnx
+
+            nnx.update(self.r._model, self._old_state)
+        self._swapping = None
+        self._tripped = None
+        self.phase = ROLLING_BACK
+        self._t_phase = now
+
+    def _finish(self, now):
+        if self.t_mix_start is not None:
+            self.mixing_s = round(now - self.t_mix_start, 4)
+        self.phase = DONE
+        self.active = False
+        if self.rolled_back:
+            self._decide("rollback_done", reason=self.rollback_reason,
+                         now=now, mixing_s=self.mixing_s)
+        else:
+            self._decide("done", now=now, mixing_s=self.mixing_s,
+                         swaps=sum(1 for d in self.decisions
+                                   if d["action"] == "swap_done"))
+
+    # -- helpers --
+
+    def _pick_canary(self):
+        if self._canary_pick is not None:
+            rep = self.r._rep(self._canary_pick)
+            return rep if rep.state == HEALTHY else None
+        cands = [rep for rep in self.r.replicas
+                 if rep.state == HEALTHY]
+        return min(cands, key=lambda rep: rep.replica_id) \
+            if cands else None
+
+    def _next_victim(self, pending):
+        cands = [rep for rep in pending if rep.state == HEALTHY]
+        return min(cands, key=lambda rep: rep.replica_id) \
+            if cands else None
+
+    def _gate_ok(self, victim):
+        """SLO-floor gate for a FORWARD swap: every other non-dead
+        replica healthy (taking one out must not stack on an existing
+        degradation) and — when an SLOEngine is attached — the burn
+        rate at or under `hold_burn`. Rollback never gates."""
+        for rep in self.r.replicas:
+            if rep is victim or rep.state == DEAD:
+                continue
+            if rep.state != HEALTHY:
+                return False
+        if self.slo is not None:
+            burn = self.slo.burn_rate()
+            if burn is not None and burn > self.hold_burn:
+                return False
+        return True
+
+    def _respawn_pending(self, rep):
+        sup = self.r._supervisor
+        return sup is not None and not sup.exhausted(rep)
+
+    def _retarget(self, rep, target):
+        """Aim ONE replica's future hellos at `target` (process
+        backend); the inproc swap needs no per-replica retarget — the
+        shared module plus set_weights is the whole story."""
+        if self.r.backend == "process":
+            rep.set_model_spec(
+                self._target_spec if target == self.version
+                else self._old_spec, version=target)
+
+    def _retarget_fleet(self, target):
+        """Aim the WHOLE fleet — every replica's respawn spec and the
+        router's replica-build recipe — at `target`, so deaths respawn
+        onto it and autoscaler growth spawns it."""
+        self._retargeted = target == self.version
+        if self.r.backend == "process":
+            self.r._spec = (self._target_spec
+                            if target == self.version else self._old_spec)
+            for rep in self.r.replicas:
+                self._retarget(rep, target)
+        self.r._engine_kwargs["weight_version"] = str(target)
+
+
+__all__ = ["RolloutManager", "version_number", "resolve_generation",
+           "canary_detectors", "BASELINE", "CANARY", "ROLLING",
+           "ROLLING_BACK", "DONE"]

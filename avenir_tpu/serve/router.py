@@ -245,6 +245,7 @@ class Router:
         self._anomaly = anomaly  # None = fleet health engine off
         self.backend = backend
         self._supervisor = None
+        self._rollout = None   # live weight lifecycle (ISSUE 20)
         # replica build recipe, retained so the autoscaler can grow the
         # fleet after construction (add_replica, ISSUE 12)
         self._model = model
@@ -647,6 +648,18 @@ class Router:
                 for rep in self.replicas:
                     if rep.state != DEAD:
                         rep.last_beat += dt_sup
+        if self._rollout is not None and self._rollout.active:
+            # drive the weight-lifecycle state machine (ISSUE 20). A
+            # swap's reload/handshake blocks the fleet loop exactly
+            # like a supervisor respawn — credit the blocking time to
+            # every live replica for the same reason as above
+            t_ro = self._clock()
+            self._rollout.poll(now)
+            dt_ro = self._clock() - t_ro
+            if dt_ro > 0:
+                for rep in self.replicas:
+                    if rep.state != DEAD:
+                        rep.last_beat += dt_ro
         self._expire_queued(now, finished)
         self._dispatch_all(now)
         ae = self._anomaly  # the single-branch disabled guard (ISSUE 14)
@@ -730,6 +743,16 @@ class Router:
             # a stall FORMING — visible before the threshold declares it
             self._reg.gauge("heartbeat_age_s").set(
                 max(self._clock() - r.last_beat for r in alive))
+            # the weight_version gauge only moves when the fleet has
+            # CONVERGED on one version (ISSUE 20) — mid-rollout it
+            # holds the previous converged value, so a plot of this
+            # gauge shows exactly when each campaign landed
+            vers = {getattr(r, "weight_version", "0") for r in alive}
+            if len(vers) == 1:
+                from avenir_tpu.serve.rollout import version_number
+
+                self._reg.gauge("weight_version").set(
+                    version_number(vers.pop()))
         # paged-KV gauges get the same fleet-aggregate treatment as
         # queue_depth above (N engines, one registry): pages_free sums,
         # util/prefix-hit average over the replicas reporting them.
@@ -776,12 +799,24 @@ class Router:
                 if r.state == DEAD:
                     continue
                 eng = r.engine
+                # version-keyed (ISSUE 20): the summary is stamped with
+                # the version the replica serves NOW, so a swap re-keys
+                # its advertisement the first post-swap refresh and the
+                # old version's entries can never match again
                 if getattr(eng, "_paged", None) is not None:
-                    cm.update(r.replica_id, eng.chain_summary(), now=t_cm)
+                    cm.update(r.replica_id, eng.chain_summary(), now=t_cm,
+                              version=r.weight_version)
                 elif getattr(eng, "chains", None) is not None:
-                    cm.update(r.replica_id, eng.chains, now=t_cm)
+                    cm.update(r.replica_id, eng.chains, now=t_cm,
+                              version=r.weight_version)
         if ae is not None:
             self._feed_anomaly(ae, finished)
+        if self._rollout is not None and self._rollout.active:
+            # canary analysis feed (ISSUE 20): phase-filtered terminal
+            # records into the campaign's private detector store — the
+            # fleet fed during BASELINE is the drift baseline the
+            # canary's own records are later compared against
+            self._rollout.observe(finished, now=self._clock())
         return finished
 
     def _feed_anomaly(self, ae, finished):
@@ -893,6 +928,38 @@ class Router:
         # _failover, so there is nothing to clear here; reviving a
         # draining replica must keep its live assignment map intact
         self._rep(i).revive()
+
+    # -- live weight lifecycle (serve/rollout.py, ISSUE 20) --
+
+    def rollout(self, version, *, state=None, out_dir=None, **kw):
+        """Start a rolling weight swap to `version` (canary first, then
+        replica by replica; anomaly-triggered auto-rollback). Returns
+        the armed RolloutManager — Router.step drives it; poll
+        `rollout_active` / the manager's `.status()` for progress.
+
+        `version` names a checkpoint generation when `out_dir` is given
+        (resolved via checkpoint/io.list_generations; 'latest' picks
+        the newest); for the in-process backend (or tests) pass `state`
+        — the target nnx parameter state — directly. Extra kwargs reach
+        RolloutConfig (canary_min_requests, max_mixing_s, ...)."""
+        from avenir_tpu.serve.rollout import RolloutManager
+
+        if self._rollout is not None and self._rollout.active:
+            raise RuntimeError(
+                "a rollout is already active — one campaign at a time "
+                "(roll it back or let it land first)")
+        self._rollout = RolloutManager(
+            self, version, state=state, out_dir=out_dir, **kw)
+        self._rollout.begin()
+        return self._rollout
+
+    @property
+    def rollout_active(self):
+        """True while a rollout (or its rollback) is converging the
+        fleet — the autoscaler suppresses scale-down/idle-to-zero for
+        the duration (a mid-campaign retire would thrash the version
+        accounting and the mixing-window bound)."""
+        return self._rollout is not None and self._rollout.active
 
     # -- observable surface --
 
@@ -1169,7 +1236,7 @@ class Router:
         to redo work it already has". The residual missed fraction is
         exactly what affinity routing could not reclaim."""
         cm = self._cache_map
-        m = cm.match(req.prompt)
+        m = cm.match(req.prompt, versions=self._fleet_versions())
         n = len(req.prompt)
         reused = min(max(m.get(rep.replica_id, 0), req.pulled_tokens), n)
         best_rid, best = rep.replica_id, reused
@@ -1197,6 +1264,15 @@ class Router:
 
     # ---- fleet KV CDN: affinity placement + peer pull (ISSUE 17) ----
 
+    def _fleet_versions(self):
+        """{replica_id: weight_version} across non-dead replicas — the
+        live view the cache map filters matches against (ISSUE 20): an
+        advertisement recorded under a version its replica no longer
+        serves scores zero, so a post-swap replica's old chains can
+        never win placement, source a pull, or count as fleet reuse."""
+        return {r.replica_id: getattr(r, "weight_version", "0")
+                for r in self.replicas if r.state != DEAD}
+
     def _affinity_match(self, req):
         """The staleness-filtered cache-map view for placement:
         {replica_id: deepest shared-chain tokens}, dropping zero
@@ -1207,7 +1283,8 @@ class Router:
         pol, cm = self._affinity, self._cache_map
         now = self._clock()
         out = {}
-        for rid, n in cm.match(req.prompt).items():
+        m = cm.match(req.prompt, versions=self._fleet_versions())
+        for rid, n in m.items():
             if n <= 0:
                 continue
             st = cm.staleness_s(rid, now=now)
@@ -1256,6 +1333,15 @@ class Router:
             fallbacks.add(1)
             trace("src_gone")
             return True
+        if (getattr(src, "weight_version", "0")
+                != getattr(rep, "weight_version", "0")):
+            # a weight swap landed between map refresh and this pull
+            # (ISSUE 20): KV produced under one version must never
+            # splice into an engine serving another — that is silent
+            # wrongness, not a perf loss. Local re-prefill instead
+            fallbacks.add(1)
+            trace("version_mismatch")
+            return True
         token_pages = [req.prompt[i * ps:(i + 1) * ps]
                        for i in range(best // ps)]
         try:
@@ -1287,11 +1373,18 @@ class Router:
 
     # ---- disaggregated page transfer + handoff (ISSUE 13) ----
 
-    def _pick_decode_target(self):
+    def _pick_decode_target(self, version=None):
         """Least-loaded healthy decode replica — the handoff target.
         Dispatchable fraction first (it nets out the engine backlog),
-        then live count, then id (deterministic)."""
+        then live count, then id (deterministic). `version` (ISSUE 20)
+        restricts candidates to replicas serving that weight version:
+        prefilled pages splice only into the weights that made them,
+        so mid-rollout a cross-version handoff waits (bounded by the
+        mixing window) instead of decoding wrong."""
         cands = self._healthy_class(False)
+        if version is not None:
+            cands = [r for r in cands
+                     if getattr(r, "weight_version", "0") == version]
         if not cands:
             return None
         return max(cands, key=lambda r: (
@@ -1312,8 +1405,10 @@ class Router:
                 continue  # already failed over/expired: transfer moot
             tr = self._transfer.setdefault(
                 rid, {"recs": [], "target": None, "shipped": 0,
-                      "bytes": 0, "src": rep.replica_id})
+                      "bytes": 0, "src": rep.replica_id,
+                      "ver": rep.weight_version})
             tr["src"] = rep.replica_id
+            tr["ver"] = rep.weight_version
             tr["recs"].append(rec)
             self._ship(rid, tr)
 
@@ -1322,13 +1417,19 @@ class Router:
         Returns the target replica, or None when no healthy decode
         replica exists right now (the handoff will retry)."""
         tgt = None
+        ver = tr.get("ver")
         if tr["target"] is not None:
             for r in self._healthy_class(False):
-                if r.replica_id == tr["target"]:
+                # a pinned target that swapped versions under the
+                # transfer is no longer importable (ISSUE 20) — fall
+                # through to a same-version re-pick + full re-ship
+                if r.replica_id == tr["target"] and (
+                        ver is None
+                        or getattr(r, "weight_version", "0") == ver):
                     tgt = r
                     break
         if tgt is None:
-            tgt = self._pick_decode_target()
+            tgt = self._pick_decode_target(version=ver)
             if tgt is None:
                 tr["target"] = None
                 tr["shipped"] = 0
@@ -1381,7 +1482,8 @@ class Router:
         now = self._clock()
         tr = self._transfer.pop(rid, {"recs": [], "target": None,
                                       "shipped": 0, "bytes": 0,
-                                      "src": rep.replica_id})
+                                      "src": rep.replica_id,
+                                      "ver": rep.weight_version})
         if req.expired(now):
             # the deadline died during prefill+transfer: account it,
             # free the accumulated pages, never burn a decode slot

@@ -126,7 +126,7 @@ def _serve(stream):
              ("kv_impl", "page_size", "n_pages", "max_pages_per_seq",
               "prefill_chunk", "prefix_sharing", "paged_attn_impl",
               "kv_dtype", "spec_decode", "spec_k", "role",
-              "health_series", "chain_topk")
+              "health_series", "chain_topk", "weight_version")
              if ekw.get(k) is not None}
     # request tracing (ISSUE 10): the parent's hello flips this flag;
     # the engine collects lifecycle events in a bounded buffer and every
@@ -196,6 +196,7 @@ def _serve(stream):
                   "kv_dtype": engine.kv_dtype,
                   "spec_decode": engine.spec_decode,
                   "role": engine.role,
+                  "weight_version": engine.weight_version,
                   "prewarm_ticks": prewarm_ticks,
                   "pid": os.getpid()})
 

@@ -49,6 +49,14 @@ Sites consulted by the production IO paths:
                          is exactly the gap the anomaly engine's
                          step-time drift detector closes
                          (obs/anomaly.py, tools/anomaly_bench.py)
+    serve_step_degrade   each fire adds a PERMANENT +2 ms of host
+                         latency to every busy step of ONE serve
+                         replica (serve/replica.py / serve/proc.py,
+                         parent-side) — the poisoned-canary pattern:
+                         the replica keeps serving, only slower, so
+                         nothing but the rollout canary's TTFT/TPOT
+                         drift detectors can tell (serve/rollout.py,
+                         ISSUE 20)
 
 The default injector (no env var) is inert: `enabled()` is a dict
 lookup returning False, so the hot paths pay nothing. Inject faults in

@@ -75,7 +75,8 @@ def test_gate_fails_on_synthetic_20pct_regression(ledger, tmp_path,
     assert wide <= {"anomaly_wedge_lead_frac",
                     "missed_reuse_frac_affinity",
                     "spec_compose_decode_speedup",
-                    "spec_ngram_decode_speedup"}, (
+                    "spec_ngram_decode_speedup",
+                    "rollout_rollback_latency_s"}, (
         "a perf-trajectory band grew past 20% — a silent 20% "
         "regression would ship clean again")
     # The spec rows' wide bands (shared-host scheduling noise on the
@@ -100,6 +101,16 @@ def test_gate_fails_on_synthetic_20pct_regression(ledger, tmp_path,
         assert ceiling < 0.6 * blind, (
             "missed_reuse_frac_affinity band ceiling crept toward the "
             "affinity-blind baseline — the CDN win is no longer gated")
+    # The rollback-latency row's wide band (wall-paced drill on a
+    # shared host) must never let the gate CEILING creep toward the
+    # bench's own 20s rollback_bound_s: a rollback that stops arriving
+    # in seconds has to fail regardless of host weather.
+    rb = ledger["benches"].get("rollout_rollback_latency_s")
+    if rb is not None:
+        ceiling = rb["value"] * (1.0 + rb["noise_frac"])
+        assert ceiling < 8.0, (
+            "rollout_rollback_latency_s band ceiling crept toward the "
+            "bench's 20s bound — slow rollbacks would ship clean")
     for name, e in ledger["benches"].items():
         art = copy.deepcopy(load_json(os.path.join(REPO,
                                                    e["artifact"])))

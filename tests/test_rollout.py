@@ -1,0 +1,523 @@
+"""Live weight lifecycle tests (serve/rollout.py + the router /
+cache-map / supervisor wiring, ISSUE 20): a rolling rollout converges
+with zero lost requests and actually serves the NEW weights, the
+canary analysis auto-rolls-back a poisoned version before it reaches
+the fleet, a SIGKILL'd canary mid-swap cannot stall (or version-split)
+the campaign, the mixing-window bound is a real backstop, and — the
+KV-safety pin — a weight swap fences the replica's old cache-map
+advertisement so no chain is ever reused across a version boundary.
+
+Budget notes: driven clocks everywhere tier-1 (deterministic, no
+sleeps); one module-scoped tiny GPT pair (v1/v2 = different init
+seeds). The process-backend SIGKILL-mid-swap drill and the wall-clock
+bench ride the slow lane; the tier-1 bench smoke is the same two
+campaigns at reduced load.
+"""
+
+import os
+import signal
+
+import jax
+import numpy as np
+import pytest
+from flax import nnx
+
+from avenir_tpu.infer.decode import generate_cached
+from avenir_tpu.models.gpt import GPT, GPTConfig
+from avenir_tpu.obs import MetricsRegistry
+from avenir_tpu.obs.trace import Tracer
+from avenir_tpu.serve import Router
+from avenir_tpu.serve.cache_map import FleetCacheMap
+from avenir_tpu.serve.pages import chain_digest
+from avenir_tpu.serve.rollout import canary_detectors, version_number
+
+GPT_TINY = GPTConfig(block_size=64, vocab_size=128, n_layer=1, n_head=2,
+                     n_embd=32, dropout=0.0, bias=True, attn_impl="xla")
+_SILENT = lambda _s: None  # noqa: E731 — decisions stay in ro.decisions
+
+
+@pytest.fixture(scope="module")
+def model():
+    return GPT(GPT_TINY, rngs=nnx.Rngs(0))
+
+
+@pytest.fixture(scope="module")
+def model2():
+    """The target generation: same config, different weights — a swap
+    that actually landed is observable in the served tokens."""
+    return GPT(GPT_TINY, rngs=nnx.Rngs(1))
+
+
+@pytest.fixture(scope="module")
+def state2(model2):
+    return nnx.split(model2)[1]
+
+
+class FakeFin:
+    """Synthetic terminal record for the canary analysis feed — the
+    public `observe()` contract reads exactly these attrs."""
+
+    def __init__(self, replica, ttft_ms, tpot_ms=10.0, n_out=4):
+        self.replica = replica
+        self.ttft_ms = ttft_ms
+        self.tpot_ms = tpot_ms
+        self.n_out = n_out
+        self.finish_reason = "length"
+
+
+# ---------------------------------------------------------------------
+# 1. pure pieces
+# ---------------------------------------------------------------------
+
+
+def test_version_number_parses_and_ordinals():
+    assert version_number("iter-00000120") == 120
+    assert version_number("v2") == 2
+    assert version_number(7) == 7
+    a, b = version_number("alpha"), version_number("bravo")
+    assert a != b and version_number("alpha") == a  # stable ordinals
+
+
+def test_canary_detector_panel():
+    dets = {d.name: d for d in canary_detectors()}
+    assert set(dets) == {"ttft_drift", "tpot_drift",
+                        "accept_rate_collapse"}
+    # rebalancing bias: a just-swapped canary rejoins empty and takes a
+    # fair-share burst — tenths of relative rise are mechanics, not
+    # weights — and nothing re-fires after the verdict, so no cooldown
+    assert dets["ttft_drift"].min_rel == 0.5
+    assert dets["tpot_drift"].min_rel == 0.5
+    assert all(d.cooldown_s == 0.0 for d in dets.values())
+    tuned = {d.name: d for d in canary_detectors(
+        {"ttft_drift": {"sustain": 3, "min_windows": 5}})}
+    assert tuned["ttft_drift"].sustain == 3
+    assert tuned["ttft_drift"].min_windows == 5
+    assert tuned["tpot_drift"].sustain == 2  # others untouched
+
+
+def test_rollout_guards(model, state2):
+    t = [0.0]
+    router = Router(model, n_replicas=2, n_slots=2,
+                    registry=MetricsRegistry(), seed=0,
+                    clock=lambda: t[0])
+    with pytest.raises(ValueError):  # inproc needs the target state
+        router.rollout("v2", echo=_SILENT)
+    with pytest.raises(AssertionError):  # fleet already serves "0"
+        router.rollout("0", state=state2, echo=_SILENT)
+    ro = router.rollout("v2", state=state2, echo=_SILENT,
+                        baseline_min_requests=0, canary_min_requests=0)
+    assert router.rollout_active
+    with pytest.raises(RuntimeError):  # one campaign at a time
+        router.rollout("v3", state=state2, echo=_SILENT)
+    while ro.active:
+        t[0] += 0.1
+        router.step()
+    assert not router.rollout_active
+
+
+# ---------------------------------------------------------------------
+# 2. cross-version KV safety (the satellite pin: these FAIL on a
+#    version-blind map)
+# ---------------------------------------------------------------------
+
+
+def test_cache_map_version_fencing():
+    """An advertisement recorded under one weight version must score 0
+    against a fleet view where that replica now serves another — KV
+    only attaches under the exact weights that produced it."""
+    cm = FleetCacheMap(clock=lambda: 0.0)
+    prompt = list(range(16))
+    nodes = {chain_digest(prompt[:8]): [8, 1, 0, 0, 0.0]}
+    cm.update(0, nodes, version="v1")
+    assert cm.version(0) == "v1"
+    # version-blind callers (telemetry) keep the old behavior
+    assert cm.match(prompt) == {0: 8}
+    # same version: matches
+    assert cm.match(prompt, versions={0: "v1"}) == {0: 8}
+    # the replica swapped since advertising: fenced to zero
+    assert cm.match(prompt, versions={0: "v2"}) == {0: 0}
+    assert cm.best_match(prompt, versions={0: "v2"}) == (None, 0)
+    # unknown current version (not in the live view): fenced too
+    assert cm.match(prompt, versions={}) == {0: 0}
+    # a swap's drop() forgets the advertisement outright
+    cm.drop(0)
+    assert cm.match(prompt) == {} and cm.version(0) is None
+
+
+def test_router_fleet_version_view_fences_stale_advertisement(model):
+    """Router-level: prime a replica's chain advertisement, then flip
+    the weights under it (the swap race: map updated before the swap,
+    match after) — the router's live version view must zero it so
+    affinity placement / peer pulls can never cross the boundary."""
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=2, n_slots=2, registry=reg,
+                    seed=0, cache_telescope=True, affinity=True,
+                    engine_kwargs=dict(kv_impl="paged", page_size=8,
+                                       n_pages=48, prefill_chunk=16))
+    prefix = [int(x) for x in
+              np.random.default_rng(7).integers(0, 128, 24)]
+    router.submit(prefix + [1, 2], max_new_tokens=4, temperature=1.0,
+                  top_k=8)
+    done = router.drain()
+    assert len(done) == 1
+    cm = router._cache_map
+    warm = cm.match(prefix, versions=router._fleet_versions())
+    warm_rid, depth = max(warm.items(), key=lambda kv: kv[1])
+    assert depth >= 16, warm  # the chain is advertised and matchable
+    # the swap lands; the map has not refreshed yet
+    rep = router._rep(warm_rid)
+    rep.engine.weight_version = "v2"
+    fenced = cm.match(prefix, versions=router._fleet_versions())
+    assert fenced[warm_rid] == 0, (
+        "a post-swap replica's old advertisement won a match across "
+        "the weight-version boundary")
+    router.close()
+
+
+# ---------------------------------------------------------------------
+# 3. the campaigns (driven clock, deterministic)
+# ---------------------------------------------------------------------
+
+
+def _pump(router, t, n=1, dt=0.05):
+    out = []
+    for _ in range(n):
+        t[0] += dt
+        out.extend(router.step())
+    return out
+
+
+def test_forward_rollout_converges_zero_lost(model, model2, state2,
+                                             tmp_path):
+    """The tentpole forward path under live load: baseline -> canary ->
+    rolling, zero requests lost, bounded mixing window, every replica
+    converged on the target — and the fleet then actually SERVES the
+    new weights (parity vs one-shot generation on the v2 module)."""
+    t = [0.0]
+    reg = MetricsRegistry()
+    tracer = Tracer(registry=reg, clock=lambda: t[0],
+                    out_dir=str(tmp_path))
+    router = Router(model, n_replicas=3, n_slots=2, registry=reg,
+                    seed=0, clock=lambda: t[0], tracer=tracer)
+    rng = np.random.default_rng(0)
+    done, submitted = [], 0
+
+    def load(n=1):
+        nonlocal submitted
+        for _ in range(n):
+            router.submit([int(x) for x in rng.integers(0, 128, 6)],
+                          max_new_tokens=6, temperature=1.0, top_k=None)
+            submitted += 1
+
+    load(6)
+    done.extend(_pump(router, t, 10))
+    ro = router.rollout("v2", state=state2, window_s=0.25,
+                        baseline_min_requests=6, canary_min_requests=4,
+                        max_mixing_s=60.0, echo=_SILENT)
+    for i in range(2000):
+        if not ro.active:
+            break
+        if i % 2 == 0:
+            load(1)
+        done.extend(_pump(router, t, 1))
+    assert not ro.active, f"campaign never converged: {ro.status()}"
+    done.extend(router.drain())
+
+    st = ro.status()
+    assert st["phase"] == "done" and not st["rolled_back"], st
+    assert all(r.weight_version == "v2" for r in router.replicas)
+    assert ro.mixing_s is not None and 0 < ro.mixing_s <= 60.0
+    # zero lost: every submit reached exactly one terminal record
+    assert len(done) == submitted
+    assert {f.finish_reason for f in done} <= {"length", "stop"}
+    snap = reg.snapshot()
+    assert snap["counters"]["rollouts"] == 1
+    assert snap["counters"].get("rollbacks", 0) == 0
+    assert snap["gauges"]["weight_version"] == version_number("v2")
+    # the auditable decision trail, trace-event side (flat attrs)
+    evs = [e for e in tracer.events() if e.get("ev") == "rollout"]
+    actions = [e["action"] for e in evs]
+    assert actions[0] == "begin" and actions[-1] == "done"
+    assert "canary_start" in actions and "canary_pass" in actions
+    assert actions.count("swap_done") == 3  # canary + two rolling
+    d0 = next(e for e in evs if e["action"] == "done")
+    assert d0["from_version"] == "0" and d0["to_version"] == "v2"
+    assert d0["swaps"] == 3 and d0["mixing_s"] == ro.mixing_s
+    # the swap landed for real: served tokens match the v2 module
+    key = jax.random.key(1234)
+    prompt = [int(x) for x in rng.integers(0, 128, 6)]
+    router.submit(prompt, max_new_tokens=6, temperature=1.0, top_k=8,
+                  rng=key)
+    (f,) = router.drain()
+    import jax.numpy as jnp
+
+    ref = [int(x) for x in np.asarray(generate_cached(
+        model2, key, jnp.asarray(prompt, jnp.int32)[None], 6,
+        temperature=1.0, top_k=8))[0]]
+    assert f.tokens == ref, "fleet is not serving the target weights"
+
+
+def test_poisoned_canary_auto_rollback(model, state2, tmp_path):
+    """The canary verdict: feed the campaign a fleet baseline, let the
+    canary swap land, then stream 10x-TTFT canary records through the
+    public observe() — the drift detector fires, the campaign
+    rolls back before the version ever reaches a second replica, and
+    the fleet converges back on the old generation."""
+    t = [0.0]
+    reg = MetricsRegistry()
+    tracer = Tracer(registry=reg, clock=lambda: t[0],
+                    out_dir=str(tmp_path))
+    router = Router(model, n_replicas=3, n_slots=2, registry=reg,
+                    seed=0, clock=lambda: t[0], tracer=tracer)
+    ro = router.rollout("v2", state=state2, window_s=0.25,
+                        baseline_min_requests=8, canary_min_requests=4,
+                        canary_hold_s=30.0, echo=_SILENT)
+    # fleet baseline under the old weights: ~90 ms TTFT, all replicas
+    for _ in range(40):
+        t[0] += 0.1
+        ro.observe([FakeFin(r, 90.0 + (r - 1)) for r in range(3)],
+                   now=t[0])
+        ro.poll(t[0])
+    assert ro.phase == "canary", ro.status()
+    canary = ro.canary_replica
+    assert router._rep(canary).weight_version == "v2"
+    # only ONE replica ever saw the target version
+    on_target = [r.replica_id for r in router.replicas
+                 if r.weight_version == "v2"]
+    assert on_target == [canary]
+    t[0] += ro.settle_s + 0.1  # past the post-swap blackout
+    for _ in range(60):
+        if ro.rolled_back:
+            break
+        t[0] += 0.1
+        ro.observe([FakeFin(canary, 900.0)], now=t[0])
+        ro.poll(t[0])
+    assert ro.rolled_back and ro.rollback_reason == "canary_anomaly", \
+        ro.status()
+    for _ in range(50):
+        if not ro.active:
+            break
+        t[0] += 0.1
+        ro.poll(t[0])
+    st = ro.status()
+    assert st["phase"] == "done" and not ro.active
+    assert all(r.weight_version == "0" for r in router.replicas), (
+        "rollback did not converge the fleet back to the old version")
+    snap = reg.snapshot()["counters"]
+    assert snap["rollbacks"] == 1
+    assert snap["canary_anomalies"] >= 1
+    # the rollback decision carries the detector evidence, flat attrs
+    rb = next(e for e in tracer.events()
+              if e.get("ev") == "rollout"
+              and e["action"] == "rollback_begin")
+    assert rb["reason"] == "canary_anomaly"
+    assert rb["anomaly"]["detector"] == "ttft_drift"
+    assert rb["anomaly"]["value"] > rb["anomaly"]["baseline"]
+
+
+def test_swap_transient_records_are_blacked_out(model, state2):
+    """The settle blackout: records produced while a swap is in flight
+    — or within settle_s after it lands — never reach the detectors
+    (the campaign's own capacity transient must not read as a weight
+    regression; observed live as a z 8.6 self-rollback)."""
+    t = [0.0]
+    router = Router(model, n_replicas=3, n_slots=2,
+                    registry=MetricsRegistry(), seed=0,
+                    clock=lambda: t[0])
+    ro = router.rollout("v2", state=state2, window_s=0.25,
+                        baseline_min_requests=4, canary_min_requests=4,
+                        canary_hold_s=30.0, echo=_SILENT)
+    for _ in range(30):
+        t[0] += 0.1
+        ro.observe([FakeFin(r, 90.0) for r in range(3)], now=t[0])
+        ro.poll(t[0])
+    assert ro.phase == "canary"
+    canary = ro.canary_replica
+    # inside the blackout: even grotesque records are ignored
+    for _ in range(8):
+        t[0] += 0.05
+        assert t[0] < ro._t_settle
+        ro.observe([FakeFin(canary, 5000.0)], now=t[0])
+        ro.poll(t[0])
+    assert not ro.rolled_back and ro._canary_seen == 0
+    # past it: clean canary records accumulate, no false fire
+    t[0] = ro._t_settle + 0.01
+    for _ in range(20):
+        t[0] += 0.1
+        ro.observe([FakeFin(canary, 95.0)], now=t[0])
+        ro.poll(t[0])
+    assert not ro.rolled_back and ro._canary_seen == 20
+
+
+def test_kill_canary_mid_swap_rollout_resumes(model, state2):
+    """Chaos twin (tier-1, driven clock): the canary dies mid-drain.
+    Inproc nobody respawns it — the campaign must log swap_dead,
+    re-pick a canary from the survivors, and still converge with zero
+    accepted requests lost (the corpse's work fails over normally)."""
+    t = [0.0]
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=3, n_slots=2, registry=reg,
+                    seed=0, clock=lambda: t[0])
+    rng = np.random.default_rng(3)
+    done, submitted = [], 0
+
+    def load(n=1, long=False):
+        nonlocal submitted
+        for _ in range(n):
+            router.submit([int(x) for x in rng.integers(0, 128, 6)],
+                          max_new_tokens=24 if long else 6,
+                          temperature=1.0, top_k=None)
+            submitted += 1
+
+    load(6, long=True)  # long streams keep every replica busy
+    done.extend(_pump(router, t, 3))
+    assert all(r.busy for r in router.replicas)
+    # mins=0: the canary drain starts on the first poll, while the
+    # canary is still mid-stream on its long requests — the kill below
+    # lands genuinely mid-swap, deterministically
+    ro = router.rollout("v2", state=state2, window_s=0.25,
+                        baseline_min_requests=0, canary_min_requests=0,
+                        detectors=[], max_mixing_s=120.0, echo=_SILENT)
+    for _ in range(200):
+        if ro.phase == "canary_swap":
+            break
+        done.extend(_pump(router, t, 1))
+    assert ro.phase == "canary_swap"
+    victim = ro.canary_replica
+    assert router._rep(victim).state == "draining"
+    assert router._rep(victim).busy  # genuinely mid-swap
+    router.kill_replica(victim)  # SIGKILL's inproc twin
+    for i in range(3000):
+        if not ro.active:
+            break
+        if i % 3 == 0 and submitted < 40:
+            load(1)
+        done.extend(_pump(router, t, 1))
+    assert not ro.active and not ro.rolled_back, ro.status()
+    done.extend(router.drain())
+    assert len(done) == submitted  # zero lost, failover included
+    assert {f.finish_reason for f in done} <= {"length", "stop"}
+    assert any(f.failovers > 0 for f in done)
+    by_action = [d["action"] for d in ro.decisions]
+    assert "swap_dead" in by_action  # the death was adjudicated
+    assert by_action.count("canary_start") == 2  # re-picked
+    survivors = [r for r in router.replicas
+                 if r.replica_id != victim]
+    assert router._rep(victim).state == "dead"
+    assert all(r.weight_version == "v2" for r in survivors), (
+        "a survivor was left behind on the old version")
+
+
+def test_mixing_window_exceeded_rolls_back(model, state2):
+    """The version-mixing bound is a backstop, not telemetry: a fleet
+    that cannot finish rolling (here: the SLO gate never opens) rolls
+    BACK at max_mixing_s rather than serving two versions forever."""
+
+    class Burn:
+        def burn_rate(self):
+            return 9.9  # forward swaps gated shut forever
+
+    t = [0.0]
+    reg = MetricsRegistry()
+    router = Router(model, n_replicas=3, n_slots=2, registry=reg,
+                    seed=0, clock=lambda: t[0])
+    ro = router.rollout("v2", state=state2, window_s=0.25,
+                        baseline_min_requests=0, canary_min_requests=0,
+                        detectors=[], slo=Burn(), hold_burn=1.0,
+                        max_mixing_s=1.0, echo=_SILENT)
+    for _ in range(100):
+        if not ro.active:
+            break
+        t[0] += 0.1
+        router.step()
+    assert not ro.active
+    assert ro.rolled_back
+    assert ro.rollback_reason == "mixing_window_exceeded"
+    assert all(r.weight_version == "0" for r in router.replicas)
+    assert reg.snapshot()["counters"]["rollbacks"] == 1
+    # only the canary ever carried the target — the gate held
+    swaps = [d for d in ro.decisions if d["action"] == "swap_done"]
+    fwd = [d for d in swaps if d.get("version") == "v2"]
+    assert len(fwd) == 1
+
+
+# ---------------------------------------------------------------------
+# 4. benches
+# ---------------------------------------------------------------------
+
+
+def test_rollout_bench_smoke(tmp_path):
+    """serve_bench --rollout --smoke, the tier-1 acceptance twin: a
+    clean campaign and a poisoned one under real paced load — zero
+    lost, rollback on the poison, artifact ok=true."""
+    import json
+
+    from tools.serve_bench import rollout_bench
+
+    out = tmp_path / "BENCH_rollout_smoke.json"
+    rc = rollout_bench({"rollout": "1", "smoke": "1",
+                        "out": str(out)})
+    assert rc == 0
+    art = json.loads(out.read_text())
+    assert art["ok"] is True
+    assert art["requests"]["lost"] == 0
+    assert art["campaigns"]["clean"]["rolled_back"] is False
+    assert art["campaigns"]["poisoned"]["rolled_back"] is True
+    assert art["campaigns"]["poisoned"]["rollback_reason"] \
+        == "canary_anomaly"
+    # the decision log renders through fleet_report
+    assert art["fleet_report"]["rollout_decisions"]
+
+
+@pytest.mark.slow
+def test_process_sigkill_mid_swap_respawns_on_target(model, model2):
+    """THE chaos drill, process backend: a REAL SIGKILL to the canary
+    worker mid-swap. Its respawn spec was retargeted before the drain
+    began, so the supervisor brings it back ON THE TARGET VERSION and
+    the rollout resumes — old weights cannot be resurrected
+    mid-campaign, and nothing is lost."""
+    from avenir_tpu.serve.proc import model_spec_from_model
+
+    reg = MetricsRegistry()
+    router = Router(model, backend="process", supervise=True,
+                    n_replicas=2, n_slots=2, max_seq_len=32,
+                    registry=reg, seed=0)
+    try:
+        rng = np.random.default_rng(5)
+        submitted = 0
+        done = []
+        for _ in range(4):
+            router.submit([int(x) for x in rng.integers(0, 128, 6)],
+                          max_new_tokens=20, temperature=1.0,
+                          top_k=None)
+            submitted += 1
+        for _ in range(3):
+            done.extend(router.step())
+        assert all(r.busy for r in router.replicas)
+        ro = router.rollout("v2", spec=model_spec_from_model(model2),
+                            baseline_min_requests=0,
+                            canary_min_requests=0, detectors=[],
+                            max_mixing_s=600.0, echo=_SILENT)
+        for _ in range(50):
+            if ro.phase == "canary_swap":
+                break
+            done.extend(router.step())
+        assert ro.phase == "canary_swap"
+        victim = router._rep(ro.canary_replica)
+        assert victim.state == "draining" and victim.busy
+        os.kill(victim.pid, signal.SIGKILL)
+        import time as _time
+
+        deadline = _time.monotonic() + 240.0
+        while ro.active and _time.monotonic() < deadline:
+            done.extend(router.step())
+        assert not ro.active and not ro.rolled_back, ro.status()
+        done.extend(router.drain())
+        assert len(done) == submitted  # zero lost through the kill
+        assert victim.deaths == 1  # it really died and came back
+        assert all(r.weight_version == "v2" for r in router.replicas), (
+            "the respawn resurrected the old weights")
+        assert reg.snapshot()["counters"]["replica_respawns"] >= 1
+        assert not any(d["action"] == "swap_dead" for d in ro.decisions)
+    finally:
+        router.close()

@@ -83,6 +83,25 @@ def summarize_fleet(events, run_end=None):
     by_action = {}
     for d in decisions:
         by_action[d["action"]] = by_action.get(d["action"], 0) + 1
+    # weight lifecycle (ISSUE 20): the rollout campaign's decision
+    # trail rides the same trace stream as `scale`, and renders the
+    # same way — action, versions, replica, and the evidence attrs
+    rollouts = []
+    for e in sorted((e for e in events if e.get("ev") == "rollout"),
+                    key=lambda e: e["t"]):
+        rollouts.append({
+            "t": e["t"],
+            "t_rel_s": e["t"] - t0,
+            "action": e.get("action"),
+            "reason": e.get("reason"),
+            "replica": e.get("replica"),
+            "from_version": e.get("from_version"),
+            "to_version": e.get("to_version"),
+            "evidence": {k: e[k] for k in
+                         ("mixing_s", "anomaly", "baseline_requests",
+                          "canary_requests", "held_s", "swaps")
+                         if k in e},
+        })
     counters = (run_end or {}).get("counters") or {}
     initial = (decisions[0]["from_size"] if decisions else None)
     mean_size = None
@@ -94,6 +113,9 @@ def summarize_fleet(events, run_end=None):
         "n_anomalies": len(anomalies),
         "by_action": by_action,
         "decisions": decisions,
+        "rollouts": rollouts,
+        "rollouts_started": counters.get("rollouts"),
+        "rollbacks": counters.get("rollbacks"),
         "window_s": t1 - t0,
         "mean_fleet_size": mean_size,
         "steady_stretch_s": (steady_window_s(decisions, t0=t0, t1=t1)
@@ -167,6 +189,41 @@ def format_fleet_report(s):
     else:
         lines.append("no scale decisions in this log — a steady fleet "
                      "(or the autoscaler was not armed)")
+    if s.get("rollouts"):
+        lines.append("")
+        lines.append("-- weight lifecycle (rollout decision log) --")
+        for d in s["rollouts"]:
+            who = (f" replica {d['replica']}"
+                   if d.get("replica") is not None else "")
+            lines.append(
+                f"  t=+{d['t_rel_s']:8.2f}s  {d['action']:<14}"
+                f"{who}  {d['from_version']} -> {d['to_version']}"
+                + (f"  reason={d['reason']}" if d.get("reason") else ""))
+            ev = d.get("evidence") or {}
+            bits = []
+            if ev.get("mixing_s") is not None:
+                bits.append(f"mixing window {ev['mixing_s']:.2f}s")
+            if ev.get("canary_requests"):
+                bits.append(f"canary saw {ev['canary_requests']:.0f} "
+                            "requests")
+            if ev.get("baseline_requests"):
+                bits.append(f"baseline {ev['baseline_requests']:.0f} "
+                            "requests")
+            if ev.get("swaps"):
+                bits.append(f"{ev['swaps']:.0f} swaps")
+            a = ev.get("anomaly")
+            if isinstance(a, dict):
+                bits.append(f"anomaly: {a.get('detector')} "
+                            f"({a.get('key')}) value "
+                            f"{a.get('value', float('nan')):.2f} vs "
+                            f"threshold "
+                            f"{a.get('threshold', float('nan')):.2f}")
+            if bits:
+                lines.append(f"      {'  '.join(bits)}")
+        if s.get("rollbacks"):
+            lines.append(f"  rollbacks this run: {s['rollbacks']:.0f} "
+                         "(see rollback_begin rows above for the "
+                         "trigger evidence)")
     return "\n".join(lines)
 
 

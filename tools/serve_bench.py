@@ -983,6 +983,212 @@ def autoscale_bench(args):
     return 0 if ok else 1
 
 
+def rollout_bench(args):
+    """BENCH_rollout.json (ISSUE 20 acceptance): under seeded Poisson
+    load on a 3-replica fleet, (A) a CLEAN rolling weight rollout
+    (canary -> rolling swap) converges with ZERO lost requests inside a
+    bounded version-mixing window, then (B) a POISONED canary
+    (serve_step_degrade: each fire adds a permanent +2 ms to one
+    replica's busy steps — armed the moment the canary starts serving)
+    trips the drift detectors and AUTO-ROLLS-BACK, also zero-lost, with
+    the whole fleet converged back on the pre-campaign version.
+    Headline (PERF ledger): rollback latency, poison armed ->
+    rollback_begin decision. `--smoke` is the tier-1 twin: same two
+    campaigns, smaller load, tighter detector windows."""
+    import json as _json
+
+    from flax import nnx
+
+    from avenir_tpu.models.gpt import GPT, GPTConfig
+    from avenir_tpu.obs import MetricsRegistry
+    from avenir_tpu.obs.trace import Tracer
+    from avenir_tpu.serve import Router
+    from avenir_tpu.utils.faults import FaultInjector, set_injector
+
+    smoke = "smoke" in args
+    seed = int(args.get("seed", 0))
+    rate = float(args.get("rate", 18.0 if smoke else 24.0))
+    n_slots = int(args.get("n_slots", 2))
+    n_replicas = int(args.get("n_replicas", 3))
+    max_new = int(args.get("max_new_tokens", 6))
+    max_prompt = int(args.get("max_prompt", 8))
+    max_seq_len = int(args.get("max_seq_len", 16))
+    tick_s = float(args.get("tick_ms", 20.0)) / 1e3
+    window_s = float(args.get("window_s", 0.3 if smoke else 0.5))
+    max_mixing_s = float(args.get("max_mixing_s", 45.0))
+    # poison budget: n fires split across every stepping replica's
+    # consults — bounded so the post-rollback fleet stays serviceable
+    poison_n = int(args.get("poison_n", 45 if smoke else 75))
+    rollback_bound_s = float(args.get("rollback_bound_s", 20.0))
+    timeout_s = float(args.get("timeout_s", 90.0 if smoke else 180.0))
+    warm_n = int(args.get("warm_n", 12 if smoke else 32))
+    cap = int(args.get("max_requests", 1200 if smoke else 4000))
+    out_path = args.get("out", "BENCH_rollout.json")
+
+    model = GPT(GPTConfig(
+        block_size=int(args.get("block_size", 64)), vocab_size=256,
+        n_layer=1, n_head=2, n_embd=int(args.get("n_embd", 32)),
+        dropout=0.0, bias=True, attn_impl="xla"), rngs=nnx.Rngs(seed))
+    state_v2 = nnx.split(GPT(model.config, rngs=nnx.Rngs(seed + 1)))[1]
+    state_v3 = nnx.split(GPT(model.config, rngs=nnx.Rngs(seed + 2)))[1]
+
+    reg = MetricsRegistry()
+    tracer = Tracer(registry=reg)
+    router = Router(model, n_replicas=n_replicas, n_slots=n_slots,
+                    max_seq_len=max_seq_len, registry=reg, seed=seed,
+                    tracer=tracer, engine_kwargs={"prewarm": True})
+
+    rng = np.random.default_rng(seed)
+    prompts = [
+        [int(t) for t in rng.integers(
+            0, 256, int(rng.integers(2, max_prompt + 1)))]
+        for _ in range(256)
+    ]
+    # faster verdicts than the production defaults: the bench pays wall
+    # time per detector window, and the poison signal is huge (tens of
+    # ms on a ~tick-bound baseline), so shorter histories stay sound
+    det_params = {"ttft_drift": {"min_windows": 6, "sustain": 2},
+                  "tpot_drift": {"min_windows": 6, "sustain": 2}}
+    if smoke:
+        # tiny fleets amplify the canary's rebalancing bias (a 2-replica
+        # smoke fleet hands the empty rejoining canary ~half the queue),
+        # and the poison signal is ~10x — a higher rel floor keeps the
+        # clean campaign clean without costing the drill any teeth
+        for d in det_params.values():
+            d["min_rel"] = 0.8
+    ro_kw = dict(window_s=window_s, max_mixing_s=max_mixing_s,
+                 baseline_min_requests=8, canary_min_requests=8,
+                 detector_params=det_params, echo=lambda _s: None)
+
+    t0 = time.perf_counter()
+    next_arrival, submitted, done = 0.0, 0, []
+    stage = "warmup"  # -> "A" -> "B" -> "drain"
+    ro_a = ro_b = None
+    t_poison = t_rollback = None
+    prev_inj = None
+    timed_out = False
+    try:
+        while True:
+            now = time.perf_counter() - t0
+            if now > timeout_s:
+                timed_out = True
+                break
+            if stage != "drain":
+                while next_arrival <= now and submitted < cap:
+                    router.submit(prompts[submitted % len(prompts)],
+                                  max_new_tokens=max_new,
+                                  temperature=1.0, top_k=None)
+                    submitted += 1
+                    next_arrival += float(rng.exponential(1.0 / rate))
+            t_step = time.perf_counter()
+            done.extend(router.step())
+            lag = tick_s - (time.perf_counter() - t_step)
+            if lag > 0:
+                time.sleep(lag)
+            if stage == "warmup" and len(done) >= warm_n:
+                ro_a = router.rollout("v2", state=state_v2, **ro_kw)
+                stage = "A"
+            elif stage == "A" and not ro_a.active:
+                # a LONGER canary hold for the poisoned campaign: the
+                # verdict window must comfortably contain the detector
+                # decision (min_windows of canary data + sustain
+                # checks) — a trip aborts the hold immediately, so the
+                # extra headroom costs nothing on the rollback path
+                ro_b = router.rollout(
+                    "v3", state=state_v3,
+                    **{**ro_kw, "canary_hold_s": 24.0 * window_s})
+                stage = "B"
+            elif stage == "B":
+                if t_poison is None and ro_b.phase == "canary":
+                    # poison lands the moment the canary starts
+                    # serving the new version — the ISSUE 14
+                    # train_step_degrade pattern, serve-side
+                    prev_inj = set_injector(FaultInjector(
+                        f"serve_step_degrade:p=1:n={poison_n}"))
+                    t_poison = time.perf_counter() - t0
+                if (t_rollback is None
+                        and ro_b.phase == "rolling_back"):
+                    t_rollback = time.perf_counter() - t0
+                if not ro_b.active:
+                    stage = "drain"
+            elif stage == "drain" and not router.open_requests \
+                    and not router._pending:
+                break
+    finally:
+        if prev_inj is not None:
+            set_injector(prev_inj)
+        router.close()
+
+    lost = submitted - len(done)
+    mixing_a = ro_a.mixing_s if ro_a is not None else None
+    rollback_latency_s = (round(t_rollback - t_poison, 3)
+                          if t_rollback is not None
+                          and t_poison is not None else None)
+    end_versions = sorted({getattr(r, "weight_version", "0")
+                           for r in router.replicas})
+    ok = (not timed_out and lost == 0
+          and ro_a is not None and not ro_a.rolled_back
+          and ro_a.phase == "done"
+          and mixing_a is not None and mixing_a <= max_mixing_s
+          and ro_b is not None and ro_b.rolled_back
+          and ro_b.phase == "done"
+          and ro_b.rollback_reason == "canary_anomaly"
+          and end_versions == ["v2"]
+          and rollback_latency_s is not None
+          and rollback_latency_s <= rollback_bound_s)
+
+    # the decision log as tools/fleet_report.py renders it — the same
+    # `rollout` trace events, summarized by the same code path
+    try:
+        from fleet_report import summarize_fleet  # python tools/serve_bench.py
+    except ImportError:
+        from tools.fleet_report import summarize_fleet  # imported from tests
+
+    fleet = summarize_fleet(
+        tracer.events(), {"counters": reg.snapshot()["counters"]})
+    counters = reg.snapshot()["counters"]
+    bench = {
+        "kind": "rollout_bench",
+        "smoke": smoke,
+        "config": {
+            "seed": seed, "rate": rate, "n_replicas": n_replicas,
+            "n_slots": n_slots, "max_new_tokens": max_new,
+            "max_prompt": max_prompt, "tick_ms": tick_s * 1e3,
+            "window_s": window_s, "max_mixing_s": max_mixing_s,
+            "poison_n": poison_n,
+            "rollback_bound_s": rollback_bound_s,
+            "detector_params": det_params,
+        },
+        "requests": {"submitted": submitted, "finished": len(done),
+                     "lost": lost},
+        "campaigns": {
+            "clean": None if ro_a is None else {
+                **ro_a.status(), "decisions": ro_a.decisions},
+            "poisoned": None if ro_b is None else {
+                **ro_b.status(), "decisions": ro_b.decisions,
+                "t_poison_s": t_poison,
+                "t_rollback_s": t_rollback,
+                "rollback_latency_s": rollback_latency_s},
+        },
+        "end_versions": end_versions,
+        "counters": {k: counters.get(k) for k in
+                     ("rollouts", "rollbacks", "canary_anomalies",
+                      "serve_failovers")},
+        "fleet_report": {"rollout_decisions": fleet["rollouts"]},
+        "timed_out": timed_out,
+        "ok": ok,
+    }
+    with open(out_path, "w") as f:
+        _json.dump(bench, f, indent=1)
+    print(f"[rollout_bench] lost {lost}/{submitted}  "
+          f"mixing(clean) "
+          f"{(mixing_a if mixing_a is not None else float('nan')):.2f}s"
+          f"  rollback latency "
+          f"{(rollback_latency_s if rollback_latency_s is not None else float('nan')):.2f}s"
+          f"  end versions {end_versions}  -> {out_path} (ok={ok})")
+    return 0 if ok else 1
+
+
 def kv_cdn_bench(args):
     """BENCH_kv_cdn.json (ISSUE 17 acceptance): multi-tenant shared-
     prefix workload through `Router(affinity=...)` on/off at EQUAL
@@ -1335,6 +1541,8 @@ def main():
         sys.exit(disagg_bench(args))
     if "autoscale_bench" in args:
         sys.exit(autoscale_bench(args))
+    if "rollout" in args:
+        sys.exit(rollout_bench(args))
     n_requests = int(args.get("n_requests", 32))
     rate = float(args.get("rate", 16.0))  # mean arrivals per second
     n_slots = int(args.get("n_slots", 4))
